@@ -1,0 +1,20 @@
+"""multiverso_tpu — a TPU-native framework with the capabilities of the
+Multiverso parameter server (reference: zhengkaifu/Multiverso, a fork of the
+DMTK parameter server; see SURVEY.md).
+
+The reference's sharded parameter tables become pjit-sharded ``jax.Array``s
+resident in TPU HBM; the worker Get/Add contract and client-side aggregation
+collapse into XLA collectives over ICI/DCN; the server-side updater stack
+compiles as an on-device sharded optimizer step.
+"""
+
+from multiverso_tpu.version import __version__
+from multiverso_tpu.core import (barrier, init, is_initialized, mesh,
+                                 num_servers, num_workers, rank, server_id,
+                                 shutdown, size, worker_id)
+
+__all__ = [
+    "__version__", "barrier", "init", "is_initialized", "mesh",
+    "num_servers", "num_workers", "rank", "server_id", "shutdown", "size",
+    "worker_id",
+]

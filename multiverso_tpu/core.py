@@ -1,0 +1,222 @@
+"""Core runtime: init / shutdown / barrier / topology / the device mesh.
+
+TPU-native replacement for the reference's process runtime (upstream layout
+`src/multiverso.cpp`, `src/zoo.cpp`, `src/communicator.cpp`,
+`src/controller.cpp`, `src/net/{mpi,zmq}_net.h` — SURVEY.md §3.1/§3.2/§4.1):
+
+- ``MV_Init`` (flag parsing + MPI/ZMQ bootstrap + actor threads + register
+  handshake + barrier) becomes :func:`init`: parse ``-name=value`` flags,
+  optionally ``jax.distributed.initialize`` over DCN, and build one global
+  :class:`jax.sharding.Mesh` over all devices.
+- The Worker/Server actor roles dissolve: every chip is simultaneously a
+  worker (compute) and a server (holds its parameter shard) — the
+  "no CPU PS in the loop" north star (BASELINE.json).
+- ``MV_Barrier`` (Control_Barrier round trip through the rank-0 Controller)
+  becomes a device-level sync: all hosts dispatch one tiny all-reduce over
+  every device and block on the result.
+- Topology queries (``MV_Rank/Size/NumWorkers/NumServers/WorkerId/ServerId``)
+  map onto JAX process/device topology: a "node" is a host process, a
+  "worker" and a "server" are both "a chip".
+
+The mesh convention: axes ``("data", "model")``. Tables shard their leading
+dimension over ``"model"`` (the analog of partitioning rows across server
+shards) and gradients are reduced over ``"data"`` (the analog of the
+Add/Aggregator path). ``model_parallel=1`` (default) gives pure DP with
+fully replicated tables, matching the reference's default deployment shape.
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from multiverso_tpu.utils import configure, log
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+class _Runtime:
+    """Process-global runtime state (the Zoo singleton's successor)."""
+
+    def __init__(self) -> None:
+        self.initialized = False
+        self.mesh: Optional[Mesh] = None
+        self.lock = threading.Lock()
+        self.barrier_count = 0
+
+
+_RT = _Runtime()
+
+
+def _build_mesh(devices: Sequence[jax.Device], data_parallel: int,
+                model_parallel: int) -> Mesh:
+    n = len(devices)
+    if model_parallel <= 0:
+        raise ValueError("model_parallel must be >= 1")
+    if data_parallel <= 0:
+        data_parallel = n // model_parallel
+    if data_parallel * model_parallel != n:
+        raise ValueError(
+            f"mesh {data_parallel}x{model_parallel} != {n} devices")
+    dev_array = np.asarray(devices).reshape(data_parallel, model_parallel)
+    return Mesh(dev_array, (DATA_AXIS, MODEL_AXIS))
+
+
+def init(argv: Optional[Sequence[str]] = None, *,
+         devices: Optional[Sequence[jax.Device]] = None,
+         data_parallel: Optional[int] = None,
+         model_parallel: Optional[int] = None) -> Mesh:
+    """Initialise the runtime and build the global device mesh.
+
+    ``argv`` may carry reference-style ``-name=value`` flags. ``devices``,
+    ``data_parallel``, ``model_parallel`` override flags when given (used by
+    tests to build virtual CPU meshes).
+
+    Idempotent like ``MV_Init``: a second call with no arguments returns the
+    existing mesh.
+    """
+    with _RT.lock:
+        if argv:
+            configure.parse_flags(argv)
+        if _RT.initialized and not argv and devices is None \
+                and data_parallel is None and model_parallel is None:
+            assert _RT.mesh is not None
+            return _RT.mesh
+
+        log.set_level(configure.get_flag("log_level"))
+        if configure.get_flag("log_file"):
+            log.set_file(configure.get_flag("log_file"))
+
+        coordinator = configure.get_flag("machine_file")
+        if coordinator:
+            # Multi-host bootstrap over DCN (the reference's MPI_Init /
+            # ZMQ-machine_file moment). Must run before anything touches
+            # the XLA backend; jax raises if the backend is already up,
+            # and that is a real misconfiguration — fail fast, a silent
+            # fallback to single-host topology would train wrong.
+            port = configure.get_flag("port") or 8476
+            jax.distributed.initialize(
+                coordinator_address=f"{coordinator}:{port}")
+
+        devs = list(devices) if devices is not None else jax.devices()
+        dp = data_parallel if data_parallel is not None \
+            else configure.get_flag("data_parallel")
+        mp = model_parallel if model_parallel is not None \
+            else configure.get_flag("model_parallel")
+        _RT.mesh = _build_mesh(devs, dp, mp)
+        _RT.initialized = True
+        log.info("multiverso_tpu.init: %d devices, mesh data=%d model=%d, "
+                 "process %d/%d", len(devs), _RT.mesh.shape[DATA_AXIS],
+                 _RT.mesh.shape[MODEL_AXIS], jax.process_index(),
+                 jax.process_count())
+        return _RT.mesh
+
+
+def is_initialized() -> bool:
+    return _RT.initialized
+
+
+def shutdown(finalize: bool = True) -> None:
+    """``MV_ShutDown`` equivalent: drop the mesh; optionally report timing."""
+    with _RT.lock:
+        if not _RT.initialized:
+            return
+        _RT.initialized = False
+        _RT.mesh = None
+    if finalize:
+        from multiverso_tpu.utils import dashboard
+        log.debug("dashboard at shutdown:\n%s", dashboard.report())
+
+
+def mesh() -> Mesh:
+    if not _RT.initialized or _RT.mesh is None:
+        init()
+    assert _RT.mesh is not None
+    return _RT.mesh
+
+
+def set_mesh(m: Mesh) -> None:
+    """Install an externally-built mesh (tests, embedding in a larger app)."""
+    with _RT.lock:
+        _RT.mesh = m
+        _RT.initialized = True
+
+
+@jax.jit
+def _barrier_sum(x):
+    return x.sum()
+
+
+def barrier(name: Optional[str] = None) -> None:
+    """Global synchronisation point (``MV_Barrier``).
+
+    Dispatches a tiny all-reduce over every device of the mesh and blocks
+    until it completes; across hosts this is a true barrier because the
+    collective cannot complete until every host has dispatched it.
+    """
+    m = mesh()
+    _RT.barrier_count += 1
+    ones = jax.device_put(
+        np.zeros((len(m.devices.flat),), np.int32),
+        NamedSharding(m, P((DATA_AXIS, MODEL_AXIS))))
+    _barrier_sum(ones).block_until_ready()
+
+
+# -- Topology queries (reference MV_* names, SURVEY.md §3.5) ---------------
+
+def rank() -> int:
+    """Host-process rank (reference: node rank)."""
+    return jax.process_index()
+
+
+def size() -> int:
+    """Number of host processes (reference: node count)."""
+    return jax.process_count()
+
+
+def num_workers() -> int:
+    """Reference: count of worker roles. Here every chip computes."""
+    return len(mesh().devices.flat)
+
+
+def num_servers() -> int:
+    """Reference: count of server roles. Here every chip holds a shard."""
+    return len(mesh().devices.flat)
+
+
+def worker_id() -> int:
+    """First local device's position in the mesh (per-host worker id)."""
+    me = jax.process_index()
+    for i, d in enumerate(mesh().devices.flat):
+        if d.process_index == me:
+            return i
+    return -1
+
+
+def server_id() -> int:
+    return worker_id()
+
+
+def is_worker() -> bool:
+    return True
+
+
+def is_server() -> bool:
+    return True
+
+
+def data_axis_size() -> int:
+    return mesh().shape[DATA_AXIS]
+
+
+def model_axis_size() -> int:
+    return mesh().shape[MODEL_AXIS]
+
+
+atexit.register(shutdown)

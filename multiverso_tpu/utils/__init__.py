@@ -1,0 +1,20 @@
+"""Cross-cutting utilities (SURVEY.md §3.7): flags, logging, dashboard,
+timers, async double-buffering."""
+
+from multiverso_tpu.utils import async_buffer, configure, dashboard, log
+from multiverso_tpu.utils.async_buffer import ASyncBuffer, prefetch_iterator
+from multiverso_tpu.utils.configure import (define_bool, define_float,
+                                            define_int, define_string,
+                                            describe_flags, get_flag,
+                                            has_flag, parse_flags,
+                                            reset_flags, set_flag)
+from multiverso_tpu.utils.dashboard import (Timer, emit_metric, monitor,
+                                            profile, report)
+
+__all__ = [
+    "async_buffer", "configure", "dashboard", "log",
+    "ASyncBuffer", "prefetch_iterator",
+    "define_bool", "define_float", "define_int", "define_string",
+    "describe_flags", "get_flag", "has_flag", "parse_flags", "reset_flags",
+    "set_flag", "Timer", "emit_metric", "monitor", "profile", "report",
+]

@@ -1,0 +1,104 @@
+"""ASyncBuffer: background-filled double buffer for prefetch pipelines.
+
+TPU-native equivalent of the reference's double-buffer utility
+(`include/multiverso/util/async_buffer.h` upstream layout; SURVEY.md §3.7):
+a background thread produces buffer k+1 while the caller consumes buffer k.
+The reference uses this to overlap parameter prefetch / data-block IO with
+trainer compute (word2vec ParameterLoader, LightLDA block streaming,
+SURVEY.md §4.5); here it overlaps host-side batch production with TPU steps.
+
+Also provides ``prefetch_iterator`` — a bounded-queue generator wrapper,
+the common shape for feeding a jitted train loop.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Generic, Iterable, Iterator, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class ASyncBuffer(Generic[T]):
+    """Two-slot buffer: ``fill_fn(slot_index)`` runs on a worker thread.
+
+    ``get()`` blocks until the in-flight fill completes, returns the filled
+    value, and immediately kicks off the next fill — the caller always
+    overlaps its consumption of buffer k with the production of buffer k+1.
+    """
+
+    def __init__(self, fill_fn: Callable[[int], T]) -> None:
+        self._fill_fn = fill_fn
+        self._results: "queue.Queue[tuple[Optional[T], Optional[BaseException]]]" = (
+            queue.Queue(maxsize=1))
+        self._index = 0
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+        self._kick()
+
+    def _kick(self) -> None:
+        def work(idx: int) -> None:
+            try:
+                self._results.put((self._fill_fn(idx), None))
+            except BaseException as exc:  # propagate to consumer
+                self._results.put((None, exc))
+
+        self._thread = threading.Thread(target=work, args=(self._index,),
+                                        daemon=True)
+        self._thread.start()
+        self._index += 1
+
+    def get(self) -> T:
+        if self._stopped:
+            raise RuntimeError("ASyncBuffer already stopped")
+        value, exc = self._results.get()
+        if exc is not None:
+            self._stopped = True
+            raise exc
+        self._kick()
+        return value
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+def prefetch_iterator(it: Iterable[T], depth: int = 2) -> Iterator[T]:
+    """Run ``it`` on a background thread, buffering up to ``depth`` items.
+
+    Closing the generator (``break`` in the consumer, ``.close()``, GC)
+    cancels the producer thread so the source iterator is released.
+    """
+    q: "queue.Queue[object]" = queue.Queue(maxsize=depth)
+    _END = object()
+    cancel = threading.Event()
+
+    def work() -> None:
+        try:
+            for item in it:
+                while not cancel.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if cancel.is_set():
+                    return
+            q.put(_END)
+        except BaseException as exc:
+            q.put(exc)
+
+    thread = threading.Thread(target=work, daemon=True)
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item  # type: ignore[misc]
+    finally:
+        cancel.set()

@@ -1,0 +1,144 @@
+"""Dashboard / Monitor: named timing accumulators + structured metrics.
+
+TPU-native equivalent of the reference's profiling dashboard
+(`include/multiverso/dashboard.h`, `src/dashboard.cpp` upstream layout;
+SURVEY.md §3.7 / §6.1): named monitors accumulate call count and elapsed
+wall-clock around instrumented regions and are dumped as a table at
+shutdown or on demand.
+
+Extensions for the TPU build (SURVEY.md §6.5): a JSONL metric sink so
+per-step throughput metrics (`words/sec/chip`, `doc-tokens/sec`) are
+scriptable, and a context-manager / decorator API instead of
+MONITOR_BEGIN/END macros.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, Optional, TextIO
+
+
+@dataclass
+class Monitor:
+    name: str
+    count: int = 0
+    total_s: float = 0.0
+    _begin: Optional[float] = field(default=None, repr=False)
+
+    def begin(self) -> None:
+        self._begin = time.perf_counter()
+
+    def end(self) -> None:
+        if self._begin is None:
+            raise RuntimeError(f"Monitor {self.name!r}: end() without begin()")
+        self.total_s += time.perf_counter() - self._begin
+        self.count += 1
+        self._begin = None
+
+    @property
+    def mean_s(self) -> float:
+        return self.total_s / self.count if self.count else 0.0
+
+
+class Dashboard:
+    """Process-wide registry of monitors + JSONL metric sink."""
+
+    def __init__(self) -> None:
+        self._monitors: Dict[str, Monitor] = {}
+        self._lock = threading.Lock()
+        self._jsonl: Optional[TextIO] = None
+
+    def monitor(self, name: str) -> Monitor:
+        with self._lock:
+            mon = self._monitors.get(name)
+            if mon is None:
+                mon = Monitor(name)
+                self._monitors[name] = mon
+            return mon
+
+    @contextlib.contextmanager
+    def profile(self, name: str) -> Iterator[Monitor]:
+        mon = self.monitor(name)
+        start = time.perf_counter()
+        try:
+            yield mon
+        finally:
+            with self._lock:
+                mon.total_s += time.perf_counter() - start
+                mon.count += 1
+
+    def set_jsonl(self, path: str) -> None:
+        with self._lock:
+            if self._jsonl is not None:
+                self._jsonl.close()
+            self._jsonl = open(path, "a") if path else None
+
+    def emit_metric(self, name: str, value: float, unit: str = "",
+                    **extra) -> dict:
+        """Emit one structured metric record (stdout-friendly JSON)."""
+        rec = {"metric": name, "value": float(value), "unit": unit,
+               "ts": time.time(), **extra}
+        with self._lock:
+            if self._jsonl is not None:
+                self._jsonl.write(json.dumps(rec) + "\n")
+                self._jsonl.flush()
+        return rec
+
+    def report(self) -> str:
+        with self._lock:
+            mons = sorted(self._monitors.values(), key=lambda m: m.name)
+        if not mons:
+            return "(dashboard: no monitors)"
+        w = max(len(m.name) for m in mons)
+        lines = [f"{'monitor'.ljust(w)}  count     total_s      mean_ms"]
+        for m in mons:
+            lines.append(f"{m.name.ljust(w)}  {m.count:5d}  {m.total_s:10.4f}"
+                         f"  {m.mean_s * 1e3:11.4f}")
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._monitors.clear()
+
+
+_DASHBOARD = Dashboard()
+
+
+def dashboard() -> Dashboard:
+    return _DASHBOARD
+
+
+def profile(name: str):
+    return _DASHBOARD.profile(name)
+
+
+def monitor(name: str) -> Monitor:
+    return _DASHBOARD.monitor(name)
+
+
+def emit_metric(name: str, value: float, unit: str = "", **extra) -> dict:
+    return _DASHBOARD.emit_metric(name, value, unit, **extra)
+
+
+def report() -> str:
+    return _DASHBOARD.report()
+
+
+class Timer:
+    """Simple restartable stopwatch (reference `util/timer.h` equivalent)."""
+
+    def __init__(self) -> None:
+        self._start = time.perf_counter()
+
+    def restart(self) -> None:
+        self._start = time.perf_counter()
+
+    def elapsed_s(self) -> float:
+        return time.perf_counter() - self._start
+
+    def elapsed_ms(self) -> float:
+        return self.elapsed_s() * 1e3

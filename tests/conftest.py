@@ -1,0 +1,47 @@
+"""Test rig: 8 virtual XLA CPU devices in one process.
+
+The analog of the reference's `mpirun -np N ./multiverso_test` trick
+(SURVEY.md §5): N ranks simulated on one machine. Here the N "ranks" are N
+simulated XLA CPU devices forming a mesh in a single process.
+
+Must set the env vars before jax initialises its backends, hence the
+os.environ writes at import time (conftest imports before any test module).
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8").strip()
+# Note: this image's sitecustomize force-registers the axon TPU platform and
+# pins jax_platforms="axon,cpu"; we therefore select CPU devices explicitly
+# (jax.devices("cpu")) rather than via JAX_PLATFORMS.
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_default_device", None)
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices("cpu")
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs
+
+
+@pytest.fixture()
+def mesh8(devices):
+    """A 4x2 (data x model) mesh over the 8 virtual devices."""
+    from multiverso_tpu import core
+    m = core.init(devices=devices, data_parallel=4, model_parallel=2)
+    yield m
+    core.shutdown()
+
+
+@pytest.fixture()
+def mesh_dp8(devices):
+    """Pure data-parallel 8x1 mesh."""
+    from multiverso_tpu import core
+    m = core.init(devices=devices, data_parallel=8, model_parallel=1)
+    yield m
+    core.shutdown()

@@ -1,0 +1,56 @@
+"""Core runtime tests: mesh, init, barrier, topology (SURVEY.md §3.1/§4.1)."""
+
+import jax
+import numpy as np
+import pytest
+
+from multiverso_tpu import core
+
+
+class TestMesh:
+    def test_init_builds_mesh(self, mesh8):
+        assert mesh8.shape[core.DATA_AXIS] == 4
+        assert mesh8.shape[core.MODEL_AXIS] == 2
+        assert core.is_initialized()
+        assert core.mesh() is mesh8
+
+    def test_pure_dp_mesh(self, mesh_dp8):
+        assert mesh_dp8.shape[core.DATA_AXIS] == 8
+        assert mesh_dp8.shape[core.MODEL_AXIS] == 1
+
+    def test_bad_factorisation_raises(self, devices):
+        with pytest.raises(ValueError):
+            core._build_mesh(devices, data_parallel=3, model_parallel=2)
+
+    def test_idempotent_reinit(self, mesh8):
+        assert core.init() is mesh8
+
+
+class TestTopology:
+    def test_counts(self, mesh8):
+        assert core.num_workers() == 8
+        assert core.num_servers() == 8
+        assert core.rank() == 0
+        assert core.size() == 1
+        assert core.is_worker() and core.is_server()
+        assert core.worker_id() == 0
+        assert core.data_axis_size() == 4
+        assert core.model_axis_size() == 2
+
+
+class TestBarrier:
+    def test_barrier_completes(self, mesh8):
+        before = core._RT.barrier_count
+        core.barrier()
+        core.barrier("named")
+        assert core._RT.barrier_count == before + 2
+
+
+class TestShutdown:
+    def test_shutdown_then_reinit(self, devices):
+        core.init(devices=devices, data_parallel=8, model_parallel=1)
+        core.shutdown()
+        assert not core.is_initialized()
+        m = core.init(devices=devices, data_parallel=2, model_parallel=4)
+        assert m.shape[core.MODEL_AXIS] == 4
+        core.shutdown()

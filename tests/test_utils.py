@@ -1,0 +1,145 @@
+"""Utilities tests: flags, logger, dashboard, async buffer (SURVEY.md §3.7)."""
+
+import time
+
+import pytest
+
+from multiverso_tpu.utils import (ASyncBuffer, configure, dashboard,
+                                  prefetch_iterator)
+from multiverso_tpu.utils import log as mvlog
+
+
+class TestConfigure:
+    def test_define_and_get_defaults(self):
+        configure.reset_flags()
+        assert configure.get_flag("sync") is True
+        assert configure.get_flag("updater_type") == "default"
+
+    def test_parse_name_value(self):
+        configure.reset_flags()
+        rest = configure.parse_flags(
+            ["-updater_type=adagrad", "-sync=false", "train.txt",
+             "--port=9000"])
+        assert configure.get_flag("updater_type") == "adagrad"
+        assert configure.get_flag("sync") is False
+        assert configure.get_flag("port") == 9000
+        assert rest == ["train.txt"]
+        configure.reset_flags()
+
+    def test_unknown_flag_passes_through(self):
+        configure.reset_flags()
+        rest = configure.parse_flags(["-no_such_flag=1"])
+        assert rest == ["-no_such_flag=1"]
+
+    def test_custom_flag_roundtrip(self):
+        configure.define_int("test_only_flag", 7, "test")
+        assert configure.get_flag("test_only_flag") == 7
+        configure.set_flag("test_only_flag", 13)
+        assert configure.get_flag("test_only_flag") == 13
+        configure.reset_flags("test_only_flag")
+        assert configure.get_flag("test_only_flag") == 7
+
+    def test_conflicting_redefinition_raises(self):
+        configure.define_int("test_conflict_flag", 1, "test")
+        with pytest.raises(ValueError):
+            configure.define_int("test_conflict_flag", 2, "test")
+
+    def test_bool_parsing(self):
+        configure.define_bool("test_bool_flag", False, "test")
+        configure.parse_flags(["-test_bool_flag=on"])
+        assert configure.get_flag("test_bool_flag") is True
+        configure.parse_flags(["-test_bool_flag=0"])
+        assert configure.get_flag("test_bool_flag") is False
+
+
+class TestLog:
+    def test_levels_and_fatal(self, capsys):
+        lg = mvlog.Logger(level=mvlog.WARN)
+        lg.info("hidden")
+        lg.warn("visible %d", 42)
+        err = capsys.readouterr().err
+        assert "hidden" not in err
+        assert "visible 42" in err
+        with pytest.raises(SystemExit):
+            lg.fatal("boom")
+
+    def test_file_sink(self, tmp_path):
+        path = tmp_path / "log.txt"
+        lg = mvlog.Logger(level=mvlog.INFO, file=str(path))
+        lg.info("to file")
+        assert "to file" in path.read_text()
+
+
+class TestDashboard:
+    def test_profile_accumulates(self):
+        db = dashboard.Dashboard()
+        for _ in range(3):
+            with db.profile("region"):
+                time.sleep(0.001)
+        mon = db.monitor("region")
+        assert mon.count == 3
+        assert mon.total_s > 0
+        assert "region" in db.report()
+
+    def test_emit_metric_jsonl(self, tmp_path):
+        db = dashboard.Dashboard()
+        path = tmp_path / "metrics.jsonl"
+        db.set_jsonl(str(path))
+        rec = db.emit_metric("words/sec/chip", 123.0, "words/s", step=1)
+        assert rec["value"] == 123.0
+        import json
+        loaded = json.loads(path.read_text().strip())
+        assert loaded["metric"] == "words/sec/chip"
+        assert loaded["step"] == 1
+
+    def test_timer(self):
+        t = dashboard.Timer()
+        time.sleep(0.001)
+        assert t.elapsed_s() > 0
+        t.restart()
+        assert t.elapsed_s() < 1.0
+
+
+class TestASyncBuffer:
+    def test_ordered_fills(self):
+        buf = ASyncBuffer(lambda i: i * i)
+        got = [buf.get() for _ in range(5)]
+        assert got == [0, 1, 4, 9, 16]
+        buf.stop()
+
+    def test_overlap(self):
+        # Fill takes 20ms; consuming 4 items with 20ms "compute" each should
+        # take ~4x20ms (overlapped), not ~8x20ms (serial).
+        def fill(i):
+            time.sleep(0.02)
+            return i
+
+        buf = ASyncBuffer(fill)
+        start = time.perf_counter()
+        for _ in range(4):
+            buf.get()
+            time.sleep(0.02)
+        elapsed = time.perf_counter() - start
+        buf.stop()
+        assert elapsed < 0.15, f"no overlap: {elapsed:.3f}s"
+
+    def test_error_propagates(self):
+        def fill(i):
+            raise RuntimeError("fill failed")
+
+        buf = ASyncBuffer(fill)
+        with pytest.raises(RuntimeError, match="fill failed"):
+            buf.get()
+
+    def test_prefetch_iterator(self):
+        assert list(prefetch_iterator(range(10), depth=3)) == list(range(10))
+
+    def test_prefetch_iterator_error(self):
+        def gen():
+            yield 1
+            raise ValueError("gen failed")
+
+        it = prefetch_iterator(gen())
+        assert next(it) == 1
+        with pytest.raises(ValueError, match="gen failed"):
+            next(it)
