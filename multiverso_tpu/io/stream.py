@@ -1,0 +1,66 @@
+"""Stream / StreamFactory: URI-scheme-dispatched binary IO.
+
+TPU-native equivalent of the reference IO layer (upstream layout
+`include/multiverso/io/io.h`, `local_stream.h`, `hdfs_stream.h` —
+SURVEY.md §3.7 / §6.4): table checkpoints (`ServerTable::Store/Load`) and
+app data flow through a `Stream` opened by URI, so `file://` and `hdfs://`
+(and anything else registered) are interchangeable.
+
+Here `file://` (and bare paths) are implemented; other schemes register
+via :func:`register_scheme`. `hdfs://` is intentionally not implemented —
+no hdfs client exists in this image; attempting it raises a clear error.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import BinaryIO, Callable, Dict, Tuple
+
+Stream = BinaryIO
+
+_OpenFn = Callable[[str, str], Stream]
+_SCHEMES: Dict[str, _OpenFn] = {}
+
+
+def register_scheme(scheme: str, open_fn: _OpenFn) -> None:
+    _SCHEMES[scheme] = open_fn
+
+
+def _split_uri(uri: str) -> Tuple[str, str]:
+    if "://" in uri:
+        scheme, _, rest = uri.partition("://")
+        return scheme, rest
+    return "file", uri
+
+
+def _open_local(path: str, mode: str) -> Stream:
+    if "w" in mode or "a" in mode:
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+    if "b" not in mode:
+        mode += "b"
+    return open(path, mode)
+
+
+register_scheme("file", _open_local)
+
+
+def open_stream(uri: str, mode: str = "rb") -> Stream:
+    """Open a binary stream for a URI (``file://path`` or a bare path)."""
+    scheme, path = _split_uri(uri)
+    try:
+        open_fn = _SCHEMES[scheme]
+    except KeyError:
+        raise ValueError(
+            f"unsupported stream scheme {scheme!r} in {uri!r}; "
+            f"registered: {sorted(_SCHEMES)}") from None
+    return open_fn(path, mode)
+
+
+class StreamFactory:
+    """Class-style facade matching the reference's StreamFactory."""
+
+    @staticmethod
+    def get_stream(uri: str, mode: str = "rb") -> Stream:
+        return open_stream(uri, mode)

@@ -1,0 +1,50 @@
+"""Table layer: sharded parameter tables (SURVEY.md §3.3).
+
+``create_table(option)`` is the TableFactory / ``MV_CreateTable<Option>``
+analog (upstream `src/table_factory.cpp`): paired worker+server creation
+collapses to constructing one sharded-array table; the option dataclass
+type selects the table kind.
+"""
+
+from typing import Union
+
+from multiverso_tpu.tables.base import (Handle, Table, get_table,
+                                        num_tables, reset_tables)
+from multiverso_tpu.tables.array_table import ArrayTable, ArrayTableOption
+from multiverso_tpu.tables.matrix_table import MatrixTable, MatrixTableOption
+from multiverso_tpu.tables.sparse_matrix_table import (SparseMatrixTable,
+                                                       SparseMatrixTableOption)
+from multiverso_tpu.tables.kv_table import KVTable, KVTableOption
+
+TableOption = Union[ArrayTableOption, MatrixTableOption,
+                    SparseMatrixTableOption, KVTableOption]
+
+
+def create_table(option: TableOption):
+    """``MV_CreateTable(option)``: construct the table kind selected by the
+    option dataclass."""
+    if isinstance(option, ArrayTableOption):
+        return ArrayTable(option.size, option.dtype,
+                          init_value=option.init_value,
+                          updater=option.updater, name=option.name)
+    if isinstance(option, SparseMatrixTableOption):
+        return SparseMatrixTable(option.num_rows, option.num_cols,
+                                 option.dtype, init_value=option.init_value,
+                                 updater=option.updater, name=option.name)
+    if isinstance(option, MatrixTableOption):
+        return MatrixTable(option.num_rows, option.num_cols, option.dtype,
+                           init_value=option.init_value,
+                           updater=option.updater, name=option.name)
+    if isinstance(option, KVTableOption):
+        return KVTable(option.capacity, option.value_dim, option.dtype,
+                       slots_per_bucket=option.slots_per_bucket,
+                       updater=option.updater, name=option.name)
+    raise TypeError(f"unknown table option type {type(option).__name__}")
+
+
+__all__ = [
+    "ArrayTable", "ArrayTableOption", "Handle", "KVTable", "KVTableOption",
+    "MatrixTable", "MatrixTableOption", "SparseMatrixTable",
+    "SparseMatrixTableOption", "Table", "TableOption", "create_table",
+    "get_table", "num_tables", "reset_tables",
+]

@@ -1,0 +1,46 @@
+"""ArrayTable: 1-D dense table.
+
+Reference: `include/multiverso/table/array_table.h` (upstream layout;
+SURVEY.md §3.3) — a 1-D dense ``T[]`` sharded in contiguous blocks across
+servers, with whole-array Get/Add (``ArrayWorker<T>::Get(T*, size)``,
+``Add(T*, size, AddOption*)``).
+
+Here the contiguous-block-per-server sharding IS the array's
+``NamedSharding`` over the mesh model axis; Get is a device→host copy (or
+a zero-copy device view), Add is the jitted updater step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from jax.sharding import Mesh
+
+from multiverso_tpu.tables.base import Table
+from multiverso_tpu.updaters import AddOption
+
+
+@dataclasses.dataclass
+class ArrayTableOption:
+    """``ArrayTableOption<T>`` analog for the create_table factory."""
+    size: int
+    dtype: Any = "float32"
+    init_value: Any = 0
+    updater: Optional[str] = None
+    name: str = "array_table"
+
+
+class ArrayTable(Table):
+    def __init__(self, size: int, dtype: Any = "float32", *,
+                 init_value: Any = 0, updater: Optional[str] = None,
+                 mesh: Optional[Mesh] = None, name: str = "array_table",
+                 default_option: Optional[AddOption] = None) -> None:
+        if size <= 0:
+            raise ValueError(f"ArrayTable size must be positive, got {size}")
+        super().__init__(name, (size,), dtype, updater=updater, mesh=mesh,
+                         init_value=init_value, default_option=default_option)
+
+    @property
+    def size(self) -> int:
+        return self.logical_shape[0]

@@ -1,0 +1,293 @@
+"""Table base: the Worker/Server table contract collapsed onto sharded
+``jax.Array`` storage.
+
+Reference mapping (upstream layout `include/multiverso/table_interface.h`,
+`src/table.cpp`, `src/table_factory.cpp` — SURVEY.md §3.3/§3.9):
+
+- ``WorkerTable::Get/Add/GetAsync/AddAsync/Wait`` → :meth:`Table.get`,
+  :meth:`Table.add`, ``*_async`` variants returning :class:`Handle`,
+  :meth:`Table.wait`. There is no Partition/ProcessReply machinery: the
+  "partition across servers" is the array's ``NamedSharding``, and the
+  request/reply round-trip is an XLA gather/scatter inside one compiled
+  program.
+- ``ServerTable::ProcessAdd`` (through the Updater) → a jitted
+  ``(param, state, delta, option) -> (param, state)`` step with donated
+  buffers, state sharded like params.
+- ``ServerTable::Store/Load(Stream*)`` → :meth:`Table.store` /
+  :meth:`Table.load` through the URI stream layer.
+- ``TableFactory`` / ``MV_CreateTable(option)`` → :func:`create_table`
+  dispatching on the option dataclass; tables registered process-wide
+  with integer ids like the reference's table ids.
+
+Sharding convention: tables shard their leading dimension over the mesh
+``"model"`` axis (the analog of row-blocks across server shards). Sizes
+that don't divide the shard count are zero-padded internally; the logical
+size is preserved at the API boundary.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from multiverso_tpu import core
+from multiverso_tpu.io import open_stream
+from multiverso_tpu.updaters import AddOption, Updater, get_updater
+from multiverso_tpu.utils import configure, log
+
+CHECKPOINT_MAGIC = "multiverso_tpu.table.v1"
+
+
+def savez_stream(uri: str, manifest: Dict[str, Any],
+                 payload: Dict[str, np.ndarray]) -> None:
+    """Write an npz (manifest json + arrays) through the stream layer."""
+    buf = io.BytesIO()
+    np.savez(buf, manifest=json.dumps(manifest), **payload)
+    with open_stream(uri, "wb") as stream:
+        stream.write(buf.getvalue())
+
+
+def loadz_stream(uri: str, magic: str):
+    """Read an npz through the stream layer; validate its manifest magic.
+    Returns (manifest dict, npz data)."""
+    with open_stream(uri, "rb") as stream:
+        data = np.load(io.BytesIO(stream.read()), allow_pickle=False)
+    try:
+        manifest = json.loads(str(data["manifest"]))
+    except Exception:
+        raise ValueError(f"{uri!r} is not a multiverso_tpu checkpoint "
+                         "(no manifest)") from None
+    if manifest.get("magic") != magic:
+        raise ValueError(f"{uri!r}: checkpoint magic "
+                         f"{manifest.get('magic')!r} != expected {magic!r}")
+    return manifest, data
+
+
+class Handle:
+    """Async completion handle (the reference's Waiter, SURVEY.md §3.7):
+    wraps dispatched device values; ``wait()`` blocks until they land."""
+
+    def __init__(self, values: Any) -> None:
+        self._values = values
+
+    def wait(self) -> Any:
+        jax.block_until_ready(self._values)
+        return self._values
+
+    # the reference's GetAsync returns data through the waiting buffer;
+    # here the handle carries the result.
+    def result(self) -> Any:
+        return self.wait()
+
+
+class Table:
+    """Base class owning one sharded param array (+ updater state)."""
+
+    def __init__(self, name: str, shape: Tuple[int, ...], dtype: Any,
+                 *, updater: Optional[str] = None,
+                 mesh: Optional[Mesh] = None,
+                 init_value: Any = 0,
+                 default_option: Optional[AddOption] = None) -> None:
+        self.name = name
+        self.mesh = mesh if mesh is not None else core.mesh()
+        self.logical_shape = tuple(shape)
+        self.dtype = jnp.dtype(dtype)
+        updater_name = updater if updater is not None \
+            else configure.get_flag("updater_type")
+        self.updater: Updater = get_updater(updater_name)
+        self.default_option = default_option or AddOption()
+        self._option_lock = threading.Lock()
+
+        # pad leading dim to a multiple of the model-axis size
+        # (subclasses override _pad_lead to reserve scratch rows)
+        shards = self.mesh.shape[core.MODEL_AXIS]
+        lead = self.logical_shape[0] if self.logical_shape else 1
+        padded_lead = self._pad_lead(lead, shards)
+        self.padded_shape = (padded_lead,) + self.logical_shape[1:]
+        self.spec = P(core.MODEL_AXIS, *([None] * (len(shape) - 1)))
+        self.sharding = NamedSharding(self.mesh, self.spec)
+
+        init = np.full(self.padded_shape, init_value, dtype=self.dtype) \
+            if np.isscalar(init_value) else self._pad(np.asarray(init_value))
+        self.param = jax.device_put(init, self.sharding)
+        # state leaves are zeros_like(param) shaped -> shard like params
+        self.state = jax.tree.map(
+            lambda s: jax.device_put(s, self.sharding),
+            self.updater.init_state(self.param))
+        self._apply = jax.jit(self.updater.apply, donate_argnums=(0, 1))
+        self.table_id = _register(self)
+        log.debug("table %r id=%d shape=%s padded=%s updater=%s", name,
+                  self.table_id, self.logical_shape, self.padded_shape,
+                  self.updater.name)
+
+    # -- helpers -----------------------------------------------------------
+
+    def _pad_lead(self, lead: int, shards: int) -> int:
+        return -(-lead // shards) * shards
+
+    def _pad(self, arr: np.ndarray) -> np.ndarray:
+        if arr.shape == self.padded_shape:
+            return arr.astype(self.dtype, copy=False)
+        if arr.shape != self.logical_shape:
+            raise ValueError(f"table {self.name!r}: value shape {arr.shape} "
+                             f"!= table shape {self.logical_shape}")
+        pad = [(0, p - l) for p, l in zip(self.padded_shape, arr.shape)]
+        return np.pad(arr.astype(self.dtype, copy=False), pad)
+
+    def _resolve_option(self, option: Optional[AddOption]) -> AddOption:
+        opt = option if option is not None else self.default_option
+        return opt.as_jax()
+
+    def _bump_step(self) -> None:
+        with self._option_lock:
+            self.default_option.step += 1
+
+    # -- the Get/Add contract ---------------------------------------------
+
+    def raw(self) -> jax.Array:
+        """The padded device array — a LIVE view of table storage: the next
+        ``add`` donates this buffer to XLA, invalidating the reference.
+        Use :meth:`get_jax` for a stable snapshot."""
+        return self.param
+
+    def get_jax(self) -> jax.Array:
+        """Device-resident logical value (slices off padding).
+
+        Returns a fresh buffer: ``add`` donates the param buffer, so a
+        zero-copy view would be invalidated by the next update.
+        """
+        if self.padded_shape == self.logical_shape:
+            return jnp.copy(self.param)
+        return self.param[tuple(slice(0, l) for l in self.logical_shape)]
+
+    def get(self) -> np.ndarray:
+        """Whole-table fetch to host (``WorkerTable::Get``)."""
+        return np.asarray(self.get_jax())
+
+    def get_async(self) -> Handle:
+        return Handle(self.get_jax())
+
+    def add(self, delta: Any, option: Optional[AddOption] = None,
+            sync: bool = False) -> Handle:
+        """``WorkerTable::Add``: fold a delta through the updater.
+
+        Dispatch is asynchronous (XLA async dispatch); ``sync=True`` blocks
+        until the update has been applied, matching the reference's
+        blocking Add.
+        """
+        if isinstance(delta, jax.Array):
+            if delta.shape == self.logical_shape \
+                    and self.logical_shape != self.padded_shape:
+                pad = [(0, p - l) for p, l in zip(self.padded_shape,
+                                                  delta.shape)]
+                delta = jnp.pad(delta, pad)
+            elif delta.shape != self.padded_shape:
+                if delta.shape != self.logical_shape:
+                    raise ValueError(
+                        f"table {self.name!r}: delta shape {delta.shape} != "
+                        f"table shape {self.logical_shape}")
+        else:
+            delta = self._pad(np.asarray(delta))
+        opt = self._resolve_option(option)
+        self.param, self.state = self._apply(self.param, self.state,
+                                             delta, opt)
+        self._bump_step()
+        handle = Handle(self.param)
+        if sync:
+            handle.wait()
+        return handle
+
+    add_async = add
+
+    def wait(self) -> None:
+        """Block until all outstanding updates on this table are applied."""
+        jax.block_until_ready((self.param, self.state))
+
+    # -- checkpoint (ServerTable::Store/Load) ------------------------------
+
+    def _manifest(self) -> Dict[str, Any]:
+        return {
+            "magic": CHECKPOINT_MAGIC,
+            "kind": type(self).__name__,
+            "name": self.name,
+            "logical_shape": list(self.logical_shape),
+            "padded_shape": list(self.padded_shape),
+            "dtype": self.dtype.name,
+            "updater": self.updater.name,
+            "step": self.default_option.step,
+        }
+
+    def store(self, uri: str) -> None:
+        """Serialize param + updater state through the stream layer."""
+        state_leaves, state_def = jax.tree.flatten(self.state)
+        payload = {"param": np.asarray(self.param)}
+        for i, leaf in enumerate(state_leaves):
+            payload[f"state_{i}"] = np.asarray(leaf)
+        manifest = self._manifest()
+        manifest["n_state_leaves"] = len(state_leaves)
+        savez_stream(uri, manifest, payload)
+
+    def load(self, uri: str) -> None:
+        manifest, data = loadz_stream(uri, CHECKPOINT_MAGIC)
+        if tuple(manifest["logical_shape"]) != self.logical_shape:
+            raise ValueError(
+                f"checkpoint shape {manifest['logical_shape']} != table "
+                f"shape {list(self.logical_shape)}")
+        if manifest["updater"] != self.updater.name:
+            raise ValueError(
+                f"checkpoint updater {manifest['updater']!r} != table "
+                f"updater {self.updater.name!r}")
+        param = data["param"]
+        if param.shape != self.padded_shape:  # repad (shard count changed)
+            param = self._pad(param[tuple(slice(0, l)
+                                          for l in self.logical_shape)])
+        self.param = jax.device_put(param.astype(self.dtype),
+                                    self.sharding)
+        leaves = [data[f"state_{i}"]
+                  for i in range(manifest["n_state_leaves"])]
+        _, state_def = jax.tree.flatten(self.state)
+        template_leaves = jax.tree.leaves(self.state)
+        restored = []
+        for leaf, tmpl in zip(leaves, template_leaves):
+            restored.append(jax.device_put(
+                leaf.astype(tmpl.dtype),
+                tmpl.sharding if isinstance(tmpl, jax.Array)
+                else self.sharding))
+        self.state = jax.tree.unflatten(state_def, restored)
+        self.default_option.step = int(manifest.get("step", 0))
+
+
+# -- process-wide table registry (TableFactory / table ids) ---------------
+
+_TABLES: List[Table] = []
+_REG_LOCK = threading.Lock()
+
+
+def _register(table: Table) -> int:
+    with _REG_LOCK:
+        _TABLES.append(table)
+        return len(_TABLES) - 1
+
+
+def get_table(table_id: int) -> Table:
+    with _REG_LOCK:
+        return _TABLES[table_id]
+
+
+def num_tables() -> int:
+    with _REG_LOCK:
+        return len(_TABLES)
+
+
+def reset_tables() -> None:
+    """Drop all registered tables (tests / shutdown)."""
+    with _REG_LOCK:
+        _TABLES.clear()

@@ -1,0 +1,305 @@
+"""KVTable: fixed-capacity hashed key→value table.
+
+Reference: `include/multiverso/table/kv_table.h` (upstream layout;
+SURVEY.md §3.3, confidence [M]) — a hash-map ``key→T`` table for
+unbounded/sparse feature spaces (logistic regression with hashed
+features), keys partitioned across servers by hash.
+
+TPU design (SURVEY.md §3.9 / §8 hard-part #4): XLA wants static shapes,
+so the open hash becomes a **bucketed cuckoo-free hash in fixed int32
+arrays**: ``num_buckets × slots_per_bucket`` slots, each bucket probed
+fully vectorized (no data-dependent while loops on the device). The
+bucket axis is sharded over the mesh model axis — hash→bucket IS the
+reference's hash→server partition.
+
+- ``get(keys)``: one jitted gather+compare; missing keys return
+  ``default_value`` and a found-mask.
+- ``add(keys, deltas)``: slot assignment (existing slot, else first free
+  slot) is resolved host-side per batch — insertion-order races between
+  duplicate new keys are a host concern, not a device loop — then one
+  jitted scatter applies all updates. Bucket overflow raises.
+
+Values may be scalar (``value_dim=0``) or fixed-dim vectors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from multiverso_tpu import core
+from multiverso_tpu.tables.base import (Handle, Table, _register,
+                                        loadz_stream, savez_stream)
+from multiverso_tpu.updaters import AddOption, get_updater
+from multiverso_tpu.utils import configure, log
+
+EMPTY_KEY = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def _split_keys(keys: np.ndarray) -> np.ndarray:
+    """(n,) uint64 → (n, 2) uint32 [hi, lo] for device storage."""
+    return np.stack([(keys >> np.uint64(32)).astype(np.uint32),
+                     (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)],
+                    axis=1)
+
+
+def _join_keys(split: np.ndarray) -> np.ndarray:
+    """(..., 2) uint32 [hi, lo] → (...,) uint64."""
+    return (split[..., 0].astype(np.uint64) << np.uint64(32)) \
+        | split[..., 1].astype(np.uint64)
+
+
+def _hash_u64(keys: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer — stable key→bucket mix (host + device safe)."""
+    x = keys.astype(np.uint64)
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
+@dataclasses.dataclass
+class KVTableOption:
+    capacity: int
+    value_dim: int = 0
+    dtype: Any = "float32"
+    slots_per_bucket: int = 8
+    updater: Optional[str] = None
+    name: str = "kv_table"
+
+
+class KVTable:
+    """Fixed-capacity hashed table. Not a dense-array Table subclass —
+    storage is (keys, values, state) triple — but implements the same
+    get/add/store/load contract and registers a table id."""
+
+    def __init__(self, capacity: int, value_dim: int = 0,
+                 dtype: Any = "float32", *, slots_per_bucket: int = 8,
+                 updater: Optional[str] = None,
+                 mesh: Optional[Mesh] = None, name: str = "kv_table",
+                 default_value: float = 0.0,
+                 default_option: Optional[AddOption] = None) -> None:
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.name = name
+        self.mesh = mesh if mesh is not None else core.mesh()
+        self.value_dim = value_dim
+        self.dtype = jnp.dtype(dtype)
+        self.slots = slots_per_bucket
+        self.default_value = default_value
+        updater_name = updater if updater is not None \
+            else configure.get_flag("updater_type")
+        self.updater = get_updater(updater_name)
+        self.default_option = default_option or AddOption()
+        self._option_lock = threading.Lock()
+
+        shards = self.mesh.shape[core.MODEL_AXIS]
+        buckets = -(-capacity // self.slots)
+        self.num_buckets = -(-buckets // shards) * shards
+        self.capacity = self.num_buckets * self.slots
+
+        kv_shape = (self.num_buckets, self.slots)
+        val_shape = kv_shape + ((value_dim,) if value_dim else ())
+        self._key_sharding = NamedSharding(
+            self.mesh, P(core.MODEL_AXIS, None, None))
+        self._val_sharding = NamedSharding(
+            self.mesh, P(core.MODEL_AXIS, *([None] * (len(val_shape) - 1))))
+        # 64-bit keys are stored as two uint32 planes (hi, lo): with
+        # jax_enable_x64 off, uint64 device arrays silently canonicalize to
+        # uint32, aliasing keys that share low 32 bits.
+        self.keys = jax.device_put(
+            np.full(kv_shape + (2,), 0xFFFFFFFF, dtype=np.uint32),
+            self._key_sharding)
+        self.values = jax.device_put(
+            np.full(val_shape, default_value, dtype=self.dtype),
+            self._val_sharding)
+        self.state = jax.tree.map(
+            lambda s: jax.device_put(s, self._val_sharding),
+            self.updater.init_state(self.values))
+        # host-side mirror of key→(bucket, slot): authoritative slot
+        # assignment (insertion decisions are host-side; device arrays are
+        # the data plane)
+        self._slot_map: Dict[int, Tuple[int, int]] = {}
+        self._bucket_fill = np.zeros(self.num_buckets, dtype=np.int32)
+        self._build_jits()
+        self.table_id = _register(self)  # type: ignore[arg-type]
+        log.debug("kv table %r: %d buckets x %d slots (capacity %d)",
+                  name, self.num_buckets, self.slots, self.capacity)
+
+    def _build_jits(self) -> None:
+        replicated = NamedSharding(self.mesh, P(None))
+
+        @partial(jax.jit, out_shardings=(replicated, replicated))
+        def lookup(keys_arr, values_arr, query, buckets):
+            # keys_arr: (B, S, 2) uint32; query: (n, 2) uint32
+            slots = jnp.take(keys_arr, buckets, axis=0)        # (n, S, 2)
+            vals = jnp.take(values_arr, buckets, axis=0)       # (n, S[, D])
+            match = (slots == query[:, None, :]).all(axis=-1)  # (n, S)
+            found = match.any(axis=1)
+            m = match if vals.ndim == 2 else match[..., None]
+            picked = jnp.sum(jnp.where(m, vals, 0), axis=1)
+            fill = found if vals.ndim == 2 else found[:, None]
+            picked = jnp.where(fill, picked,
+                               jnp.asarray(self.default_value, vals.dtype))
+            return picked, found
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def scatter_update(keys_arr, values_arr, state, buckets, slot_ids,
+                           query, deltas, option):
+            keys_arr = keys_arr.at[buckets, slot_ids].set(query)
+            old = values_arr[buckets, slot_ids]
+            old_state = jax.tree.map(lambda s: s[buckets, slot_ids], state)
+            new, new_state = self.updater.apply(old, old_state, deltas,
+                                                option)
+            values_arr = values_arr.at[buckets, slot_ids].set(
+                new.astype(values_arr.dtype))
+            state = jax.tree.map(
+                lambda s, ns: s.at[buckets, slot_ids].set(ns.astype(s.dtype)),
+                state, new_state)
+            return keys_arr, values_arr, state
+
+        self._lookup = lookup
+        self._scatter_update = scatter_update
+
+    def _buckets_of(self, keys: np.ndarray) -> np.ndarray:
+        return (_hash_u64(keys) % np.uint64(self.num_buckets)).astype(
+            np.int32)
+
+    def _check_keys(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.asarray(keys, dtype=np.uint64)
+        if keys.ndim != 1 or len(keys) == 0:
+            raise ValueError("keys must be a non-empty 1-D array")
+        if (keys == EMPTY_KEY).any():
+            raise ValueError(f"key {EMPTY_KEY} is the reserved empty "
+                             "sentinel")
+        return keys
+
+    # -- API ---------------------------------------------------------------
+
+    def get(self, keys) -> Tuple[np.ndarray, np.ndarray]:
+        """Batched lookup → (values, found_mask). Missing keys yield
+        ``default_value`` (the reference's KV semantics: absent = initial
+        value)."""
+        keys = self._check_keys(keys)
+        buckets = self._buckets_of(keys)
+        vals, found = self._lookup(self.keys, self.values,
+                                   jnp.asarray(_split_keys(keys)),
+                                   jnp.asarray(buckets))
+        return np.asarray(vals), np.asarray(found)
+
+    def add(self, keys, deltas, option: Optional[AddOption] = None,
+            sync: bool = False) -> Handle:
+        """Batched upsert-through-updater.
+
+        Duplicate keys within one batch must be pre-aggregated (the
+        client-side Aggregator role) — they raise otherwise.
+        """
+        keys = self._check_keys(keys)
+        uniq = np.unique(keys)
+        if len(uniq) != len(keys):
+            raise ValueError("duplicate keys in one add; pre-aggregate")
+        deltas = np.asarray(deltas)
+        want = (len(keys), self.value_dim) if self.value_dim else (len(keys),)
+        if deltas.shape != want:
+            raise ValueError(f"deltas shape {deltas.shape} != {want}")
+
+        # Two-pass slot assignment: plan first (no mutation), commit only
+        # once the whole batch is known to fit — an overflow raise must not
+        # leak slots or desynchronize the host mirror from device state.
+        buckets = self._buckets_of(keys)
+        slot_ids = np.empty(len(keys), dtype=np.int32)
+        planned_fill: Dict[int, int] = {}
+        new_assignments: Dict[int, Tuple[int, int]] = {}
+        for i, (k, b) in enumerate(zip(keys.tolist(), buckets.tolist())):
+            assigned = self._slot_map.get(k)
+            if assigned is not None:
+                slot_ids[i] = assigned[1]
+                continue
+            fill = planned_fill.get(b, int(self._bucket_fill[b]))
+            if fill >= self.slots:
+                raise RuntimeError(
+                    f"kv table {self.name!r}: bucket {b} overflow "
+                    f"({self.slots} slots); raise capacity or "
+                    "slots_per_bucket")
+            new_assignments[k] = (b, fill)
+            planned_fill[b] = fill + 1
+            slot_ids[i] = fill
+        self._slot_map.update(new_assignments)
+        for b, fill in planned_fill.items():
+            self._bucket_fill[b] = fill
+
+        opt = (option or self.default_option).as_jax()
+        self.keys, self.values, self.state = self._scatter_update(
+            self.keys, self.values, self.state, jnp.asarray(buckets),
+            jnp.asarray(slot_ids), jnp.asarray(_split_keys(keys)),
+            jnp.asarray(deltas), opt)
+        with self._option_lock:
+            self.default_option.step += 1
+        handle = Handle(self.values)
+        if sync:
+            handle.wait()
+        return handle
+
+    def wait(self) -> None:
+        jax.block_until_ready((self.keys, self.values, self.state))
+
+    def __len__(self) -> int:
+        return len(self._slot_map)
+
+    # -- checkpoint --------------------------------------------------------
+
+    KV_MAGIC = "multiverso_tpu.kvtable.v1"
+
+    def store(self, uri: str) -> None:
+        state_leaves = jax.tree.leaves(self.state)
+        payload = {"keys": np.asarray(self.keys),
+                   "values": np.asarray(self.values),
+                   "bucket_fill": self._bucket_fill}
+        for i, leaf in enumerate(state_leaves):
+            payload[f"state_{i}"] = np.asarray(leaf)
+        manifest = {"magic": self.KV_MAGIC, "name": self.name,
+                    "capacity": self.capacity, "value_dim": self.value_dim,
+                    "slots": self.slots, "num_buckets": self.num_buckets,
+                    "dtype": self.dtype.name, "updater": self.updater.name,
+                    "n_state_leaves": len(state_leaves),
+                    "step": self.default_option.step}
+        savez_stream(uri, manifest, payload)
+
+    def load(self, uri: str) -> None:
+        manifest, data = loadz_stream(uri, self.KV_MAGIC)
+        for field in ("num_buckets", "slots", "value_dim", "dtype"):
+            mine = getattr(self, field) if field != "dtype" \
+                else self.dtype.name
+            theirs = manifest[field]
+            if theirs != mine:
+                raise ValueError(
+                    f"kv table {field} mismatch: checkpoint {theirs!r} != "
+                    f"table {mine!r}")
+        if manifest["updater"] != self.updater.name:
+            raise ValueError(
+                f"checkpoint updater {manifest['updater']!r} != "
+                f"{self.updater.name!r}")
+        host_keys = data["keys"]
+        self.keys = jax.device_put(host_keys, self._key_sharding)
+        self.values = jax.device_put(data["values"].astype(self.dtype),
+                                     self._val_sharding)
+        leaves = [data[f"state_{i}"]
+                  for i in range(manifest["n_state_leaves"])]
+        _, state_def = jax.tree.flatten(self.state)
+        tmpl = jax.tree.leaves(self.state)
+        self.state = jax.tree.unflatten(state_def, [
+            jax.device_put(l.astype(t.dtype), self._val_sharding)
+            for l, t in zip(leaves, tmpl)])
+        self._bucket_fill = data["bucket_fill"].copy()
+        self._slot_map = {}
+        joined = _join_keys(host_keys)
+        for b in range(self.num_buckets):
+            for s in range(int(self._bucket_fill[b])):
+                self._slot_map[int(joined[b, s])] = (b, s)
+        self.default_option.step = int(manifest.get("step", 0))

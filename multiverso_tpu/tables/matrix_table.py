@@ -1,0 +1,193 @@
+"""MatrixTable: 2-D dense row-major table with row-subset Get/Add.
+
+Reference: `include/multiverso/table/matrix_table.h` (upstream layout;
+SURVEY.md §3.3) — row-sharded across servers; Get/Add of the whole matrix
+or an arbitrary row-id list; word2vec's embedding store
+(``MatrixWorkerTable<T>::Get(row_ids, ...)``, ``Add(row_ids, deltas)``).
+
+TPU design:
+
+- storage is one row-sharded array (``P("model", None)``); the reference's
+  row→server partition map is the sharding.
+- ``get_rows(ids)`` is a jitted gather (XLA inserts the collectives); the
+  six-thread-hop request/reply path of the reference (SURVEY.md §4.2)
+  becomes one compiled op.
+- ``add_rows(ids, deltas)`` for the ``default`` updater is a jitted
+  duplicate-safe scatter-add; for stateful updaters it is
+  gather→updater→masked scatter, touching only the addressed rows (the
+  reference applies the updater only to rows present in the Add).
+- row-count-dependent shapes are bucketed to powers of two and padded, so
+  the jit cache stays small; padded lanes scatter into a reserved scratch
+  row that lives beyond the logical row range.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from multiverso_tpu import core
+from multiverso_tpu.tables.base import Handle, Table
+from multiverso_tpu.updaters import AddOption
+
+
+def _bucket(n: int) -> int:
+    """Round up to the next power of two (min 8) to bound recompiles."""
+    b = 8
+    while b < n:
+        b <<= 1
+    return b
+
+
+@dataclasses.dataclass
+class MatrixTableOption:
+    num_rows: int
+    num_cols: int
+    dtype: Any = "float32"
+    init_value: Any = 0
+    updater: Optional[str] = None
+    name: str = "matrix_table"
+
+
+class MatrixTable(Table):
+    def __init__(self, num_rows: int, num_cols: int, dtype: Any = "float32",
+                 *, init_value: Any = 0, updater: Optional[str] = None,
+                 mesh: Optional[Mesh] = None, name: str = "matrix_table",
+                 default_option: Optional[AddOption] = None) -> None:
+        if num_rows <= 0 or num_cols <= 0:
+            raise ValueError(f"MatrixTable dims must be positive, got "
+                             f"{num_rows}x{num_cols}")
+        super().__init__(name, (num_rows, num_cols), dtype, updater=updater,
+                         mesh=mesh, init_value=init_value,
+                         default_option=default_option)
+        # scratch row: guaranteed > logical rows (base padding reserves it)
+        self._scratch_row = self.padded_shape[0] - 1
+        assert self._scratch_row >= self.logical_shape[0], \
+            "scratch row must live in the padded area"
+        self._build_jits()
+
+    # base class hook: reserve at least one padding row for scatter scratch
+    def _pad_lead(self, lead: int, shards: int) -> int:
+        return -(-(lead + 1) // shards) * shards
+
+    @property
+    def num_rows(self) -> int:
+        return self.logical_shape[0]
+
+    @property
+    def num_cols(self) -> int:
+        return self.logical_shape[1]
+
+    # -- jitted kernels ----------------------------------------------------
+
+    def _build_jits(self) -> None:
+        replicated = NamedSharding(self.mesh, P(None, None))
+
+        @partial(jax.jit, out_shardings=replicated)
+        def gather_rows(param, ids):
+            return jnp.take(param, ids, axis=0)
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def scatter_add(param, ids, deltas):
+            return param.at[ids].add(deltas.astype(param.dtype))
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def gather_apply_scatter(param, state, ids, deltas, mask, option):
+            rows = jnp.take(param, ids, axis=0)
+            st_rows = jax.tree.map(lambda s: jnp.take(s, ids, axis=0), state)
+            new_rows, new_st = self.updater.apply(rows, st_rows, deltas,
+                                                  option)
+            m = mask[:, None]
+            new_rows = jnp.where(m, new_rows, rows)
+            param = param.at[ids].set(new_rows.astype(param.dtype))
+            state = jax.tree.map(
+                lambda s, ns, olds: s.at[ids].set(
+                    jnp.where(m, ns, olds).astype(s.dtype)),
+                state, new_st, st_rows)
+            return param, state
+
+        self._gather_rows = gather_rows
+        self._scatter_add = scatter_add
+        self._gather_apply_scatter = gather_apply_scatter
+
+    def _pad_ids(self, ids: np.ndarray,
+                 deltas: Optional[np.ndarray] = None):
+        n = len(ids)
+        b = _bucket(n)
+        out_ids = np.full(b, self._scratch_row, dtype=np.int32)
+        out_ids[:n] = ids
+        mask = np.zeros(b, dtype=bool)
+        mask[:n] = True
+        if deltas is None:
+            return out_ids, mask, n
+        out_d = np.zeros((b, self.num_cols), dtype=deltas.dtype)
+        out_d[:n] = deltas
+        return out_ids, mask, n, out_d
+
+    # -- row API -----------------------------------------------------------
+
+    def get_rows(self, row_ids) -> np.ndarray:
+        """Fetch a list of rows (``MatrixWorkerTable::Get(row_ids, ...)``)."""
+        ids = np.asarray(row_ids, dtype=np.int32)
+        self._check_ids(ids)
+        padded, _, n = self._pad_ids(ids)
+        return np.asarray(self._gather_rows(self.param, padded))[:n]
+
+    def get_rows_async(self, row_ids) -> Handle:
+        ids = np.asarray(row_ids, dtype=np.int32)
+        self._check_ids(ids)
+        padded, _, n = self._pad_ids(ids)
+        return Handle(self._gather_rows(self.param, padded)[:n])
+
+    def add_rows(self, row_ids, deltas, option: Optional[AddOption] = None,
+                 sync: bool = False) -> Handle:
+        """Apply deltas to a row subset (``MatrixWorkerTable::Add(rows)``).
+
+        With the ``default`` updater duplicate row ids accumulate (true
+        scatter-add). Stateful updaters (adagrad/momentum/adam) require
+        unique row ids per call — pre-aggregate duplicates first (the
+        reference's client-side Aggregator role).
+        """
+        ids = np.asarray(row_ids, dtype=np.int32)
+        self._check_ids(ids)
+        deltas = np.asarray(deltas)
+        if deltas.shape != (len(ids), self.num_cols):
+            raise ValueError(f"deltas shape {deltas.shape} != "
+                             f"({len(ids)}, {self.num_cols})")
+        if self.updater.name == "default":
+            padded, _, _, pd = self._pad_ids(ids, deltas)
+            self.param = self._scatter_add(self.param, padded, pd)
+        elif self.updater.name == "sgd":
+            # stateless: scatter-add of -lr*delta, duplicate-safe
+            padded, _, _, pd = self._pad_ids(ids, deltas)
+            lr = float(option.learning_rate if option is not None
+                       else self.default_option.learning_rate)
+            self.param = self._scatter_add(self.param, padded, -lr * pd)
+        else:
+            if len(np.unique(ids)) != len(ids):
+                raise ValueError(
+                    f"add_rows with stateful updater "
+                    f"{self.updater.name!r} requires unique row ids; "
+                    "pre-aggregate duplicates (Aggregator role)")
+            opt = self._resolve_option(option)
+            padded, mask, _, pd = self._pad_ids(ids, deltas)
+            self.param, self.state = self._gather_apply_scatter(
+                self.param, self.state, padded, pd, mask, opt)
+        self._bump_step()
+        handle = Handle(self.param)
+        if sync:
+            handle.wait()
+        return handle
+
+    def _check_ids(self, ids: np.ndarray) -> None:
+        if len(ids) == 0:
+            raise ValueError("empty row id list")
+        if ids.min() < 0 or ids.max() >= self.num_rows:
+            raise ValueError(f"row ids out of range [0, {self.num_rows}): "
+                             f"min={ids.min()} max={ids.max()}")

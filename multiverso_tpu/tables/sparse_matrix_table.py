@@ -1,0 +1,102 @@
+"""SparseMatrixTable: matrix table with COO sparse Add and sparse-row Get.
+
+Reference: `include/multiverso/table/sparse_matrix_table.h` (upstream
+layout; SURVEY.md §3.3) — a matrix table variant where Add carries
+(row, col, value) sparse deltas and Get returns only requested rows;
+LightLDA's word-topic count store.
+
+TPU design (SURVEY.md §3.9): storage stays DENSE and row-sharded (TPU HBM
+is fine with dense counts; vocab×topics fits comfortably), and the sparse
+COO Add becomes a jitted duplicate-safe ``.at[rows, cols].add(values)``
+scatter — XLA lowers this to a sorted segment scatter on TPU. COO batch
+lengths are bucketed to powers of two; padded lanes scatter zeros into a
+reserved scratch row.
+
+Sparse adds are supported for the stateless updaters (``default`` — the
+LightLDA count case — and ``sgd``). Stateful updaters would need
+per-element state touched only at COO positions; the reference never uses
+them with sparse tables either.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from multiverso_tpu.tables.base import Handle
+from multiverso_tpu.tables.matrix_table import MatrixTable, _bucket
+from multiverso_tpu.updaters import AddOption
+
+
+@dataclasses.dataclass
+class SparseMatrixTableOption:
+    num_rows: int
+    num_cols: int
+    dtype: Any = "float32"
+    init_value: Any = 0
+    updater: Optional[str] = None
+    name: str = "sparse_matrix_table"
+
+
+class SparseMatrixTable(MatrixTable):
+    def __init__(self, num_rows: int, num_cols: int,
+                 dtype: Any = "float32", *, init_value: Any = 0,
+                 updater: Optional[str] = None, mesh=None,
+                 name: str = "sparse_matrix_table",
+                 default_option: Optional[AddOption] = None) -> None:
+        super().__init__(num_rows, num_cols, dtype, init_value=init_value,
+                         updater=updater, mesh=mesh, name=name,
+                         default_option=default_option)
+        if self.updater.name not in ("default", "sgd"):
+            raise ValueError(
+                f"SparseMatrixTable supports stateless updaters "
+                f"(default, sgd), got {self.updater.name!r}")
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def coo_scatter_add(param, rows, cols, vals):
+            return param.at[rows, cols].add(vals.astype(param.dtype))
+
+        self._coo_scatter_add = coo_scatter_add
+
+    def add_sparse(self, rows, cols, values,
+                   option: Optional[AddOption] = None,
+                   sync: bool = False) -> Handle:
+        """COO sparse Add: ``param[rows[i], cols[i]] += values[i]``.
+
+        Duplicate (row, col) pairs accumulate. With the ``sgd`` updater the
+        values are treated as gradients: ``param -= lr * values``.
+        """
+        rows = np.asarray(rows, dtype=np.int32)
+        cols = np.asarray(cols, dtype=np.int32)
+        values = np.asarray(values)
+        if not (rows.shape == cols.shape == values.shape) or rows.ndim != 1:
+            raise ValueError(
+                f"COO arrays must be same-length 1-D, got rows={rows.shape} "
+                f"cols={cols.shape} values={values.shape}")
+        if len(rows) == 0:
+            raise ValueError("empty COO add")
+        self._check_ids(rows)
+        if cols.min() < 0 or cols.max() >= self.num_cols:
+            raise ValueError(f"col ids out of range [0, {self.num_cols})")
+
+        n = len(rows)
+        b = _bucket(n)
+        prows = np.full(b, self._scratch_row, dtype=np.int32)
+        pcols = np.zeros(b, dtype=np.int32)
+        pvals = np.zeros(b, dtype=values.dtype)
+        prows[:n], pcols[:n], pvals[:n] = rows, cols, values
+        if self.updater.name == "sgd":
+            lr = float(option.learning_rate if option is not None
+                       else self.default_option.learning_rate)
+            pvals = -lr * pvals
+        self.param = self._coo_scatter_add(self.param, prows, pcols, pvals)
+        self._bump_step()
+        handle = Handle(self.param)
+        if sync:
+            handle.wait()
+        return handle

@@ -1,0 +1,34 @@
+"""Server-side updater stack, compiled as on-device optimizer steps.
+
+TPU-native equivalent of the reference updater layer (upstream layout
+`include/multiverso/updater/{updater,sgd_updater,adagrad_updater,
+momentum_updater}.h`, `src/updater.cpp` — SURVEY.md §3.4): the reference
+selects an updater by the ``updater_type`` flag and calls
+``Update(n, data, delta, AddOption*, offset)`` element-block-wise inside
+``ServerTable::ProcessAdd``, with updater state living server-side, sized
+like the table.
+
+Here each updater is a pure function ``(param, state, delta, option) ->
+(param, state)`` traced into the table's jitted ``add`` step; state is
+created with ``init_state(param)`` via ``zeros_like`` so it inherits the
+param's ``NamedSharding`` — optimizer state sharded like params, the
+idiomatic TPU form of "state lives on the server shard".
+
+Updater semantics (matching the reference's):
+
+- ``default`` — plain additive merge: ``param += delta`` (the PS Add verb;
+  delta is a value-difference, not a gradient).
+- ``sgd``     — ``param -= lr * delta`` (delta is a gradient).
+- ``adagrad`` — per-element squared-gradient accumulator ``h += delta**2``;
+  ``param -= lr * delta / (sqrt(h) + eps)``.
+- ``momentum``— velocity ``v = mu * v + delta``; ``param -= lr * v``.
+- ``adam``    — extension beyond the reference set (not in upstream
+  Multiverso; provided because modern workloads expect it).
+"""
+
+from multiverso_tpu.updaters.updaters import (AddOption, Updater,
+                                              get_updater, register_updater,
+                                              updater_names)
+
+__all__ = ["AddOption", "Updater", "get_updater", "register_updater",
+           "updater_names"]

@@ -1,0 +1,157 @@
+"""Updater implementations. See package docstring for semantics and the
+reference mapping (SURVEY.md §3.4)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class AddOption:
+    """Per-Add hyperparameters, the reference's ``AddOption`` struct
+    (upstream `include/multiverso/table_interface.h`; SURVEY.md §3.3).
+
+    Registered as a pytree of scalar leaves so changing a value (lr decay
+    schedules etc.) does NOT retrigger XLA compilation — the values are
+    traced operands, not static attributes.
+    """
+    learning_rate: float = 0.1
+    momentum: float = 0.9
+    rho: float = 0.999          # second-moment decay (adam)
+    lam: float = 1e-8           # epsilon / regularization knob
+    step: int = 0               # global step counter (adam bias correction)
+
+    def as_jax(self) -> "AddOption":
+        return AddOption(
+            learning_rate=jnp.asarray(self.learning_rate, jnp.float32),
+            momentum=jnp.asarray(self.momentum, jnp.float32),
+            rho=jnp.asarray(self.rho, jnp.float32),
+            lam=jnp.asarray(self.lam, jnp.float32),
+            step=jnp.asarray(self.step, jnp.int32),
+        )
+
+
+Param = Any    # jax array or pytree of arrays (one table shard)
+State = Any    # pytree of arrays shaped/sharded like Param
+
+
+@dataclasses.dataclass(frozen=True)
+class Updater:
+    """A named pair of pure functions: state init + apply."""
+    name: str
+    init_state: Callable[[Param], State]
+    apply: Callable[[Param, State, Param, AddOption], Tuple[Param, State]]
+
+
+def _no_state(param: Param) -> State:
+    return ()
+
+
+def _default_apply(param, state, delta, option):
+    new = jax.tree.map(lambda p, d: p + d.astype(p.dtype), param, delta)
+    return new, state
+
+
+def _sgd_apply(param, state, delta, option):
+    lr = option.learning_rate
+    new = jax.tree.map(lambda p, d: p - (lr * d).astype(p.dtype),
+                       param, delta)
+    return new, state
+
+
+def _adagrad_init(param: Param) -> State:
+    return jax.tree.map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), param)
+
+
+def _adagrad_apply(param, state, delta, option):
+    lr, eps = option.learning_rate, option.lam
+
+    def upd(p, h, d):
+        d32 = d.astype(jnp.float32)
+        h = h + d32 * d32
+        return (p - (lr * d32 / (jnp.sqrt(h) + eps)).astype(p.dtype), h)
+
+    flat = jax.tree.map(upd, param, state, delta)
+    new_param = jax.tree.map(lambda t: t[0], flat,
+                             is_leaf=lambda t: isinstance(t, tuple))
+    new_state = jax.tree.map(lambda t: t[1], flat,
+                             is_leaf=lambda t: isinstance(t, tuple))
+    return new_param, new_state
+
+
+def _momentum_init(param: Param) -> State:
+    return jax.tree.map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), param)
+
+
+def _momentum_apply(param, state, delta, option):
+    lr, mu = option.learning_rate, option.momentum
+
+    def upd(p, v, d):
+        v = mu * v + d.astype(jnp.float32)
+        return (p - (lr * v).astype(p.dtype), v)
+
+    flat = jax.tree.map(upd, param, state, delta)
+    new_param = jax.tree.map(lambda t: t[0], flat,
+                             is_leaf=lambda t: isinstance(t, tuple))
+    new_state = jax.tree.map(lambda t: t[1], flat,
+                             is_leaf=lambda t: isinstance(t, tuple))
+    return new_param, new_state
+
+
+def _adam_init(param: Param) -> State:
+    zeros = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
+    return {"m": jax.tree.map(zeros, param), "v": jax.tree.map(zeros, param)}
+
+
+def _adam_apply(param, state, delta, option):
+    lr, b1, b2, eps = (option.learning_rate, option.momentum, option.rho,
+                       option.lam)
+    t = option.step.astype(jnp.float32) + 1.0
+
+    def upd(p, m, v, d):
+        d32 = d.astype(jnp.float32)
+        m = b1 * m + (1.0 - b1) * d32
+        v = b2 * v + (1.0 - b2) * d32 * d32
+        mhat = m / (1.0 - b1 ** t)
+        vhat = v / (1.0 - b2 ** t)
+        return (p - (lr * mhat / (jnp.sqrt(vhat) + eps)).astype(p.dtype),
+                m, v)
+
+    flat = jax.tree.map(upd, param, state["m"], state["v"], delta)
+    is_tup = lambda x: isinstance(x, tuple)
+    return (jax.tree.map(lambda x: x[0], flat, is_leaf=is_tup),
+            {"m": jax.tree.map(lambda x: x[1], flat, is_leaf=is_tup),
+             "v": jax.tree.map(lambda x: x[2], flat, is_leaf=is_tup)})
+
+
+_REGISTRY: Dict[str, Updater] = {}
+
+
+def register_updater(updater: Updater) -> None:
+    _REGISTRY[updater.name] = updater
+
+
+def get_updater(name: str) -> Updater:
+    """Factory selected by the ``updater_type`` flag, the analog of
+    ``Updater<T>::GetUpdater()`` (upstream `src/updater.cpp`)."""
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"unknown updater_type {name!r}; "
+                         f"valid: {sorted(_REGISTRY)}") from None
+
+
+def updater_names():
+    return sorted(_REGISTRY)
+
+
+register_updater(Updater("default", _no_state, _default_apply))
+register_updater(Updater("sgd", _no_state, _sgd_apply))
+register_updater(Updater("adagrad", _adagrad_init, _adagrad_apply))
+register_updater(Updater("momentum", _momentum_init, _momentum_apply))
+register_updater(Updater("adam", _adam_init, _adam_apply))

@@ -1,0 +1,371 @@
+"""Table layer tests on the 8-virtual-device CPU mesh (SURVEY.md §5:
+'table round-trip property tests (Get∘Add ≡ updater math) on the fake
+mesh')."""
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.tables import (ArrayTable, ArrayTableOption, KVTable,
+                                   KVTableOption, MatrixTable,
+                                   MatrixTableOption, SparseMatrixTable,
+                                   SparseMatrixTableOption, create_table,
+                                   get_table, reset_tables)
+from multiverso_tpu.updaters import AddOption
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    yield
+    reset_tables()
+
+
+class TestArrayTable:
+    def test_get_add_roundtrip(self, mesh8):
+        t = ArrayTable(100, "float32", updater="default")
+        np.testing.assert_array_equal(t.get(), np.zeros(100, np.float32))
+        delta = np.arange(100, dtype=np.float32)
+        t.add(delta, sync=True)
+        t.add(delta)
+        t.wait()
+        np.testing.assert_allclose(t.get(), 2 * delta)
+
+    def test_nondivisible_size_padded(self, mesh8):
+        # 101 not divisible by model axis (2) -> padded internally
+        t = ArrayTable(101, updater="default")
+        assert t.padded_shape[0] % 2 == 0
+        t.add(np.ones(101, np.float32))
+        assert t.get().shape == (101,)
+        np.testing.assert_allclose(t.get(), np.ones(101))
+
+    def test_sgd_updater(self, mesh8):
+        t = ArrayTable(10, updater="sgd", init_value=1.0,
+                       default_option=AddOption(learning_rate=0.5))
+        t.add(np.ones(10, np.float32), sync=True)
+        np.testing.assert_allclose(t.get(), 0.5 * np.ones(10))
+
+    def test_adagrad_state_persists(self, mesh8):
+        t = ArrayTable(8, updater="adagrad",
+                       default_option=AddOption(learning_rate=0.1, lam=1e-8))
+        g = np.ones(8, np.float32)
+        t.add(g, sync=True)
+        t.add(g, sync=True)
+        # numpy oracle
+        p = np.zeros(8, np.float32)
+        h = np.zeros(8, np.float32)
+        for _ in range(2):
+            h += g * g
+            p -= 0.1 * g / (np.sqrt(h) + 1e-8)
+        np.testing.assert_allclose(t.get(), p, rtol=1e-5)
+
+    def test_init_value(self, mesh8):
+        t = ArrayTable(5, init_value=3.5)
+        np.testing.assert_allclose(t.get(), 3.5 * np.ones(5))
+
+    def test_bad_size(self, mesh8):
+        with pytest.raises(ValueError):
+            ArrayTable(0)
+
+    def test_wrong_delta_shape(self, mesh8):
+        t = ArrayTable(5)
+        with pytest.raises(ValueError, match="delta shape|value shape"):
+            t.add(np.ones(7, np.float32))
+
+    def test_async_handles(self, mesh8):
+        t = ArrayTable(16, updater="default")
+        h = t.add_async(np.ones(16, np.float32))
+        h.wait()
+        g = t.get_async()
+        np.testing.assert_allclose(np.asarray(g.result()), np.ones(16))
+
+
+class TestMatrixTable:
+    def test_whole_matrix_roundtrip(self, mesh8):
+        t = MatrixTable(10, 4, updater="default")
+        delta = np.arange(40, dtype=np.float32).reshape(10, 4)
+        t.add(delta, sync=True)
+        np.testing.assert_allclose(t.get(), delta)
+
+    def test_get_rows(self, mesh8):
+        t = MatrixTable(20, 3, updater="default")
+        full = np.random.default_rng(0).standard_normal((20, 3)) \
+            .astype(np.float32)
+        t.add(full, sync=True)
+        ids = [0, 7, 19, 7]
+        np.testing.assert_allclose(t.get_rows(ids), full[ids], rtol=1e-6)
+
+    def test_add_rows_scatter_add_duplicates(self, mesh8):
+        t = MatrixTable(10, 2, updater="default")
+        ids = [3, 3, 5]
+        deltas = np.ones((3, 2), np.float32)
+        t.add_rows(ids, deltas, sync=True)
+        got = t.get()
+        np.testing.assert_allclose(got[3], [2, 2])  # duplicate accumulated
+        np.testing.assert_allclose(got[5], [1, 1])
+        np.testing.assert_allclose(got[0], [0, 0])
+
+    def test_add_rows_sgd(self, mesh8):
+        t = MatrixTable(6, 2, updater="sgd",
+                        default_option=AddOption(learning_rate=0.1))
+        t.add_rows([1], np.ones((1, 2), np.float32), sync=True)
+        np.testing.assert_allclose(t.get()[1], [-0.1, -0.1], rtol=1e-6)
+
+    def test_add_rows_adagrad_touches_only_addressed_rows(self, mesh8):
+        t = MatrixTable(8, 2, updater="adagrad",
+                        default_option=AddOption(learning_rate=0.1,
+                                                 lam=1e-8))
+        g = np.ones((2, 2), np.float32)
+        t.add_rows([2, 5], g, sync=True)
+        got = t.get()
+        # oracle for touched rows
+        h = np.ones(2, np.float32)  # h = g*g = 1
+        want = -0.1 * 1.0 / (np.sqrt(h) + 1e-8)
+        np.testing.assert_allclose(got[2], want, rtol=1e-5)
+        np.testing.assert_allclose(got[5], want, rtol=1e-5)
+        np.testing.assert_allclose(got[0], [0, 0])  # untouched
+
+    def test_add_rows_stateful_duplicate_raises(self, mesh8):
+        t = MatrixTable(8, 2, updater="momentum")
+        with pytest.raises(ValueError, match="unique row ids"):
+            t.add_rows([1, 1], np.ones((2, 2), np.float32))
+
+    def test_row_ids_out_of_range(self, mesh8):
+        t = MatrixTable(8, 2)
+        with pytest.raises(ValueError, match="out of range"):
+            t.get_rows([8])
+        with pytest.raises(ValueError, match="out of range"):
+            t.get_rows([-1])
+
+    def test_bucketing_stable_results(self, mesh8):
+        # different batch sizes cross bucket boundaries
+        t = MatrixTable(64, 2, updater="default")
+        for n in (1, 8, 9, 17):
+            ids = list(range(n))
+            t.add_rows(ids, np.ones((n, 2), np.float32), sync=True)
+        got = t.get()
+        # row 0 got 4 adds, row 8 got 2, row 16 got 1
+        np.testing.assert_allclose(got[0], [4, 4])
+        np.testing.assert_allclose(got[8], [2, 2])
+        np.testing.assert_allclose(got[16], [1, 1])
+        np.testing.assert_allclose(got[63], [0, 0])
+
+
+class TestSparseMatrixTable:
+    def test_coo_add(self, mesh8):
+        t = SparseMatrixTable(10, 6, "float32", updater="default")
+        rows = [0, 0, 9, 5]
+        cols = [1, 1, 5, 0]
+        vals = [1.0, 2.0, 3.0, 4.0]
+        t.add_sparse(rows, cols, vals, sync=True)
+        got = t.get()
+        assert got[0, 1] == 3.0  # duplicates accumulate
+        assert got[9, 5] == 3.0
+        assert got[5, 0] == 4.0
+        assert got.sum() == 10.0
+
+    def test_int_counts(self, mesh8):
+        t = SparseMatrixTable(4, 4, "int32", updater="default")
+        t.add_sparse([1], [1], [5], sync=True)
+        t.add_sparse([1], [1], [-2], sync=True)
+        assert t.get()[1, 1] == 3
+        assert t.get().dtype == np.int32
+
+    def test_stateful_updater_rejected(self, mesh8):
+        with pytest.raises(ValueError, match="stateless"):
+            SparseMatrixTable(4, 4, updater="adagrad")
+
+    def test_coo_bad_shapes(self, mesh8):
+        t = SparseMatrixTable(4, 4)
+        with pytest.raises(ValueError, match="same-length"):
+            t.add_sparse([1, 2], [1], [1.0])
+        with pytest.raises(ValueError, match="col ids"):
+            t.add_sparse([1], [9], [1.0])
+
+    def test_get_rows_inherited(self, mesh8):
+        t = SparseMatrixTable(8, 3, updater="default")
+        t.add_sparse([2], [1], [7.0], sync=True)
+        np.testing.assert_allclose(t.get_rows([2])[0], [0, 7, 0])
+
+
+class TestKVTable:
+    def test_missing_keys_default(self, mesh8):
+        t = KVTable(100, updater="default")
+        vals, found = t.get([1, 2, 3])
+        assert not found.any()
+        np.testing.assert_allclose(vals, 0.0)
+
+    def test_upsert_and_get(self, mesh8):
+        t = KVTable(100, updater="default")
+        keys = [10, 20, 30]
+        t.add(keys, [1.0, 2.0, 3.0], sync=True)
+        vals, found = t.get(keys)
+        assert found.all()
+        np.testing.assert_allclose(vals, [1, 2, 3])
+        t.add(keys, [1.0, 1.0, 1.0], sync=True)
+        vals, _ = t.get(keys)
+        np.testing.assert_allclose(vals, [2, 3, 4])
+        assert len(t) == 3
+
+    def test_vector_values(self, mesh8):
+        t = KVTable(64, value_dim=4, updater="default")
+        t.add([5], np.ones((1, 4), np.float32), sync=True)
+        vals, found = t.get([5, 6])
+        assert found.tolist() == [True, False]
+        np.testing.assert_allclose(vals[0], np.ones(4))
+        np.testing.assert_allclose(vals[1], np.zeros(4))
+
+    def test_sgd_updater(self, mesh8):
+        t = KVTable(64, updater="sgd",
+                    default_option=AddOption(learning_rate=0.5))
+        t.add([7], [1.0], sync=True)
+        vals, _ = t.get([7])
+        np.testing.assert_allclose(vals, [-0.5])
+
+    def test_duplicate_keys_raise(self, mesh8):
+        t = KVTable(64)
+        with pytest.raises(ValueError, match="duplicate"):
+            t.add([1, 1], [1.0, 2.0])
+
+    def test_reserved_sentinel_raises(self, mesh8):
+        t = KVTable(64)
+        with pytest.raises(ValueError, match="sentinel"):
+            t.get([int(0xFFFFFFFFFFFFFFFF)])
+
+    def test_large_key_space(self, mesh8):
+        t = KVTable(256, updater="default")
+        keys = [2**63 + 17, 12345678901234567, 42]
+        t.add(keys, [1.0, 2.0, 3.0], sync=True)
+        vals, found = t.get(keys)
+        assert found.all()
+        np.testing.assert_allclose(vals, [1, 2, 3])
+
+    def test_keys_sharing_low_32_bits_distinct(self, mesh8):
+        # regression: uint64 keys must not be truncated to uint32 on device
+        t = KVTable(64, updater="default")
+        k1, k2 = 42, 42 + (1 << 32)
+        t.add([k1], [5.0], sync=True)
+        t.add([k2], [7.0], sync=True)
+        v1, f1 = t.get([k1])
+        v2, f2 = t.get([k2])
+        assert f1.all() and f2.all()
+        assert v1[0] == 5.0 and v2[0] == 7.0
+
+    def test_low_bits_all_ones_no_phantom_match(self, mesh8):
+        # regression: key with low 32 bits 0xFFFFFFFF must not match the
+        # EMPTY sentinel slots
+        t = KVTable(64, updater="default")
+        vals, found = t.get([0x1FFFFFFFF])
+        assert not found.any()
+        np.testing.assert_allclose(vals, 0.0)
+
+    def test_overflow_raise_leaks_no_slots(self, mesh8):
+        # regression: mid-batch overflow must not desynchronize host mirror
+        t = KVTable(8, slots_per_bucket=1, updater="default")
+        # find many keys mapping to the same bucket
+        b0 = t._buckets_of(np.asarray([1], np.uint64))[0]
+        same_bucket = [k for k in range(1, 5000)
+                       if t._buckets_of(np.asarray([k], np.uint64))[0] == b0]
+        assert len(same_bucket) >= 2
+        k1, k2 = same_bucket[0], same_bucket[1]
+        with pytest.raises(RuntimeError, match="overflow"):
+            t.add([k1, k2], [1.0, 2.0])
+        # nothing applied, nothing leaked
+        assert len(t) == 0
+        _, found = t.get([k1, k2])
+        assert not found.any()
+        # a fitting batch still works
+        t.add([k1], [1.0], sync=True)
+        vals, found = t.get([k1])
+        assert found.all() and vals[0] == 1.0
+
+
+class TestCheckpoint:
+    def test_array_store_load(self, mesh8, tmp_path):
+        t = ArrayTable(50, updater="adagrad",
+                       default_option=AddOption(learning_rate=0.1))
+        t.add(np.ones(50, np.float32), sync=True)
+        uri = f"file://{tmp_path}/array.ckpt"
+        t.store(uri)
+        t2 = ArrayTable(50, updater="adagrad",
+                        default_option=AddOption(learning_rate=0.1))
+        t2.load(uri)
+        np.testing.assert_allclose(t2.get(), t.get())
+        # state restored: another add must continue the adagrad trajectory
+        t.add(np.ones(50, np.float32), sync=True)
+        t2.add(np.ones(50, np.float32), sync=True)
+        np.testing.assert_allclose(t2.get(), t.get(), rtol=1e-6)
+
+    def test_matrix_store_load_plain_path(self, mesh8, tmp_path):
+        t = MatrixTable(6, 3, updater="default")
+        t.add(np.ones((6, 3), np.float32), sync=True)
+        path = str(tmp_path / "m.ckpt")
+        t.store(path)
+        t2 = MatrixTable(6, 3, updater="default")
+        t2.load(path)
+        np.testing.assert_allclose(t2.get(), t.get())
+
+    def test_shape_mismatch_rejected(self, mesh8, tmp_path):
+        t = ArrayTable(10)
+        uri = str(tmp_path / "a.ckpt")
+        t.store(uri)
+        t2 = ArrayTable(11)
+        with pytest.raises(ValueError, match="shape"):
+            t2.load(uri)
+
+    def test_updater_mismatch_rejected(self, mesh8, tmp_path):
+        t = ArrayTable(10, updater="sgd")
+        uri = str(tmp_path / "a.ckpt")
+        t.store(uri)
+        t2 = ArrayTable(10, updater="momentum")
+        with pytest.raises(ValueError, match="updater"):
+            t2.load(uri)
+
+    def test_kv_value_dim_mismatch_rejected(self, mesh8, tmp_path):
+        t = KVTable(64, value_dim=4, updater="default")
+        uri = str(tmp_path / "kv4.ckpt")
+        t.store(uri)
+        t2 = KVTable(64, value_dim=0, updater="default")
+        with pytest.raises(ValueError, match="value_dim"):
+            t2.load(uri)
+
+    def test_get_jax_snapshot_survives_add(self, mesh8):
+        # regression: add() donates the param buffer; get_jax must return a
+        # fresh snapshot, not the live buffer
+        t = ArrayTable(8, updater="default")  # 8 divides shards: no padding
+        snap = t.get_jax()
+        assert snap is not t.param
+        t.add(np.ones(8, np.float32), sync=True)
+        np.testing.assert_allclose(np.asarray(snap), np.zeros(8))
+
+    def test_kv_store_load(self, mesh8, tmp_path):
+        t = KVTable(128, updater="default")
+        t.add([11, 22], [1.5, 2.5], sync=True)
+        uri = str(tmp_path / "kv.ckpt")
+        t.store(uri)
+        t2 = KVTable(128, updater="default")
+        t2.load(uri)
+        vals, found = t2.get([11, 22, 33])
+        assert found.tolist() == [True, True, False]
+        np.testing.assert_allclose(vals[:2], [1.5, 2.5])
+        # further inserts work after load (slot map restored)
+        t2.add([33], [3.5], sync=True)
+        vals, found = t2.get([33])
+        assert found.all()
+
+
+class TestFactory:
+    def test_create_table_dispatch(self, mesh8):
+        a = create_table(ArrayTableOption(size=10))
+        m = create_table(MatrixTableOption(num_rows=4, num_cols=2))
+        s = create_table(SparseMatrixTableOption(num_rows=4, num_cols=2))
+        k = create_table(KVTableOption(capacity=64))
+        assert isinstance(a, ArrayTable)
+        assert isinstance(m, MatrixTable)
+        assert isinstance(s, SparseMatrixTable)
+        assert isinstance(k, KVTable)
+        # table-id registry (reference table ids)
+        assert get_table(a.table_id) is a
+        assert get_table(k.table_id) is k
+
+    def test_unknown_option_type(self, mesh8):
+        with pytest.raises(TypeError):
+            create_table(object())

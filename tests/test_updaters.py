@@ -1,0 +1,131 @@
+"""Updater numeric-parity tests against a NumPy oracle (SURVEY.md §5:
+'numeric parity tests of each Updater against a NumPy oracle')."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from multiverso_tpu.updaters import AddOption, get_updater, updater_names
+
+
+def _rand(shape, seed):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+class TestRegistry:
+    def test_names(self):
+        names = updater_names()
+        for expected in ("default", "sgd", "adagrad", "momentum", "adam"):
+            assert expected in names
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError, match="unknown updater_type"):
+            get_updater("rmsprop")
+
+
+class TestNumpyOracle:
+    """Run 5 steps of each updater in jax and in straight numpy; compare."""
+
+    N = 64
+
+    def _run_jax(self, name, param0, deltas, opt_kwargs):
+        upd = get_updater(name)
+        param = jnp.asarray(param0)
+        state = upd.init_state(param)
+        apply_fn = jax.jit(upd.apply)
+        for step, d in enumerate(deltas):
+            opt = AddOption(step=step, **opt_kwargs).as_jax()
+            param, state = apply_fn(param, state, jnp.asarray(d), opt)
+        return np.asarray(param)
+
+    def test_default(self):
+        p0 = _rand(self.N, 0)
+        deltas = [_rand(self.N, i + 1) for i in range(5)]
+        got = self._run_jax("default", p0, deltas, {})
+        want = p0 + np.sum(deltas, axis=0)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_sgd(self):
+        p0 = _rand(self.N, 0)
+        deltas = [_rand(self.N, i + 1) for i in range(5)]
+        got = self._run_jax("sgd", p0, deltas, {"learning_rate": 0.05})
+        want = p0 - 0.05 * np.sum(deltas, axis=0)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_adagrad(self):
+        p0 = _rand(self.N, 0)
+        deltas = [_rand(self.N, i + 1) for i in range(5)]
+        lr, eps = 0.1, 1e-8
+        got = self._run_jax("adagrad", p0, deltas,
+                            {"learning_rate": lr, "lam": eps})
+        p, h = p0.copy(), np.zeros(self.N, np.float32)
+        for d in deltas:
+            h += d * d
+            p -= lr * d / (np.sqrt(h) + eps)
+        np.testing.assert_allclose(got, p, rtol=1e-5)
+
+    def test_momentum(self):
+        p0 = _rand(self.N, 0)
+        deltas = [_rand(self.N, i + 1) for i in range(5)]
+        lr, mu = 0.1, 0.9
+        got = self._run_jax("momentum", p0, deltas,
+                            {"learning_rate": lr, "momentum": mu})
+        p, v = p0.copy(), np.zeros(self.N, np.float32)
+        for d in deltas:
+            v = mu * v + d
+            p -= lr * v
+        np.testing.assert_allclose(got, p, rtol=1e-5)
+
+    def test_adam(self):
+        p0 = _rand(self.N, 0)
+        deltas = [_rand(self.N, i + 1) for i in range(5)]
+        lr, b1, b2, eps = 1e-3, 0.9, 0.999, 1e-8
+        got = self._run_jax("adam", p0, deltas,
+                            {"learning_rate": lr, "momentum": b1,
+                             "rho": b2, "lam": eps})
+        p = p0.copy()
+        m = np.zeros(self.N, np.float32)
+        v = np.zeros(self.N, np.float32)
+        for t, d in enumerate(deltas, start=1):
+            m = b1 * m + (1 - b1) * d
+            v = b2 * v + (1 - b2) * d * d
+            mhat = m / (1 - b1 ** t)
+            vhat = v / (1 - b2 ** t)
+            p -= lr * mhat / (np.sqrt(vhat) + eps)
+        np.testing.assert_allclose(got, p, rtol=1e-4)
+
+
+class TestJitStability:
+    def test_lr_change_no_retrace(self):
+        """AddOption values are traced operands — changing lr must not
+        retrigger compilation."""
+        upd = get_updater("sgd")
+        traces = []
+
+        @jax.jit
+        def step(p, d, opt):
+            traces.append(1)
+            return upd.apply(p, (), d, opt)[0]
+
+        p = jnp.ones(8)
+        d = jnp.ones(8)
+        step(p, d, AddOption(learning_rate=0.1).as_jax())
+        step(p, d, AddOption(learning_rate=0.01).as_jax())
+        assert len(traces) == 1
+
+    def test_state_matches_param_structure(self):
+        tree = {"a": jnp.ones((4, 4)), "b": jnp.ones(3)}
+        st = get_updater("adagrad").init_state(tree)
+        assert set(st) == {"a", "b"}
+        assert st["a"].shape == (4, 4)
+
+    def test_bfloat16_param_stays_bfloat16(self):
+        upd = get_updater("adagrad")
+        p = jnp.ones(8, dtype=jnp.bfloat16)
+        st = upd.init_state(p)
+        assert st.dtype == jnp.float32  # state kept in f32 for accuracy
+        newp, _ = upd.apply(p, st, jnp.ones(8, jnp.float32),
+                            AddOption().as_jax())
+        assert newp.dtype == jnp.bfloat16
