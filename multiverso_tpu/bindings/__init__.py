@@ -1,0 +1,30 @@
+"""Binding-compat Python API.
+
+Mirrors the reference Python binding surface (upstream layout
+`binding/python/multiverso/{api.py,tables.py}` — SURVEY.md §3.5), so
+training scripts written against the reference's ctypes binding port with
+an import swap::
+
+    import multiverso_tpu.bindings as multiverso
+    multiverso.init(sync=True)
+    tbl = multiverso.ArrayTableHandler(1000, init_value=0.0)
+    tbl.add(delta); vals = tbl.get()
+    multiverso.barrier()
+    multiverso.shutdown()
+
+The reference's C-ABI/ctypes hop does not exist: handlers sit directly on
+the sharded-array tables. The delta-sync data-parallel wrapper
+(`theano_ext.sharedvar.mv_shared` / `lasagne_ext.param_manager`) has its
+JAX analog in :mod:`multiverso_tpu.bindings.jax_ext`.
+"""
+
+from multiverso_tpu.bindings.api import (barrier, init, is_master_worker,
+                                         server_id, shutdown, workers_num,
+                                         worker_id)
+from multiverso_tpu.bindings.table_handlers import (ArrayTableHandler,
+                                                    MatrixTableHandler)
+from multiverso_tpu.bindings import jax_ext
+
+__all__ = ["ArrayTableHandler", "MatrixTableHandler", "barrier", "init",
+           "is_master_worker", "jax_ext", "server_id", "shutdown",
+           "worker_id", "workers_num"]

@@ -1,0 +1,44 @@
+"""Reference `multiverso/api.py` surface (SURVEY.md §3.5): init/shutdown/
+barrier and topology queries, names preserved."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from multiverso_tpu import core
+from multiverso_tpu.utils import configure
+
+
+def init(sync: bool = True, argv: Optional[Sequence[str]] = None) -> None:
+    """Reference: ``multiverso.init(sync=...)``. On TPU sync DP is the
+    native mode; ``sync=False`` is accepted for script compat and recorded
+    in the ``sync`` flag (async PS semantics are subsumed by sync DP —
+    SURVEY.md §3.8)."""
+    configure.set_flag("sync", bool(sync))
+    core.init(argv)
+
+
+def shutdown() -> None:
+    core.shutdown()
+
+
+def barrier() -> None:
+    core.barrier()
+
+
+def workers_num() -> int:
+    return core.num_workers()
+
+
+def worker_id() -> int:
+    return core.worker_id()
+
+
+def server_id() -> int:
+    return core.server_id()
+
+
+def is_master_worker() -> bool:
+    """Reference semantics: exactly one worker is 'master' (does data
+    splitting / logging). Process 0 of the job."""
+    return core.rank() == 0

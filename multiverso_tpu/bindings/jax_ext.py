@@ -1,0 +1,119 @@
+"""JAX analog of the reference's framework extensions.
+
+Reference mapping (upstream layout `binding/python/multiverso/theano_ext/
+sharedvar.py` and `.../lasagne_ext/param_manager.py` — SURVEY.md §3.5 /
+§4.4):
+
+- ``mv_shared`` was a drop-in for ``theano.shared`` that tracks the
+  last-synced snapshot; ``sync()`` ships ``add(current − last_synced)``
+  then ``get()``s the merged value back. Workers never overwrite each
+  other — they ship *differences*, so concurrent updates merge additively.
+  :class:`MVSharedVariable` keeps exactly that delta-sync contract over a
+  host-mirrored value.
+- ``LasagneParamManager`` registered all params of a network into one
+  table with a per-iteration ``sync_all_param()``. :class:`ParamManager`
+  does the same for an arbitrary pytree of arrays (flax/haiku params,
+  plain dicts) flattened into one ArrayTable.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from multiverso_tpu.bindings.table_handlers import ArrayTableHandler
+
+_ALL_SHARED: List["MVSharedVariable"] = []
+_ALL_LOCK = threading.Lock()
+
+
+class MVSharedVariable:
+    """Delta-synced shared value backed by an ArrayTable."""
+
+    def __init__(self, value, name: str = "mv_shared") -> None:
+        self._value = np.array(value, dtype=np.float32, copy=True)
+        self._shape = self._value.shape
+        self._table = ArrayTableHandler(int(self._value.size) or 1,
+                                        name=name)
+        # publish the initial value once: add(initial - 0)
+        self._table.add(self._value.ravel(), sync=True)
+        self._last_synced = self._table.get().reshape(self._shape).copy()
+        self._value = self._last_synced.copy()
+        with _ALL_LOCK:
+            _ALL_SHARED.append(self)
+
+    def get_value(self) -> np.ndarray:
+        return self._value.copy()
+
+    def set_value(self, value) -> None:
+        value = np.asarray(value, dtype=np.float32)
+        if value.shape != self._shape:
+            raise ValueError(f"shape {value.shape} != {self._shape}")
+        self._value = value.copy()
+
+    def sync(self) -> None:
+        """add(current − last_synced); get() the merged value back."""
+        delta = self._value - self._last_synced
+        self._table.add(delta.ravel(), sync=True)
+        merged = self._table.get().reshape(self._shape)
+        self._value = merged.copy()
+        self._last_synced = merged.copy()
+
+
+def mv_shared(value, name: str = "mv_shared") -> MVSharedVariable:
+    return MVSharedVariable(value, name=name)
+
+
+def sync_all_mv_shared_vars() -> None:
+    """Reference: ``sharedvar.sync_all_mv_shared_vars()``."""
+    with _ALL_LOCK:
+        shared = list(_ALL_SHARED)
+    for var in shared:
+        var.sync()
+
+
+def reset_shared_vars() -> None:
+    with _ALL_LOCK:
+        _ALL_SHARED.clear()
+
+
+class ParamManager:
+    """Register a pytree of params into one table; ``sync_all_param()``
+    per iteration/epoch (reference ``LasagneParamManager``)."""
+
+    def __init__(self, params: Any, name: str = "param_manager") -> None:
+        leaves, self._treedef = jax.tree.flatten(params)
+        self._shapes = [np.shape(l) for l in leaves]
+        self._sizes = [int(np.size(l)) for l in leaves]
+        self._total = sum(self._sizes)
+        self._table = ArrayTableHandler(self._total, name=name)
+        flat = np.concatenate(
+            [np.asarray(l, dtype=np.float32).ravel() for l in leaves]) \
+            if leaves else np.zeros(0, np.float32)
+        self._table.add(flat, sync=True)
+        self._last_synced = self._table.get().copy()
+
+    def _flatten(self, params: Any) -> np.ndarray:
+        leaves = jax.tree.leaves(params)
+        if len(leaves) != len(self._sizes):
+            raise ValueError("param tree structure changed since init")
+        return np.concatenate(
+            [np.asarray(l, dtype=np.float32).ravel() for l in leaves])
+
+    def _unflatten(self, flat: np.ndarray) -> Any:
+        out, off = [], 0
+        for shape, size in zip(self._shapes, self._sizes):
+            out.append(flat[off:off + size].reshape(shape))
+            off += size
+        return jax.tree.unflatten(self._treedef, out)
+
+    def sync_all_param(self, params: Any) -> Any:
+        """Delta-sync the whole tree; returns the merged tree."""
+        flat = self._flatten(params)
+        self._table.add(flat - self._last_synced, sync=True)
+        merged = self._table.get()
+        self._last_synced = merged.copy()
+        return self._unflatten(merged)

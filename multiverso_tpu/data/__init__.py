@@ -1,0 +1,11 @@
+"""Data pipeline (SURVEY.md §3.6 / §8 step 6): corpus + vocab building,
+Huffman coding, skip-gram/CBOW example generation, LDA doc blocks —
+native C++ backend with Python fallback — and prefetching iterators."""
+
+from multiverso_tpu.data.corpus import (Corpus, backend, synthetic_docs,
+                                        synthetic_text)
+from multiverso_tpu.data.native import CorpusData, NativeData, load_native
+from multiverso_tpu.data.pydata import PyData
+
+__all__ = ["Corpus", "CorpusData", "NativeData", "PyData", "backend",
+           "load_native", "synthetic_docs", "synthetic_text"]

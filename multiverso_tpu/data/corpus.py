@@ -1,0 +1,161 @@
+"""Corpus-level helpers: vocabulary, subsampling, negative-sampling
+distribution, Huffman codes, and batch iterators.
+
+Reference mapping (SURVEY.md §3.6): `Dictionary` + `Reader` +
+`HuffmanEncoder` of Applications/WordEmbedding, and the data-block
+pipeline (`DataBlock`, `ASyncBuffer` prefetch — SURVEY.md §4.5). The
+backend (native C++ or Python fallback) is selected automatically.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from multiverso_tpu.data.native import CorpusData, load_native
+from multiverso_tpu.data.pydata import PyData
+from multiverso_tpu.utils import log
+from multiverso_tpu.utils.async_buffer import prefetch_iterator
+
+
+def backend():
+    """The active data backend: native if loadable, else Python."""
+    native = load_native()
+    return native if native is not None else PyData()
+
+
+class Corpus:
+    """An encoded corpus + vocab with word2vec-style accessors."""
+
+    def __init__(self, data: CorpusData, subsample: float = 1e-3) -> None:
+        self.data = data
+        self.subsample = subsample
+        self._keep_prob: Optional[np.ndarray] = None
+        self._unigram: Optional[np.ndarray] = None
+
+    @classmethod
+    def from_file(cls, path: str, min_count: int = 5,
+                  subsample: float = 1e-3) -> "Corpus":
+        return cls(backend().build_corpus(path, min_count),
+                   subsample=subsample)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.data.words)
+
+    @property
+    def num_tokens(self) -> int:
+        return len(self.data.ids)
+
+    @property
+    def words(self):
+        return self.data.words
+
+    @property
+    def counts(self) -> np.ndarray:
+        return self.data.counts
+
+    @property
+    def ids(self) -> np.ndarray:
+        return self.data.ids
+
+    def keep_prob(self) -> Optional[np.ndarray]:
+        """word2vec subsampling keep-probability per word id:
+        ``min(1, sqrt(t/f) + t/f)`` with f the corpus frequency fraction."""
+        if self.subsample <= 0:
+            return None
+        if self._keep_prob is None:
+            total = max(self.counts.sum(), 1)
+            f = self.counts / total
+            with np.errstate(divide="ignore"):
+                kp = np.sqrt(self.subsample / f) + self.subsample / f
+            self._keep_prob = np.minimum(kp, 1.0).astype(np.float32)
+        return self._keep_prob
+
+    def unigram_probs(self, power: float = 0.75) -> np.ndarray:
+        """Negative-sampling distribution ∝ count^0.75 (word2vec)."""
+        if self._unigram is None:
+            p = self.counts.astype(np.float64) ** power
+            self._unigram = (p / p.sum()).astype(np.float32)
+        return self._unigram
+
+    def huffman(self, max_len: int = 64):
+        """(codes int8 [V, L], points int32 [V, L], lengths int32 [V])."""
+        return backend().huffman(self.counts, max_len)
+
+    # -- batch iterators ---------------------------------------------------
+
+    def skipgram_batches(self, batch_size: int, window: int = 5,
+                         seed: int = 1, epochs: int = 1,
+                         block_tokens: int = 1 << 20,
+                         prefetch: int = 2
+                         ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Yield fixed-size (centers, contexts) int32 batches.
+
+        The corpus is cut into blocks (the reference's DataBlock); pair
+        generation per block runs on the backend and is prefetched on a
+        background thread (ASyncBuffer role) while the previous batch
+        trains. Trailing pairs that don't fill a batch are dropped (static
+        shapes for jit).
+        """
+
+        def gen():
+            be = backend()
+            kp = self.keep_prob()
+            leftover_c = np.empty(0, np.int32)
+            leftover_x = np.empty(0, np.int32)
+            for epoch in range(epochs):
+                for start in range(0, self.num_tokens, block_tokens):
+                    block = self.ids[start:start + block_tokens]
+                    c, x = be.skipgram_pairs(
+                        block, window, kp,
+                        seed=seed + 0x9E3779B9 * (epoch + 1) + start)
+                    c = np.concatenate([leftover_c, c])
+                    x = np.concatenate([leftover_x, x])
+                    n_full = (len(c) // batch_size) * batch_size
+                    for i in range(0, n_full, batch_size):
+                        yield c[i:i + batch_size], x[i:i + batch_size]
+                    leftover_c, leftover_x = c[n_full:], x[n_full:]
+
+        return prefetch_iterator(gen(), depth=prefetch)
+
+
+def synthetic_text(path: str, num_tokens: int = 200_000,
+                   vocab_size: int = 2_000, seed: int = 0,
+                   zipf_a: float = 1.2) -> None:
+    """Write a synthetic Zipf-distributed corpus (no-network stand-in for
+    text8; the benchmark metric is throughput, which depends on shapes,
+    not on the tokens being English)."""
+    rng = np.random.default_rng(seed)
+    ranks = rng.zipf(zipf_a, size=num_tokens)
+    ranks = np.clip(ranks, 1, vocab_size)
+    with open(path, "w") as f:
+        line = []
+        for r in ranks:
+            line.append(f"w{r}")
+            if len(line) == 1000:
+                f.write(" ".join(line) + "\n")
+                line = []
+        if line:
+            f.write(" ".join(line) + "\n")
+
+
+def synthetic_docs(path: str, num_docs: int = 1000, vocab_size: int = 2000,
+                   avg_doc_len: int = 64, num_topics: int = 20,
+                   seed: int = 0) -> None:
+    """Write synthetic LDA docs in 'word:count' bag-of-words format with a
+    planted topic structure (so inference has something to find)."""
+    rng = np.random.default_rng(seed)
+    # planted topics: each topic is a dirichlet over a vocab slice
+    topic_word = rng.dirichlet(np.full(vocab_size, 0.05), size=num_topics)
+    with open(path, "w") as f:
+        for _ in range(num_docs):
+            theta = rng.dirichlet(np.full(num_topics, 0.1))
+            length = max(1, rng.poisson(avg_doc_len))
+            topics = rng.choice(num_topics, size=length, p=theta)
+            words = np.array([rng.choice(vocab_size, p=topic_word[t])
+                              for t in topics])
+            uniq, cnts = np.unique(words, return_counts=True)
+            f.write(" ".join(f"{w}:{c}" for w, c in zip(uniq, cnts)) + "\n")

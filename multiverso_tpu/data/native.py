@@ -1,0 +1,240 @@
+"""ctypes binding to libmvtpu_data.so (native/mvtpu_data.cpp).
+
+The reference's data stack is C++ (SURVEY.md §3.6: word2vec
+Dictionary/Reader/HuffmanEncoder, LightLDA DataBlock streaming); this is
+its TPU-build equivalent — the host-side pipeline must outrun the chips.
+No pybind11 in this image, so the ABI is flat C consumed via ctypes
+(SURVEY.md §3.5's C-ABI role, repurposed for the data plane).
+
+``load_native()`` finds (or builds, if a toolchain is present) the shared
+library and returns a :class:`NativeData`; returns ``None`` when
+unavailable, in which case callers fall back to
+:mod:`multiverso_tpu.data.pydata`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from multiverso_tpu.utils import log
+
+ABI_VERSION = 4
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SO_PATH = os.path.join(_REPO_ROOT, "native", "build", "libmvtpu_data.so")
+
+
+@dataclass
+class CorpusData:
+    words: List[str]
+    counts: np.ndarray        # (vocab,) int64
+    ids: np.ndarray           # (tokens,) int32
+    total_raw_tokens: int
+
+
+class NativeData:
+    def __init__(self, lib: ctypes.CDLL) -> None:
+        self._lib = lib
+        lib.mv_corpus_build.restype = ctypes.c_uint64
+        lib.mv_corpus_build.argtypes = [ctypes.c_char_p, ctypes.c_int32]
+        lib.mv_corpus_vocab_size.restype = ctypes.c_int32
+        lib.mv_corpus_vocab_size.argtypes = [ctypes.c_uint64]
+        lib.mv_corpus_num_tokens.restype = ctypes.c_int64
+        lib.mv_corpus_num_tokens.argtypes = [ctypes.c_uint64]
+        lib.mv_corpus_total_raw_tokens.restype = ctypes.c_int64
+        lib.mv_corpus_total_raw_tokens.argtypes = [ctypes.c_uint64]
+        lib.mv_corpus_counts.restype = ctypes.c_int32
+        lib.mv_corpus_counts.argtypes = [
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_int64), ctypes.c_int32]
+        lib.mv_corpus_ids.restype = ctypes.c_int64
+        lib.mv_corpus_ids.argtypes = [
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
+        lib.mv_corpus_word.restype = ctypes.c_char_p
+        lib.mv_corpus_word.argtypes = [ctypes.c_uint64, ctypes.c_int32]
+        lib.mv_corpus_free.restype = None
+        lib.mv_corpus_free.argtypes = [ctypes.c_uint64]
+        lib.mv_huffman_build.restype = ctypes.c_int32
+        lib.mv_huffman_build.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int8), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.mv_skipgram_pairs.restype = ctypes.c_int64
+        lib.mv_skipgram_pairs.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64]
+        lib.mv_cbow_examples.restype = ctypes.c_int64
+        lib.mv_cbow_examples.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64]
+        lib.mv_lda_read_docs.restype = ctypes.c_int64
+        lib.mv_lda_read_docs.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64, ctypes.c_int64]
+
+    # -- corpus ------------------------------------------------------------
+
+    def build_corpus(self, path: str, min_count: int = 5) -> CorpusData:
+        handle = self._lib.mv_corpus_build(path.encode(), min_count)
+        if handle == 0:
+            raise FileNotFoundError(f"cannot read corpus file {path!r}")
+        try:
+            vocab = self._lib.mv_corpus_vocab_size(handle)
+            ntok = self._lib.mv_corpus_num_tokens(handle)
+            counts = np.empty(vocab, np.int64)
+            ids = np.empty(ntok, np.int32)
+            if vocab and self._lib.mv_corpus_counts(
+                    handle, counts.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_int64)), vocab) < 0:
+                raise RuntimeError("mv_corpus_counts failed")
+            if ntok and self._lib.mv_corpus_ids(
+                    handle, ids.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_int32)), ntok) < 0:
+                raise RuntimeError("mv_corpus_ids failed")
+            words = [self._lib.mv_corpus_word(handle, i).decode()
+                     for i in range(vocab)]
+            raw = self._lib.mv_corpus_total_raw_tokens(handle)
+        finally:
+            self._lib.mv_corpus_free(handle)
+        return CorpusData(words, counts, ids, raw)
+
+    # -- huffman -----------------------------------------------------------
+
+    def huffman(self, counts: np.ndarray, max_len: int = 64
+                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        counts = np.ascontiguousarray(counts, np.int64)
+        vocab = len(counts)
+        codes = np.empty((vocab, max_len), np.int8)
+        points = np.empty((vocab, max_len), np.int32)
+        lengths = np.empty(vocab, np.int32)
+        used = self._lib.mv_huffman_build(
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), vocab,
+            max_len, codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+            points.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if used < 0:
+            raise ValueError(f"huffman code exceeded max_len={max_len}")
+        return codes, points, lengths
+
+    # -- training examples -------------------------------------------------
+
+    def skipgram_pairs(self, ids: np.ndarray, window: int,
+                       keep_prob: Optional[np.ndarray], seed: int,
+                       cap: Optional[int] = None
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+        ids = np.ascontiguousarray(ids, np.int32)
+        if cap is None:
+            cap = 2 * window * len(ids) + 16
+        centers = np.empty(cap, np.int32)
+        contexts = np.empty(cap, np.int32)
+        kp = None
+        if keep_prob is not None:
+            keep_prob = np.ascontiguousarray(keep_prob, np.float32)
+            kp = keep_prob.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        n = self._lib.mv_skipgram_pairs(
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(ids),
+            window, kp, seed,
+            centers.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            contexts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), cap)
+        return centers[:n].copy(), contexts[:n].copy()
+
+    def cbow_examples(self, ids: np.ndarray, window: int,
+                      keep_prob: Optional[np.ndarray], seed: int,
+                      cap: Optional[int] = None
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+        ids = np.ascontiguousarray(ids, np.int32)
+        if cap is None:
+            cap = len(ids) + 16
+        width = 2 * window
+        contexts = np.empty((cap, width), np.int32)
+        targets = np.empty(cap, np.int32)
+        kp = None
+        if keep_prob is not None:
+            keep_prob = np.ascontiguousarray(keep_prob, np.float32)
+            kp = keep_prob.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        n = self._lib.mv_cbow_examples(
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(ids),
+            window, kp, seed,
+            contexts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            targets.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), cap)
+        return contexts[:n].copy(), targets[:n].copy()
+
+    # -- LDA ---------------------------------------------------------------
+
+    def lda_read_docs(self, path: str
+                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Returns CSR (doc_offsets[int64 D+1], word_ids, word_counts)."""
+        ndocs = ctypes.c_int64()
+        nnz = ctypes.c_int64()
+        rc = self._lib.mv_lda_read_docs(
+            path.encode(), ctypes.byref(ndocs), ctypes.byref(nnz),
+            None, None, None, 0, 0)
+        if rc != 0:
+            raise FileNotFoundError(f"cannot read docs file {path!r}")
+        offsets = np.empty(ndocs.value + 1, np.int64)
+        word_ids = np.empty(max(nnz.value, 1), np.int32)
+        word_counts = np.empty(max(nnz.value, 1), np.int32)
+        rc = self._lib.mv_lda_read_docs(
+            path.encode(), ctypes.byref(ndocs), ctypes.byref(nnz),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            word_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            word_counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ndocs.value, max(nnz.value, 1))
+        if rc != 0:
+            raise RuntimeError(f"lda_read_docs second pass failed: {path!r}")
+        return offsets, word_ids[:nnz.value], word_counts[:nnz.value]
+
+
+_CACHED: Optional[NativeData] = None
+_TRIED = False
+
+
+def load_native(rebuild: bool = False) -> Optional[NativeData]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _CACHED, _TRIED
+    if _CACHED is not None and not rebuild:
+        return _CACHED
+    if _TRIED and not rebuild:
+        return None
+    _TRIED = True
+    if not os.path.exists(_SO_PATH) or rebuild:
+        makefile_dir = os.path.join(_REPO_ROOT, "native")
+        if not os.path.exists(os.path.join(makefile_dir, "Makefile")):
+            return None
+        try:
+            subprocess.run(["make", "-C", makefile_dir], check=True,
+                           capture_output=True, timeout=120)
+        except Exception as exc:
+            log.warn("native data lib build failed (%s); using Python "
+                     "fallback", exc)
+            return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.mv_data_abi_version.restype = ctypes.c_int32
+        version = lib.mv_data_abi_version()
+        if version != ABI_VERSION:
+            if not rebuild:
+                return load_native(rebuild=True)
+            log.warn("native data lib ABI %d != expected %d", version,
+                     ABI_VERSION)
+            return None
+        _CACHED = NativeData(lib)
+        return _CACHED
+    except (OSError, AttributeError) as exc:
+        # AttributeError: stale .so without the version symbol
+        if not rebuild and isinstance(exc, AttributeError):
+            return load_native(rebuild=True)
+        log.warn("cannot load %s (%s); using Python fallback", _SO_PATH, exc)
+        return None

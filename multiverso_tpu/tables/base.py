@@ -71,15 +71,46 @@ def loadz_stream(uri: str, magic: str):
     return manifest, data
 
 
+def pack_state(state: Any, payload: Dict[str, np.ndarray]) -> int:
+    """Add updater-state leaves to a checkpoint payload as state_{i}.
+    Returns the leaf count (for the manifest)."""
+    leaves = jax.tree.leaves(state)
+    for i, leaf in enumerate(leaves):
+        payload[f"state_{i}"] = np.asarray(leaf)
+    return len(leaves)
+
+
+def unpack_state(data, n_leaves: int, template_state: Any, convert) -> Any:
+    """Rebuild an updater-state pytree from checkpoint leaves.
+    ``convert(leaf_np, template_leaf)`` places one leaf on device."""
+    leaves = [data[f"state_{i}"] for i in range(n_leaves)]
+    _, treedef = jax.tree.flatten(template_state)
+    tmpl = jax.tree.leaves(template_state)
+    return jax.tree.unflatten(
+        treedef, [convert(l, t) for l, t in zip(leaves, tmpl)])
+
+
 class Handle:
     """Async completion handle (the reference's Waiter, SURVEY.md §3.7):
-    wraps dispatched device values; ``wait()`` blocks until they land."""
+    wraps dispatched device values; ``wait()`` blocks until they land.
 
-    def __init__(self, values: Any) -> None:
+    An add-handle's buffer may be donated to a LATER update before
+    ``wait()`` is called (donation deletes the buffer on TPU). Updates
+    apply in program order, so waiting on the table's *current* buffers
+    subsumes waiting on the older ones — ``fallback`` provides them.
+    """
+
+    def __init__(self, values: Any, fallback=None) -> None:
         self._values = values
+        self._fallback = fallback
 
     def wait(self) -> Any:
-        jax.block_until_ready(self._values)
+        try:
+            jax.block_until_ready(self._values)
+        except RuntimeError:
+            if self._fallback is None:
+                raise
+            jax.block_until_ready(self._fallback())
         return self._values
 
     # the reference's GetAsync returns data through the waiting buffer;
@@ -200,7 +231,8 @@ class Table:
         self.param, self.state = self._apply(self.param, self.state,
                                              delta, opt)
         self._bump_step()
-        handle = Handle(self.param)
+        handle = Handle(self.param,
+                        fallback=lambda: (self.param, self.state))
         if sync:
             handle.wait()
         return handle
@@ -227,12 +259,9 @@ class Table:
 
     def store(self, uri: str) -> None:
         """Serialize param + updater state through the stream layer."""
-        state_leaves, state_def = jax.tree.flatten(self.state)
         payload = {"param": np.asarray(self.param)}
-        for i, leaf in enumerate(state_leaves):
-            payload[f"state_{i}"] = np.asarray(leaf)
         manifest = self._manifest()
-        manifest["n_state_leaves"] = len(state_leaves)
+        manifest["n_state_leaves"] = pack_state(self.state, payload)
         savez_stream(uri, manifest, payload)
 
     def load(self, uri: str) -> None:
@@ -245,23 +274,22 @@ class Table:
             raise ValueError(
                 f"checkpoint updater {manifest['updater']!r} != table "
                 f"updater {self.updater.name!r}")
-        param = data["param"]
-        if param.shape != self.padded_shape:  # repad (shard count changed)
-            param = self._pad(param[tuple(slice(0, l)
-                                          for l in self.logical_shape)])
-        self.param = jax.device_put(param.astype(self.dtype),
-                                    self.sharding)
-        leaves = [data[f"state_{i}"]
-                  for i in range(manifest["n_state_leaves"])]
-        _, state_def = jax.tree.flatten(self.state)
-        template_leaves = jax.tree.leaves(self.state)
-        restored = []
-        for leaf, tmpl in zip(leaves, template_leaves):
-            restored.append(jax.device_put(
-                leaf.astype(tmpl.dtype),
-                tmpl.sharding if isinstance(tmpl, jax.Array)
-                else self.sharding))
-        self.state = jax.tree.unflatten(state_def, restored)
+        def repad(arr: np.ndarray, want_shape, want_dtype):
+            # slice to the logical region, then pad to the current padded
+            # shape — the checkpoint may come from a different shard count
+            if arr.shape != want_shape:
+                arr = arr[tuple(slice(0, l) for l in self.logical_shape)]
+                pad = [(0, p - l) for p, l in zip(want_shape, arr.shape)]
+                arr = np.pad(arr, pad)
+            return arr.astype(want_dtype)
+
+        self.param = jax.device_put(
+            repad(data["param"], self.padded_shape, self.dtype),
+            self.sharding)
+        self.state = unpack_state(
+            data, manifest["n_state_leaves"], self.state,
+            lambda leaf, tmpl: jax.device_put(
+                repad(leaf, tmpl.shape, tmpl.dtype), self.sharding))
         self.default_option.step = int(manifest.get("step", 0))
 
 

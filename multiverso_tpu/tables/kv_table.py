@@ -36,7 +36,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from multiverso_tpu import core
 from multiverso_tpu.tables.base import (Handle, Table, _register,
-                                        loadz_stream, savez_stream)
+                                        loadz_stream, pack_state,
+                                        savez_stream, unpack_state)
 from multiverso_tpu.updaters import AddOption, get_updater
 from multiverso_tpu.utils import configure, log
 
@@ -241,7 +242,9 @@ class KVTable:
             jnp.asarray(deltas), opt)
         with self._option_lock:
             self.default_option.step += 1
-        handle = Handle(self.values)
+        handle = Handle(
+            self.values,
+            fallback=lambda: (self.keys, self.values, self.state))
         if sync:
             handle.wait()
         return handle
@@ -257,17 +260,14 @@ class KVTable:
     KV_MAGIC = "multiverso_tpu.kvtable.v1"
 
     def store(self, uri: str) -> None:
-        state_leaves = jax.tree.leaves(self.state)
         payload = {"keys": np.asarray(self.keys),
                    "values": np.asarray(self.values),
                    "bucket_fill": self._bucket_fill}
-        for i, leaf in enumerate(state_leaves):
-            payload[f"state_{i}"] = np.asarray(leaf)
         manifest = {"magic": self.KV_MAGIC, "name": self.name,
                     "capacity": self.capacity, "value_dim": self.value_dim,
                     "slots": self.slots, "num_buckets": self.num_buckets,
                     "dtype": self.dtype.name, "updater": self.updater.name,
-                    "n_state_leaves": len(state_leaves),
+                    "n_state_leaves": pack_state(self.state, payload),
                     "step": self.default_option.step}
         savez_stream(uri, manifest, payload)
 
@@ -289,13 +289,10 @@ class KVTable:
         self.keys = jax.device_put(host_keys, self._key_sharding)
         self.values = jax.device_put(data["values"].astype(self.dtype),
                                      self._val_sharding)
-        leaves = [data[f"state_{i}"]
-                  for i in range(manifest["n_state_leaves"])]
-        _, state_def = jax.tree.flatten(self.state)
-        tmpl = jax.tree.leaves(self.state)
-        self.state = jax.tree.unflatten(state_def, [
-            jax.device_put(l.astype(t.dtype), self._val_sharding)
-            for l, t in zip(leaves, tmpl)])
+        self.state = unpack_state(
+            data, manifest["n_state_leaves"], self.state,
+            lambda leaf, tmpl: jax.device_put(leaf.astype(tmpl.dtype),
+                                              self._val_sharding))
         self._bucket_fill = data["bucket_fill"].copy()
         self._slot_map = {}
         joined = _join_keys(host_keys)

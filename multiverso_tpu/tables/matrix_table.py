@@ -180,7 +180,8 @@ class MatrixTable(Table):
             self.param, self.state = self._gather_apply_scatter(
                 self.param, self.state, padded, pd, mask, opt)
         self._bump_step()
-        handle = Handle(self.param)
+        handle = Handle(self.param,
+                        fallback=lambda: (self.param, self.state))
         if sync:
             handle.wait()
         return handle

@@ -1,0 +1,203 @@
+"""Data pipeline tests: native backend vs Python fallback parity, corpus
+semantics, Huffman validity, pair generation, LDA CSR reading."""
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.data import (Corpus, PyData, load_native,
+                                 synthetic_docs, synthetic_text)
+
+native = load_native()
+BACKENDS = [pytest.param(PyData(), id="python")]
+if native is not None:
+    BACKENDS.append(pytest.param(native, id="native"))
+
+
+@pytest.fixture(scope="module")
+def text_file(tmp_path_factory):
+    p = tmp_path_factory.mktemp("data") / "corpus.txt"
+    p.write_text("the quick brown fox jumps over the lazy dog\n"
+                 "the quick brown fox\nthe dog sleeps\n")
+    return str(p)
+
+
+@pytest.mark.parametrize("be", BACKENDS)
+class TestCorpusBuild:
+    def test_vocab_and_encoding(self, be, text_file):
+        c = be.build_corpus(text_file, min_count=1)
+        assert c.words[0] == "the"                      # most frequent first
+        assert c.counts[0] == 4
+        assert c.total_raw_tokens == 16
+        assert len(c.ids) == 16
+        # encoding round-trips: id of first token is id of 'the' = 0
+        assert c.ids[0] == 0
+        # counts sorted descending
+        assert (np.diff(c.counts) <= 0).all()
+
+    def test_min_count_filters(self, be, text_file):
+        c = be.build_corpus(text_file, min_count=2)
+        assert set(c.words) <= {"the", "quick", "brown", "fox", "dog"}
+        assert all(cnt >= 2 for cnt in c.counts)
+        # dropped words removed from the id stream
+        assert len(c.ids) < 16
+
+    def test_missing_file_raises(self, be, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            be.build_corpus(str(tmp_path / "nope.txt"), 1)
+
+    def test_deterministic_word_order(self, be, text_file):
+        c1 = be.build_corpus(text_file, min_count=1)
+        c2 = be.build_corpus(text_file, min_count=1)
+        assert c1.words == c2.words
+
+
+@pytest.mark.parametrize("be", BACKENDS)
+class TestHuffman:
+    def test_codes_are_prefix_free_and_complete(self, be):
+        counts = np.asarray([50, 30, 10, 5, 3, 2], np.int64)
+        codes, points, lengths = be.huffman(counts)
+        assert (lengths > 0).all()
+        # more frequent words get codes no longer than rarer ones
+        assert lengths[0] <= lengths[-1]
+        # prefix-free: no code is a prefix of another
+        strs = ["".join(str(int(codes[w, i])) for i in range(lengths[w]))
+                for w in range(len(counts))]
+        for a in range(len(strs)):
+            for b in range(len(strs)):
+                if a != b:
+                    assert not strs[b].startswith(strs[a])
+        # expected code length ~ entropy bound
+        p = counts / counts.sum()
+        entropy = -(p * np.log2(p)).sum()
+        avg_len = (p * lengths).sum()
+        assert entropy <= avg_len <= entropy + 1
+        # points index inner nodes [0, V-2]; root = V-2 is first point
+        V = len(counts)
+        for w in range(V):
+            assert points[w, 0] == V - 2
+            for i in range(lengths[w]):
+                assert 0 <= points[w, i] <= V - 2
+
+    def test_single_word_vocab(self, be):
+        codes, points, lengths = be.huffman(np.asarray([7], np.int64))
+        assert lengths[0] == 0
+        # regression: padding must be -1-filled, not uninitialized memory
+        assert (codes[0] == -1).all()
+        assert (points[0] == -1).all()
+
+class TestHuffmanParity:
+    def test_python_native_parity(self):
+        if native is None:
+            pytest.skip("native backend unavailable")
+        counts = np.sort(np.random.default_rng(3).integers(
+            1, 1000, size=50))[::-1].astype(np.int64)
+        c1, p1, l1 = PyData().huffman(counts)
+        c2, p2, l2 = native.huffman(counts)
+        np.testing.assert_array_equal(l1, l2)
+        np.testing.assert_array_equal(c1, c2)
+        np.testing.assert_array_equal(p1, p2)
+
+
+@pytest.mark.parametrize("be", BACKENDS)
+class TestPairs:
+    def test_skipgram_pairs_valid(self, be):
+        ids = np.arange(100, dtype=np.int32) % 10
+        c, x = be.skipgram_pairs(ids, window=3, keep_prob=None, seed=7)
+        assert len(c) == len(x) > 0
+        assert c.max() < 10 and x.max() < 10
+        assert (c >= 0).all() and (x >= 0).all()
+
+    def test_skipgram_deterministic_per_seed(self, be):
+        ids = np.arange(50, dtype=np.int32) % 5
+        a = be.skipgram_pairs(ids, 2, None, seed=1)
+        b = be.skipgram_pairs(ids, 2, None, seed=1)
+        np.testing.assert_array_equal(a[0], b[0])
+        np.testing.assert_array_equal(a[1], b[1])
+
+    def test_subsampling_reduces_pairs(self, be):
+        ids = np.zeros(200, np.int32)  # one hyper-frequent word
+        keep = np.asarray([0.1], np.float32)
+        c_all, _ = be.skipgram_pairs(ids, 2, None, seed=3)
+        c_sub, _ = be.skipgram_pairs(ids, 2, keep, seed=3)
+        assert len(c_sub) < len(c_all)
+
+    def test_cbow_examples(self, be):
+        ids = np.arange(60, dtype=np.int32) % 6
+        ctx, tgt = be.cbow_examples(ids, window=2, keep_prob=None, seed=5)
+        assert ctx.shape == (len(tgt), 4)
+        assert tgt.max() < 6
+        # padding marker -1 only at row tails
+        for row in ctx:
+            seen_pad = False
+            for v in row:
+                if v == -1:
+                    seen_pad = True
+                else:
+                    assert not seen_pad
+
+
+@pytest.mark.parametrize("be", BACKENDS)
+class TestLdaDocs:
+    def test_csr_roundtrip(self, be, tmp_path):
+        # includes an empty line AND a whitespace-only line: neither is a doc
+        p = tmp_path / "docs.txt"
+        p.write_text("0:2 3:1\n5:4\n\n \t \n1:1 2:1 3:1\n")
+        offsets, wids, wcnts = be.lda_read_docs(str(p))
+        assert len(offsets) == 4  # 3 non-empty docs
+        np.testing.assert_array_equal(offsets, [0, 2, 3, 6])
+        np.testing.assert_array_equal(wids, [0, 3, 5, 1, 2, 3])
+        np.testing.assert_array_equal(wcnts, [2, 1, 4, 1, 1, 1])
+
+    def test_malformed_tokens_skipped(self, be, tmp_path):
+        p = tmp_path / "docs.txt"
+        p.write_text("0:2 garbage 3:x 4:1\n")
+        offsets, wids, wcnts = be.lda_read_docs(str(p))
+        np.testing.assert_array_equal(wids, [0, 4])
+
+    def test_missing_file(self, be, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            be.lda_read_docs(str(tmp_path / "nope"))
+
+
+class TestCorpusClass:
+    def test_from_file_and_distributions(self, text_file):
+        c = Corpus.from_file(text_file, min_count=1, subsample=1e-3)
+        assert c.vocab_size > 0
+        kp = c.keep_prob()
+        assert kp.shape == (c.vocab_size,)
+        assert (kp > 0).all() and (kp <= 1).all()
+        # rarer words kept with probability >= more frequent words
+        assert kp[-1] >= kp[0]
+        u = c.unigram_probs()
+        assert abs(u.sum() - 1.0) < 1e-5
+        # ^0.75 flattens: max prob below raw frequency share
+        raw = c.counts / c.counts.sum()
+        assert u.max() < raw.max()
+
+    def test_skipgram_batches_fixed_shape(self, text_file):
+        c = Corpus.from_file(text_file, min_count=1, subsample=0)
+        batches = list(c.skipgram_batches(batch_size=8, window=2, epochs=2))
+        assert len(batches) > 0
+        for ctr, ctx in batches:
+            assert ctr.shape == (8,) and ctx.shape == (8,)
+
+
+class TestSynthetic:
+    def test_synthetic_text(self, tmp_path):
+        p = tmp_path / "syn.txt"
+        synthetic_text(str(p), num_tokens=5000, vocab_size=100, seed=1)
+        c = Corpus.from_file(str(p), min_count=1)
+        assert c.num_tokens == 5000
+        assert c.vocab_size <= 100
+        # zipf: most frequent word much more common than median
+        assert c.counts[0] > 5 * np.median(c.counts)
+
+    def test_synthetic_docs(self, tmp_path):
+        p = tmp_path / "docs.txt"
+        synthetic_docs(str(p), num_docs=20, vocab_size=50, avg_doc_len=10,
+                       seed=1)
+        from multiverso_tpu.data import backend
+        offsets, wids, wcnts = backend().lda_read_docs(str(p))
+        assert len(offsets) == 21
+        assert wids.max() < 50
+        assert (wcnts > 0).all()

@@ -327,6 +327,38 @@ class TestCheckpoint:
         with pytest.raises(ValueError, match="value_dim"):
             t2.load(uri)
 
+    def test_load_across_shard_counts_stateful(self, mesh8, devices,
+                                               tmp_path):
+        # regression: checkpoint from one shard count loaded under another
+        # must repad updater state along with params
+        from multiverso_tpu import core
+        t = MatrixTable(5, 3, updater="adagrad",
+                        default_option=AddOption(learning_rate=0.1))
+        t.add(np.ones((5, 3), np.float32), sync=True)
+        uri = str(tmp_path / "m.ckpt")
+        t.store(uri)
+        expected_after = None
+        t.add(np.ones((5, 3), np.float32), sync=True)
+        expected_after = t.get()
+        core.shutdown()
+        core.init(devices=devices, data_parallel=2, model_parallel=4)
+        t2 = MatrixTable(5, 3, updater="adagrad",
+                         default_option=AddOption(learning_rate=0.1))
+        t2.load(uri)
+        t2.add(np.ones((5, 3), np.float32), sync=True)  # must not crash
+        np.testing.assert_allclose(t2.get(), expected_after, rtol=1e-5)
+        core.shutdown()
+        core.init(devices=devices, data_parallel=4, model_parallel=2)
+
+    def test_add_handle_wait_after_later_add(self, mesh8):
+        # regression: an add-handle whose buffer was donated to a later
+        # update must still complete wait() (via the fallback)
+        t = ArrayTable(8, updater="default")
+        h1 = t.add_async(np.ones(8, np.float32))
+        t.add(np.ones(8, np.float32))
+        h1.wait()
+        np.testing.assert_allclose(t.get(), 2 * np.ones(8))
+
     def test_get_jax_snapshot_survives_add(self, mesh8):
         # regression: add() donates the param buffer; get_jax must return a
         # fresh snapshot, not the live buffer
