@@ -1,0 +1,7 @@
+"""Flagship applications, the TPU-native rebuilds of the reference's
+`Applications/` tree (SURVEY.md §3.6):
+
+- :mod:`multiverso_tpu.apps.logreg` — Applications/LogisticRegression
+- :mod:`multiverso_tpu.apps.word_embedding` — Applications/WordEmbedding
+- :mod:`multiverso_tpu.apps.lightlda` — LightLDA (companion repo)
+"""

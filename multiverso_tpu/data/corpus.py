@@ -9,14 +9,12 @@ backend (native C++ or Python fallback) is selected automatically.
 
 from __future__ import annotations
 
-import math
 from typing import Iterator, Optional, Tuple
 
 import numpy as np
 
 from multiverso_tpu.data.native import CorpusData, load_native
 from multiverso_tpu.data.pydata import PyData
-from multiverso_tpu.utils import log
 from multiverso_tpu.utils.async_buffer import prefetch_iterator
 
 
@@ -33,7 +31,7 @@ class Corpus:
         self.data = data
         self.subsample = subsample
         self._keep_prob: Optional[np.ndarray] = None
-        self._unigram: Optional[np.ndarray] = None
+        self._unigram: Optional[Tuple[float, np.ndarray]] = None
 
     @classmethod
     def from_file(cls, path: str, min_count: int = 5,
@@ -76,10 +74,10 @@ class Corpus:
 
     def unigram_probs(self, power: float = 0.75) -> np.ndarray:
         """Negative-sampling distribution ∝ count^0.75 (word2vec)."""
-        if self._unigram is None:
+        if self._unigram is None or self._unigram[0] != power:
             p = self.counts.astype(np.float64) ** power
-            self._unigram = (p / p.sum()).astype(np.float32)
-        return self._unigram
+            self._unigram = (power, (p / p.sum()).astype(np.float32))
+        return self._unigram[1]
 
     def huffman(self, max_len: int = 64):
         """(codes int8 [V, L], points int32 [V, L], lengths int32 [V])."""

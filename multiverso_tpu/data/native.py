@@ -214,7 +214,10 @@ def load_native(rebuild: bool = False) -> Optional[NativeData]:
         if not os.path.exists(os.path.join(makefile_dir, "Makefile")):
             return None
         try:
-            subprocess.run(["make", "-C", makefile_dir], check=True,
+            # -B on rebuild: a stale committed .so has a fresh mtime after
+            # clone, so plain make would consider it up to date
+            cmd = ["make", "-C", makefile_dir] + (["-B"] if rebuild else [])
+            subprocess.run(cmd, check=True,
                            capture_output=True, timeout=120)
         except Exception as exc:
             log.warn("native data lib build failed (%s); using Python "

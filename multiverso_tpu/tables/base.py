@@ -110,7 +110,10 @@ class Handle:
         except RuntimeError:
             if self._fallback is None:
                 raise
-            jax.block_until_ready(self._fallback())
+            # the original buffer was donated to a later update; the live
+            # table buffers subsume it — return those, never the dead array
+            self._values = self._fallback()
+            jax.block_until_ready(self._values)
         return self._values
 
     # the reference's GetAsync returns data through the waiting buffer;

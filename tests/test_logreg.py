@@ -1,0 +1,135 @@
+"""apps/logreg: convergence + data plumbing on the virtual CPU mesh.
+
+The analog of the reference's examples-as-system-tests (SURVEY.md §5):
+loss goes down / accuracy goes up on a small dataset.
+"""
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.apps.logreg import (LogisticRegression, LogRegConfig,
+                                        read_libsvm, synthetic_blobs)
+from multiverso_tpu.tables import base as table_base
+
+
+@pytest.fixture(autouse=True)
+def _clean_tables():
+    yield
+    table_base.reset_tables()
+
+
+def test_read_libsvm(tmp_path):
+    p = tmp_path / "data.libsvm"
+    p.write_text("1 0:1.5 3:2.0\n-1 1:0.5\n1 2:1.0\n")
+    X, y = read_libsvm(str(p), input_dim=4)
+    assert X.shape == (3, 4)
+    assert list(y) == [1, 0, 1]
+    assert X[0, 0] == 1.5 and X[0, 3] == 2.0 and X[1, 1] == 0.5
+
+
+def test_read_libsvm_multiclass(tmp_path):
+    p = tmp_path / "data.libsvm"
+    p.write_text("0 0:1\n2 1:1\n1 2:1\n")
+    X, y = read_libsvm(str(p), input_dim=3)
+    assert list(y) == [0, 2, 1]
+
+
+def test_read_libsvm_one_based_autodetect(tmp_path):
+    p = tmp_path / "data.libsvm"
+    # canonical 1-based: indices 1..4 with input_dim=4
+    p.write_text("1 1:1.5 4:2.0\n-1 2:0.5\n")
+    X, y = read_libsvm(str(p), input_dim=4)
+    assert X[0, 0] == 1.5 and X[0, 3] == 2.0 and X[1, 1] == 0.5
+
+
+def test_read_libsvm_out_of_range(tmp_path):
+    p = tmp_path / "data.libsvm"
+    p.write_text("1 9:1.0\n")
+    with pytest.raises(ValueError):
+        read_libsvm(str(p), input_dim=4)
+
+
+def test_converges_dp(mesh_dp8):
+    X, y = synthetic_blobs(2048, input_dim=16, num_classes=4, seed=1)
+    app = LogisticRegression(
+        LogRegConfig(input_dim=16, num_classes=4, minibatch_size=256,
+                     epochs=4, learning_rate=0.5), mesh=mesh_dp8)
+    first = app.train_epoch(X, y, shuffle_seed=0)
+    for e in range(1, 4):
+        last = app.train_epoch(X, y, shuffle_seed=e)
+    assert last < first
+    assert app.accuracy(X, y) > 0.9
+
+
+def test_converges_model_sharded(mesh8):
+    """Weights sharded over the model axis (4x2 mesh) still converge."""
+    X, y = synthetic_blobs(1024, input_dim=10, num_classes=3, seed=2)
+    app = LogisticRegression(
+        LogRegConfig(input_dim=10, num_classes=3, minibatch_size=128,
+                     epochs=5, learning_rate=0.5), mesh=mesh8)
+    app.train(X, y)
+    assert app.accuracy(X, y) > 0.9
+
+
+def test_adagrad_updater(mesh_dp8):
+    X, y = synthetic_blobs(1024, input_dim=8, num_classes=2, seed=3)
+    app = LogisticRegression(
+        LogRegConfig(input_dim=8, num_classes=2, minibatch_size=128,
+                     epochs=5, learning_rate=0.3, updater="adagrad"),
+        mesh=mesh_dp8)
+    app.train(X, y)
+    assert app.accuracy(X, y) > 0.9
+
+
+def test_sigmoid_objective(mesh_dp8):
+    X, y = synthetic_blobs(1024, input_dim=8, num_classes=2, seed=4)
+    app = LogisticRegression(
+        LogRegConfig(input_dim=8, num_classes=2, minibatch_size=128,
+                     epochs=5, learning_rate=0.5, objective="sigmoid"),
+        mesh=mesh_dp8)
+    app.train(X, y)
+    assert app.accuracy(X, y) > 0.9
+
+
+def test_l2_shrinks_weights(mesh_dp8):
+    X, y = synthetic_blobs(512, input_dim=8, num_classes=2, seed=5)
+    free = LogisticRegression(
+        LogRegConfig(input_dim=8, num_classes=2, epochs=3,
+                     learning_rate=0.5), mesh=mesh_dp8, name="lr_free")
+    reg = LogisticRegression(
+        LogRegConfig(input_dim=8, num_classes=2, epochs=3,
+                     learning_rate=0.5, regular_lambda=0.5),
+        mesh=mesh_dp8, name="lr_reg")
+    free.train(X, y)
+    reg.train(X, y)
+    wf, _ = free.weights()
+    wr, _ = reg.weights()
+    assert np.linalg.norm(wr) < np.linalg.norm(wf)
+
+
+def test_checkpoint_roundtrip(mesh_dp8, tmp_path):
+    X, y = synthetic_blobs(512, input_dim=8, num_classes=2, seed=6)
+    app = LogisticRegression(
+        LogRegConfig(input_dim=8, num_classes=2, epochs=2,
+                     learning_rate=0.5), mesh=mesh_dp8, name="lr_ckpt")
+    app.train(X, y)
+    uri = f"file://{tmp_path}/model.npz"
+    app.store(uri)
+    w_before = app.weights()[0]
+    app2 = LogisticRegression(
+        LogRegConfig(input_dim=8, num_classes=2), mesh=mesh_dp8,
+        name="lr_ckpt2")
+    app2.load(uri)
+    np.testing.assert_allclose(app2.weights()[0], w_before, rtol=1e-6)
+    assert app2.accuracy(X, y) == app.accuracy(X, y)
+
+
+def test_remainder_batch(mesh_dp8):
+    """Batch not divisible by the data-axis size still trains."""
+    X, y = synthetic_blobs(515, input_dim=8, num_classes=2, seed=7)
+    app = LogisticRegression(
+        LogRegConfig(input_dim=8, num_classes=2, minibatch_size=130,
+                     epochs=3, learning_rate=0.5), mesh=mesh_dp8,
+        name="lr_rem")
+    app.train(X, y)
+    assert app.accuracy(X, y) > 0.85
