@@ -1,0 +1,400 @@
+"""Distributed word2vec — TPU-native rebuild of the reference's
+`Applications/WordEmbedding/` (upstream layout; SURVEY.md §3.6/§4.5):
+skip-gram & CBOW, negative sampling & hierarchical softmax, embeddings in
+two row-sharded MatrixTables.
+
+Reference shape (SURVEY.md §4.5): `Distributed_wordembedding` main +
+`WordEmbedding` model math + N `Trainer` threads doing local scalar SGD on
+per-block row copies + `ParameterLoader` prefetch + per-block delta
+aggregation `Add`ed to the MatrixTables.
+
+TPU design (the whole point — nothing here is a translation):
+
+- The per-pair scalar loop (dot/sigmoid/axpy over one row pair at a time)
+  becomes a **batched jitted superstep**: ``lax.scan`` over S minibatches
+  of B pairs, each step = gather rows → one einsum against the MXU →
+  analytic sigmoid gradients → duplicate-safe scatter-add. One dispatch
+  trains S*B pairs.
+- The reference's Trainer-thread Hogwild + per-block aggregation becomes
+  the batched scatter-add: duplicate rows within a minibatch accumulate
+  additively (`.at[].add`), exactly the reference's Aggregator semantics.
+- Negative sampling runs **on device** via the alias method: the unigram^p
+  distribution is preprocessed into (prob, alias) arrays once; a sample is
+  two uniforms + two gathers — no host RNG in the hot loop
+  (`jax.random.fold_in`-per-step keys keep it reproducible across chips).
+- Data parallelism: the pair stream is sharded over the mesh ``"data"``
+  axis; the embedding tables keep their row sharding, so XLA inserts the
+  cross-chip reduction of the scatter contributions (psum over ICI) —
+  the Get/Add round-trip of SURVEY.md §4.2/§4.3 collapsed into one
+  compiled program.
+- Hierarchical softmax uses the Huffman (codes, points) arrays from the
+  data layer, padded to fixed length with a masked scratch row — static
+  shapes for XLA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from multiverso_tpu import core
+from multiverso_tpu.data.corpus import Corpus
+from multiverso_tpu.tables import MatrixTable
+from multiverso_tpu.utils import dashboard, log
+
+
+@dataclasses.dataclass
+class W2VConfig:
+    """The reference app's argv config (word2vec-style flags)."""
+    embedding_dim: int = 100
+    window: int = 5
+    negative: int = 5           # negatives per positive (NS objective)
+    model: str = "skipgram"     # "skipgram" | "cbow"
+    objective: str = "ns"       # "ns" (negative sampling) | "hs" (Huffman)
+    batch_size: int = 1024      # pairs per scan step
+    steps_per_call: int = 16    # scan length: pairs/dispatch = B * S
+    learning_rate: float = 0.025
+    min_lr_frac: float = 1e-4   # linear decay floor (lr * frac)
+    epochs: int = 1
+    subsample: float = 1e-3
+    unigram_power: float = 0.75
+    max_code_len: int = 40      # HS: Huffman code pad length
+    seed: int = 0
+    dtype: str = "float32"
+
+
+def build_alias(probs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Vose alias-table construction, O(V).
+
+    Returns (prob f32[V], alias int32[V]): sample j ~ U[0,V), u ~ U[0,1);
+    result = j if u < prob[j] else alias[j].
+    """
+    v = len(probs)
+    prob = np.zeros(v, np.float64)
+    alias = np.zeros(v, np.int32)
+    scaled = probs.astype(np.float64) * v
+    small = [i for i in range(v) if scaled[i] < 1.0]
+    large = [i for i in range(v) if scaled[i] >= 1.0]
+    while small and large:
+        s, l = small.pop(), large.pop()
+        prob[s] = scaled[s]
+        alias[s] = l
+        scaled[l] = scaled[l] - (1.0 - scaled[s])
+        (small if scaled[l] < 1.0 else large).append(l)
+    for i in large + small:
+        prob[i] = 1.0
+    return prob.astype(np.float32), alias
+
+
+def alias_sample(key, prob: jax.Array, alias: jax.Array, shape):
+    """Draw ids from the alias table (two gathers, no host round-trip)."""
+    kj, ku = jax.random.split(key)
+    j = jax.random.randint(kj, shape, 0, prob.shape[0])
+    u = jax.random.uniform(ku, shape)
+    return jnp.where(u < prob[j], j, alias[j]).astype(jnp.int32)
+
+
+class WordEmbedding:
+    """The app: two MatrixTables + the fused scan superstep."""
+
+    def __init__(self, corpus: Corpus, config: W2VConfig, *,
+                 mesh=None, name: str = "w2v") -> None:
+        self.corpus = corpus
+        self.config = config
+        self.mesh = mesh if mesh is not None else core.mesh()
+        c = config
+        v, d = corpus.vocab_size, c.embedding_dim
+        rng = np.random.default_rng(c.seed)
+        # reference init: input embeddings ~ U(-0.5/dim, 0.5/dim), output 0
+        w_in_init = rng.uniform(-0.5 / d, 0.5 / d, (v, d)).astype(c.dtype)
+        self.w_in = MatrixTable(v, d, c.dtype, init_value=w_in_init,
+                                updater="default", mesh=self.mesh,
+                                name=f"{name}_in")
+        self.w_out = MatrixTable(v, d, c.dtype, init_value=0,
+                                 updater="default", mesh=self.mesh,
+                                 name=f"{name}_out")
+        self._scratch = self.w_in.padded_shape[0] - 1  # masked-lane row
+
+        # negative-sampling alias table (device-resident constants)
+        if c.objective == "ns":
+            p, a = build_alias(corpus.unigram_probs(c.unigram_power))
+            self._alias_prob = jnp.asarray(p)
+            self._alias_idx = jnp.asarray(a)
+        elif c.objective == "hs":
+            codes, points, lengths = corpus.huffman(c.max_code_len)
+            L = c.max_code_len
+            # mask beyond each word's code length; park masked lanes on the
+            # scratch row so the scatter is shape-static
+            msk = np.arange(L)[None, :] < lengths[:, None]
+            pts = np.where(msk, points[:, :L], self._scratch)
+            self._hs_points = jnp.asarray(pts.astype(np.int32))
+            self._hs_codes = jnp.asarray(codes[:, :L].astype(np.float32))
+            self._hs_mask = jnp.asarray(msk.astype(np.float32))
+        else:
+            raise ValueError(f"objective must be 'ns' or 'hs', "
+                             f"got {c.objective!r}")
+        if c.model not in ("skipgram", "cbow"):
+            raise ValueError(f"model must be 'skipgram' or 'cbow', "
+                             f"got {c.model!r}")
+        self._key = jax.random.PRNGKey(c.seed)
+        self._step_no = 0
+        self.loss_history: list = []
+        self._build_superstep()
+
+    # -- the fused superstep ----------------------------------------------
+
+    def _pos_neg_step(self, w_out, v, tgt, key, lr):
+        """Shared NS inner math: v [B,D] input vectors vs target ids [B].
+        Returns (w_out', grad wrt v [B,D], mean loss)."""
+        c = self.config
+        negs = alias_sample(key, self._alias_prob, self._alias_idx,
+                            (v.shape[0], c.negative))
+        ids = jnp.concatenate([tgt[:, None], negs], axis=1)   # [B, 1+K]
+        u = jnp.take(w_out, ids, axis=0)                      # [B, 1+K, D]
+        logits = jnp.einsum("bd,bkd->bk", v, u)
+        labels = jnp.zeros_like(logits).at[:, 0].set(1.0)
+        sig = jax.nn.sigmoid(logits)
+        # binary CE on (pos, negs); analytic grad dL/dlogit = sig - label
+        loss = -jnp.mean(
+            jnp.sum(labels * jax.nn.log_sigmoid(logits)
+                    + (1.0 - labels) * jax.nn.log_sigmoid(-logits), axis=1))
+        g = (sig - labels) * lr                               # [B, 1+K]
+        grad_v = jnp.einsum("bk,bkd->bd", g, u)
+        grad_u = g[:, :, None] * v[:, None, :]                # [B,1+K,D]
+        w_out = w_out.at[ids.reshape(-1)].add(
+            -grad_u.reshape(-1, u.shape[-1]).astype(w_out.dtype))
+        return w_out, grad_v, loss
+
+    def _hs_step(self, w_out, v, tgt, lr):
+        """Hierarchical-softmax inner math along the Huffman path."""
+        pts = jnp.take(self._hs_points, tgt, axis=0)          # [B, L]
+        code = jnp.take(self._hs_codes, tgt, axis=0)          # [B, L] 0/1
+        msk = jnp.take(self._hs_mask, tgt, axis=0)            # [B, L]
+        u = jnp.take(w_out, pts, axis=0)                      # [B, L, D]
+        logits = jnp.einsum("bd,bld->bl", v, u)
+        sig = jax.nn.sigmoid(logits)
+        # label = code bit: P(go-right) modeled by sigmoid
+        loss = -jnp.sum(msk * (code * jax.nn.log_sigmoid(logits)
+                               + (1 - code) * jax.nn.log_sigmoid(-logits))
+                        ) / jnp.maximum(jnp.sum(msk), 1.0)
+        g = (sig - code) * msk * lr                           # [B, L]
+        grad_v = jnp.einsum("bl,bld->bd", g, u)
+        grad_u = g[:, :, None] * v[:, None, :]
+        w_out = w_out.at[pts.reshape(-1)].add(
+            -grad_u.reshape(-1, u.shape[-1]).astype(w_out.dtype))
+        return w_out, grad_v, loss
+
+    def _build_superstep(self) -> None:
+        c = self.config
+        sh = self.w_in.sharding
+        cbow = c.model == "cbow"
+
+        def body(carry, inp):
+            w_in, w_out = carry
+            src, tgt, key, lr = inp
+            if cbow:
+                # src [B, 2w] context ids (scratch row = padding), tgt [B]
+                ctx_mask = (src != self._scratch).astype(w_in.dtype)
+                n_ctx = jnp.maximum(ctx_mask.sum(axis=1, keepdims=True), 1.0)
+                vecs = jnp.take(w_in, src, axis=0)            # [B, 2w, D]
+                v = jnp.einsum("bwd,bw->bd", vecs, ctx_mask) / n_ctx
+            else:
+                v = jnp.take(w_in, src, axis=0)               # [B, D]
+            if c.objective == "ns":
+                w_out, grad_v, loss = self._pos_neg_step(
+                    w_out, v, tgt, key, lr)
+            else:
+                w_out, grad_v, loss = self._hs_step(w_out, v, tgt, lr)
+            if cbow:
+                # spread the input-side gradient over the context words
+                gctx = (grad_v / n_ctx)[:, None, :] * ctx_mask[:, :, None]
+                w_in = w_in.at[src.reshape(-1)].add(
+                    -gctx.reshape(-1, gctx.shape[-1]).astype(w_in.dtype))
+            else:
+                w_in = w_in.at[src].add(-grad_v.astype(w_in.dtype))
+            return (w_in, w_out), loss
+
+        @partial(jax.jit, donate_argnums=(0, 1),
+                 out_shardings=(sh, sh, None))
+        def superstep(w_in, w_out, srcs, tgts, key, lrs):
+            keys = jax.random.split(key, srcs.shape[0])
+            (w_in, w_out), losses = lax.scan(
+                body, (w_in, w_out), (srcs, tgts, keys, lrs))
+            return w_in, w_out, losses.mean()
+
+        self._superstep = superstep
+
+    # -- data placement ----------------------------------------------------
+
+    def _place(self, srcs: np.ndarray, tgts: np.ndarray):
+        """Shard the pair stream over the data axis (batch dim last-level)."""
+        if srcs.ndim == 2:      # skipgram: [S, B]
+            spec = P(None, core.DATA_AXIS)
+        else:                   # cbow: [S, B, 2w]
+            spec = P(None, core.DATA_AXIS, None)
+        s = jax.device_put(srcs, NamedSharding(self.mesh, spec))
+        t = jax.device_put(tgts, NamedSharding(
+            self.mesh, P(None, core.DATA_AXIS)))
+        return s, t
+
+    # -- training ----------------------------------------------------------
+
+    def _batches(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        c = self.config
+        if c.model == "skipgram":
+            it = self.corpus.skipgram_batches(
+                c.batch_size, window=c.window, seed=c.seed, epochs=c.epochs)
+            # skip-gram trains (center → context): src = center
+            return it
+        return self.corpus.cbow_batches(
+            c.batch_size, window=c.window, seed=c.seed, epochs=c.epochs,
+            pad_id=self._scratch)
+
+    def train(self, total_steps: Optional[int] = None) -> float:
+        """Run the full training loop; returns the final mean loss."""
+        c = self.config
+        d = self.mesh.shape[core.DATA_AXIS]
+        if c.batch_size % d:
+            raise ValueError(f"batch_size {c.batch_size} not divisible by "
+                             f"data-axis size {d}")
+        # linear lr decay over the whole corpus (reference's alpha decay);
+        # skip-gram emits ~2b pairs per center, b ~ U[1, window] -> E = w+1
+        est_pairs = self.corpus.num_tokens * c.epochs * (c.window + 1) \
+            if c.model == "skipgram" else self.corpus.num_tokens * c.epochs
+        est_calls = max(int(est_pairs) //
+                        (c.batch_size * c.steps_per_call), 1)
+        if total_steps is not None:
+            est_calls = max(total_steps // c.steps_per_call, 1)
+
+        srcs_buf, tgts_buf = [], []
+        losses, call_no = [], 0
+        t0 = time.perf_counter()
+        for src, tgt in self._batches():
+            srcs_buf.append(src)
+            tgts_buf.append(tgt)
+            if len(srcs_buf) < c.steps_per_call:
+                continue
+            loss = self._dispatch(np.stack(srcs_buf), np.stack(tgts_buf),
+                                  call_no, est_calls)
+            losses.append(loss)
+            srcs_buf, tgts_buf = [], []
+            call_no += 1
+            if total_steps is not None \
+                    and call_no * c.steps_per_call >= total_steps:
+                break
+        if srcs_buf and total_steps is None:
+            loss = self._dispatch(np.stack(srcs_buf), np.stack(tgts_buf),
+                                  call_no, est_calls)
+            losses.append(loss)
+        self.w_in.wait()
+        dt = time.perf_counter() - t0
+        words = self.corpus.num_tokens * c.epochs
+        dashboard.emit_metric("w2v.words_per_sec", words / dt, "words/s")
+        self.loss_history = [float(l) for l in losses]
+        final = float(np.mean(self.loss_history[-10:])) \
+            if losses else float("nan")
+        log.info("w2v train done: %d calls, loss=%.4f, %.0f words/s",
+                 call_no, final, words / dt)
+        return final
+
+    def _dispatch(self, srcs: np.ndarray, tgts: np.ndarray,
+                  call_no: int, est_calls: int) -> jax.Array:
+        c = self.config
+        s = srcs.shape[0]
+        frac = min(call_no / est_calls, 1.0)
+        lr_hi = c.learning_rate * (1.0 - frac)
+        lr_lo = c.learning_rate * (1.0 - min((call_no + 1) / est_calls, 1.0))
+        floor = c.learning_rate * c.min_lr_frac
+        lrs = np.maximum(np.linspace(lr_hi, lr_lo, s), floor) \
+            .astype(np.float32)
+        key = jax.random.fold_in(self._key, call_no)
+        sd, td = self._place(srcs, tgts)
+        with dashboard.profile("w2v.superstep"):
+            self.w_in.param, self.w_out.param, loss = self._superstep(
+                self.w_in.param, self.w_out.param, sd, td, key,
+                jnp.asarray(lrs))
+        self._step_no += s
+        return loss
+
+    # -- embeddings out / eval --------------------------------------------
+
+    def embeddings(self) -> np.ndarray:
+        """The trained input embeddings [V, D] (the reference saves W_in)."""
+        return self.w_in.get()
+
+    def nearest(self, word_id: int, k: int = 10) -> np.ndarray:
+        """Top-k neighbor ids by cosine similarity (excluding self)."""
+        emb = self.embeddings()
+        norm = emb / np.maximum(
+            np.linalg.norm(emb, axis=1, keepdims=True), 1e-12)
+        sims = norm @ norm[word_id]
+        sims[word_id] = -np.inf
+        return np.argsort(-sims)[:k]
+
+    def similarity(self, a: int, b: int) -> float:
+        emb = self.embeddings()
+        va, vb = emb[a], emb[b]
+        return float(va @ vb / max(np.linalg.norm(va) * np.linalg.norm(vb),
+                                   1e-12))
+
+    def store(self, uri_prefix: str) -> None:
+        self.w_in.store(f"{uri_prefix}.in.npz")
+        self.w_out.store(f"{uri_prefix}.out.npz")
+
+    def load(self, uri_prefix: str) -> None:
+        self.w_in.load(f"{uri_prefix}.in.npz")
+        self.w_out.load(f"{uri_prefix}.out.npz")
+
+
+def main(argv=None) -> None:
+    """CLI mirroring the reference's word2vec-style argv."""
+    from multiverso_tpu.utils import configure
+    configure.define_string("train_file", "", "corpus text file")
+    configure.define_int("size", 100, "embedding dimension")
+    configure.define_int("window", 5, "context window")
+    configure.define_int("negative", 5, "negative samples (0 -> HS)")
+    configure.define_bool("cbow", False, "CBOW instead of skip-gram")
+    configure.define_int("epoch", 1, "epochs")
+    configure.define_int("batch_size", 1024, "pairs per step")
+    configure.define_float("alpha", 0.025, "initial learning rate")
+    configure.define_float("sample", 1e-3, "subsampling threshold")
+    configure.define_int("min_count", 5, "vocab min count")
+    configure.define_string("output_file", "", "embedding checkpoint prefix")
+    core.init(argv)
+    train_file = configure.get_flag("train_file")
+    if not train_file:
+        raise SystemExit("-train_file is required")
+    corpus = Corpus.from_file(train_file,
+                              min_count=configure.get_flag("min_count"),
+                              subsample=configure.get_flag("sample"))
+    neg = configure.get_flag("negative")
+    cfg = W2VConfig(
+        embedding_dim=configure.get_flag("size"),
+        window=configure.get_flag("window"),
+        negative=max(neg, 1),
+        objective="ns" if neg > 0 else "hs",
+        model="cbow" if configure.get_flag("cbow") else "skipgram",
+        batch_size=configure.get_flag("batch_size"),
+        learning_rate=configure.get_flag("alpha"),
+        epochs=configure.get_flag("epoch"),
+        subsample=configure.get_flag("sample"),
+    )
+    app = WordEmbedding(corpus, cfg)
+    app.train()
+    out = configure.get_flag("output_file")
+    if out:
+        app.store(out)
+    core.barrier()
+
+
+if __name__ == "__main__":
+    import sys
+    main(sys.argv[1:])

@@ -85,39 +85,67 @@ class Corpus:
 
     # -- batch iterators ---------------------------------------------------
 
+    def _block_batches(self, example_fn, batch_size: int, epochs: int,
+                       block_tokens: int, prefetch: int
+                       ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Shared block pipeline: cut the corpus into blocks (the
+        reference's DataBlock), run ``example_fn(block, seed)`` per block on
+        a prefetch thread (ASyncBuffer role), carry leftovers across blocks
+        and yield fixed-size batch pairs (static shapes for jit)."""
+
+        def gen():
+            left_a = left_b = None
+            for epoch in range(epochs):
+                for start in range(0, self.num_tokens, block_tokens):
+                    block = self.ids[start:start + block_tokens]
+                    a, b = example_fn(
+                        block, 0x9E3779B9 * (epoch + 1) + start)
+                    if left_a is not None:
+                        a = np.concatenate([left_a, a])
+                        b = np.concatenate([left_b, b])
+                    n_full = (len(b) // batch_size) * batch_size
+                    for i in range(0, n_full, batch_size):
+                        yield a[i:i + batch_size], b[i:i + batch_size]
+                    left_a, left_b = a[n_full:], b[n_full:]
+
+        return prefetch_iterator(gen(), depth=prefetch)
+
     def skipgram_batches(self, batch_size: int, window: int = 5,
                          seed: int = 1, epochs: int = 1,
                          block_tokens: int = 1 << 20,
                          prefetch: int = 2
                          ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-        """Yield fixed-size (centers, contexts) int32 batches.
+        """Yield fixed-size (centers, contexts) int32 batches."""
+        be = backend()
+        kp = self.keep_prob()
 
-        The corpus is cut into blocks (the reference's DataBlock); pair
-        generation per block runs on the backend and is prefetched on a
-        background thread (ASyncBuffer role) while the previous batch
-        trains. Trailing pairs that don't fill a batch are dropped (static
-        shapes for jit).
+        def examples(block, salt):
+            return be.skipgram_pairs(block, window, kp, seed=seed + salt)
+
+        return self._block_batches(examples, batch_size, epochs,
+                                   block_tokens, prefetch)
+
+    def cbow_batches(self, batch_size: int, window: int = 5,
+                     seed: int = 1, epochs: int = 1,
+                     block_tokens: int = 1 << 20, prefetch: int = 2,
+                     pad_id: int = -1
+                     ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Yield fixed-size (contexts [B, 2w], targets [B]) int32 batches.
+
+        Context rows are padded to 2*window with ``pad_id`` (callers pass
+        a scratch-row id so gathers stay in range under jit).
         """
+        be = backend()
+        kp = self.keep_prob()
 
-        def gen():
-            be = backend()
-            kp = self.keep_prob()
-            leftover_c = np.empty(0, np.int32)
-            leftover_x = np.empty(0, np.int32)
-            for epoch in range(epochs):
-                for start in range(0, self.num_tokens, block_tokens):
-                    block = self.ids[start:start + block_tokens]
-                    c, x = be.skipgram_pairs(
-                        block, window, kp,
-                        seed=seed + 0x9E3779B9 * (epoch + 1) + start)
-                    c = np.concatenate([leftover_c, c])
-                    x = np.concatenate([leftover_x, x])
-                    n_full = (len(c) // batch_size) * batch_size
-                    for i in range(0, n_full, batch_size):
-                        yield c[i:i + batch_size], x[i:i + batch_size]
-                    leftover_c, leftover_x = c[n_full:], x[n_full:]
+        def examples(block, salt):
+            ctx, tgt = be.cbow_examples(block, window, kp, seed=seed + salt)
+            if pad_id != -1:
+                ctx = np.where(ctx < 0, pad_id, ctx)
+            return ctx, tgt
 
-        return prefetch_iterator(gen(), depth=prefetch)
+        return self._block_batches(examples, batch_size, epochs,
+                                   block_tokens, prefetch)
 
 
 def synthetic_text(path: str, num_tokens: int = 200_000,

@@ -234,8 +234,7 @@ class Table:
         self.param, self.state = self._apply(self.param, self.state,
                                              delta, opt)
         self._bump_step()
-        handle = Handle(self.param,
-                        fallback=lambda: (self.param, self.state))
+        handle = Handle(self.param, fallback=lambda: self.param)
         if sync:
             handle.wait()
         return handle

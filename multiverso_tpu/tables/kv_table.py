@@ -244,7 +244,7 @@ class KVTable:
             self.default_option.step += 1
         handle = Handle(
             self.values,
-            fallback=lambda: (self.keys, self.values, self.state))
+            fallback=lambda: self.values)
         if sync:
             handle.wait()
         return handle

@@ -181,7 +181,7 @@ class MatrixTable(Table):
                 self.param, self.state, padded, pd, mask, opt)
         self._bump_step()
         handle = Handle(self.param,
-                        fallback=lambda: (self.param, self.state))
+                        fallback=lambda: self.param)
         if sync:
             handle.wait()
         return handle

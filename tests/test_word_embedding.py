@@ -1,0 +1,139 @@
+"""apps/word_embedding: alias sampling, convergence, semantic structure.
+
+Convergence tests mirror the reference's examples-as-system-tests
+(SURVEY.md §5): loss decreases, co-occurring words embed closer.
+"""
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.apps.word_embedding import (W2VConfig, WordEmbedding,
+                                                build_alias)
+from multiverso_tpu.data.corpus import Corpus
+from multiverso_tpu.tables import base as table_base
+
+
+@pytest.fixture(autouse=True)
+def _clean_tables():
+    yield
+    table_base.reset_tables()
+
+
+def _clustered_corpus(tmp_path, n_clusters=8, words_per_cluster=4,
+                      n_sents=600, sent_len=20, seed=0):
+    """Text whose words co-occur only within their cluster — gives the
+    embeddings a recoverable structure to test against."""
+    rng = np.random.default_rng(seed)
+    path = tmp_path / "corpus.txt"
+    with open(path, "w") as f:
+        for _ in range(n_sents):
+            c = rng.integers(n_clusters)
+            ws = rng.integers(0, words_per_cluster, sent_len)
+            f.write(" ".join(f"c{c}w{w}" for w in ws) + "\n")
+    corpus = Corpus.from_file(str(path), min_count=1, subsample=0)
+    cluster_ids = {}
+    for wid, w in enumerate(corpus.words):
+        cluster_ids.setdefault(int(w[1:w.index("w")]), []).append(wid)
+    return corpus, cluster_ids
+
+
+def test_build_alias_distribution():
+    rng = np.random.default_rng(0)
+    probs = rng.random(50)
+    probs /= probs.sum()
+    prob, alias = build_alias(probs)
+    # emulate sampling exactly as the device does, in numpy
+    n = 200_000
+    j = rng.integers(0, 50, n)
+    u = rng.random(n)
+    out = np.where(u < prob[j], j, alias[j])
+    emp = np.bincount(out, minlength=50) / n
+    np.testing.assert_allclose(emp, probs, atol=0.005)
+
+
+def test_build_alias_degenerate():
+    prob, alias = build_alias(np.array([1.0]))
+    assert prob[0] == 1.0
+
+
+@pytest.mark.parametrize("model,objective", [
+    ("skipgram", "ns"), ("skipgram", "hs"),
+    ("cbow", "ns"), ("cbow", "hs"),
+])
+def test_variants_loss_decreases(mesh_dp8, tmp_path, model, objective):
+    corpus, _ = _clustered_corpus(tmp_path, n_sents=300)
+    cfg = W2VConfig(embedding_dim=16, window=3, negative=4, model=model,
+                    objective=objective, batch_size=256, steps_per_call=4,
+                    learning_rate=0.05, epochs=1, subsample=0, seed=1)
+    app = WordEmbedding(corpus, cfg, mesh=mesh_dp8,
+                        name=f"w2v_{model}_{objective}")
+    app.train()
+    hist = app.loss_history
+    assert len(hist) >= 6 and np.all(np.isfinite(hist))
+    early = np.mean(hist[:3])
+    late = np.mean(hist[-3:])
+    assert late < early, f"loss did not decrease: {early:.3f} -> {late:.3f}"
+
+
+def test_skipgram_recovers_clusters(mesh_dp8, tmp_path):
+    corpus, clusters = _clustered_corpus(tmp_path, n_sents=800, seed=3)
+    cfg = W2VConfig(embedding_dim=24, window=3, negative=5,
+                    batch_size=256, steps_per_call=4,
+                    learning_rate=0.03, epochs=3, subsample=0, seed=2)
+    app = WordEmbedding(corpus, cfg, mesh=mesh_dp8, name="w2v_clusters")
+    app.train()
+    emb = app.embeddings()
+    norm = emb / np.maximum(np.linalg.norm(emb, axis=1, keepdims=True),
+                            1e-12)
+    sims = norm @ norm.T
+    intra, inter = [], []
+    ids = list(clusters.values())
+    for ci, members in enumerate(ids):
+        for i in members:
+            for j in members:
+                if i < j:
+                    intra.append(sims[i, j])
+            for other in ids[ci + 1:]:
+                for j in other:
+                    inter.append(sims[i, j])
+    assert np.mean(intra) > np.mean(inter) + 0.2, \
+        f"intra {np.mean(intra):.3f} vs inter {np.mean(inter):.3f}"
+
+
+def test_nearest_is_same_cluster(mesh_dp8, tmp_path):
+    corpus, clusters = _clustered_corpus(tmp_path, n_sents=800, seed=4)
+    cfg = W2VConfig(embedding_dim=24, window=3, negative=5,
+                    batch_size=256, steps_per_call=4,
+                    learning_rate=0.03, epochs=3, subsample=0, seed=5)
+    app = WordEmbedding(corpus, cfg, mesh=mesh_dp8, name="w2v_nn")
+    app.train()
+    hits = 0
+    total = 0
+    for members in clusters.values():
+        for wid in members:
+            nn = app.nearest(wid, k=len(members) - 1)
+            hits += len(set(nn) & set(members))
+            total += len(members) - 1
+    assert hits / total > 0.5, f"nearest-neighbor cluster hit rate " \
+                               f"{hits}/{total}"
+
+
+def test_store_load_roundtrip(mesh_dp8, tmp_path):
+    corpus, _ = _clustered_corpus(tmp_path, n_sents=200, seed=6)
+    cfg = W2VConfig(embedding_dim=8, window=2, negative=2, batch_size=256,
+                    steps_per_call=2, epochs=1, subsample=0)
+    app = WordEmbedding(corpus, cfg, mesh=mesh_dp8, name="w2v_ckpt")
+    app.train()
+    emb = app.embeddings()
+    app.store(f"file://{tmp_path}/w2v")
+    app2 = WordEmbedding(corpus, cfg, mesh=mesh_dp8, name="w2v_ckpt2")
+    app2.load(f"file://{tmp_path}/w2v")
+    np.testing.assert_allclose(app2.embeddings(), emb, rtol=1e-6)
+
+
+def test_batch_size_must_divide_mesh(mesh_dp8, tmp_path):
+    corpus, _ = _clustered_corpus(tmp_path, n_sents=100, seed=7)
+    cfg = W2VConfig(embedding_dim=8, batch_size=100)  # 100 % 8 != 0
+    app = WordEmbedding(corpus, cfg, mesh=mesh_dp8, name="w2v_bad")
+    with pytest.raises(ValueError, match="divisible"):
+        app.train()
