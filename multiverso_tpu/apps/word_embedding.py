@@ -111,6 +111,9 @@ class WordEmbedding:
         self.config = config
         self.mesh = mesh if mesh is not None else core.mesh()
         c = config
+        # the config owns the subsampling threshold (word2vec's -sample);
+        # push it into the corpus so the two can't silently disagree
+        corpus.set_subsample(c.subsample)
         v, d = corpus.vocab_size, c.embedding_dim
         rng = np.random.default_rng(c.seed)
         # reference init: input embeddings ~ U(-0.5/dim, 0.5/dim), output 0
@@ -290,10 +293,9 @@ class WordEmbedding:
             if total_steps is not None \
                     and call_no * c.steps_per_call >= total_steps:
                 break
-        if srcs_buf and total_steps is None:
-            loss = self._dispatch(np.stack(srcs_buf), np.stack(tgts_buf),
-                                  call_no, est_calls)
-            losses.append(loss)
+        # trailing partial buffer is dropped (like per-batch remainders):
+        # a shorter scan length would force a full XLA recompile for one
+        # leftover call's worth of pairs
         self.w_in.wait()
         dt = time.perf_counter() - t0
         words = self.corpus.num_tokens * c.epochs

@@ -33,6 +33,11 @@ class Corpus:
         self._keep_prob: Optional[np.ndarray] = None
         self._unigram: Optional[Tuple[float, np.ndarray]] = None
 
+    def set_subsample(self, subsample: float) -> None:
+        """Change the subsampling threshold (drops the keep-prob cache)."""
+        self.subsample = subsample
+        self._keep_prob = None
+
     @classmethod
     def from_file(cls, path: str, min_count: int = 5,
                   subsample: float = 1e-3) -> "Corpus":
@@ -128,19 +133,21 @@ class Corpus:
     def cbow_batches(self, batch_size: int, window: int = 5,
                      seed: int = 1, epochs: int = 1,
                      block_tokens: int = 1 << 20, prefetch: int = 2,
-                     pad_id: int = -1
+                     pad_id: Optional[int] = None
                      ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         """Yield fixed-size (contexts [B, 2w], targets [B]) int32 batches.
 
-        Context rows are padded to 2*window with ``pad_id`` (callers pass
-        a scratch-row id so gathers stay in range under jit).
+        Context rows are padded to 2*window with ``pad_id`` (pass a
+        scratch-row id so jit gathers stay in range — JAX silently clips
+        negative indices). ``pad_id=None`` keeps the raw -1 sentinels for
+        numpy consumers that mask explicitly.
         """
         be = backend()
         kp = self.keep_prob()
 
         def examples(block, salt):
             ctx, tgt = be.cbow_examples(block, window, kp, seed=seed + salt)
-            if pad_id != -1:
+            if pad_id is not None:
                 ctx = np.where(ctx < 0, pad_id, ctx)
             return ctx, tgt
 

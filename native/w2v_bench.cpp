@@ -1,0 +1,172 @@
+// CPU baseline for the word2vec benchmark: a faithful re-implementation of
+// the reference trainer's hot loop (SURVEY.md §4.5 — per-pair dot /
+// sigmoid / axpy scalar SGD on local embedding rows, negative sampling via
+// a unigram table), measured in words/sec on one CPU worker.
+//
+// This is the measurement the ≥8×-vs-16-CPU-workers north star
+// (BASELINE.json) is scored against, since the reference itself is not
+// runnable in this container (SURVEY.md §0). Build: make w2v_bench.
+// Output: one JSON line {"words_per_sec": N, ...}.
+
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <random>
+#include <vector>
+
+namespace {
+
+constexpr int kExpTableSize = 1000;
+constexpr float kMaxExp = 6.0f;
+constexpr int kUnigramTableSize = 10'000'000;
+
+struct Params {
+  int vocab = 10000;
+  long tokens = 400'000;
+  int dim = 100;
+  int window = 5;
+  int negative = 5;
+  float alpha = 0.025f;
+  double sample = 1e-3;  // subsampling threshold (0 disables)
+  uint64_t seed = 1;
+};
+
+// word2vec.c-style sigmoid lookup table (the reference app uses the same
+// precomputed-exp trick in its Trainer).
+std::vector<float> BuildExpTable() {
+  std::vector<float> t(kExpTableSize);
+  for (int i = 0; i < kExpTableSize; ++i) {
+    float e = std::exp((i / static_cast<float>(kExpTableSize) * 2 - 1) *
+                       kMaxExp);
+    t[i] = e / (e + 1.0f);
+  }
+  return t;
+}
+
+inline float Sigmoid(const std::vector<float>& table, float x) {
+  if (x >= kMaxExp) return 1.0f;
+  if (x < -kMaxExp) return 0.0f;
+  int i = static_cast<int>((x + kMaxExp) *
+                           (kExpTableSize / kMaxExp / 2.0f));
+  if (i >= kExpTableSize) i = kExpTableSize - 1;  // float rounding guard
+  return table[static_cast<size_t>(i)];
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Params p;
+  for (int i = 1; i + 1 < argc; i += 2) {
+    std::string k = argv[i];
+    long v = std::atol(argv[i + 1]);
+    if (k == "-vocab") p.vocab = static_cast<int>(v);
+    else if (k == "-tokens") p.tokens = v;
+    else if (k == "-dim") p.dim = static_cast<int>(v);
+    else if (k == "-window") p.window = static_cast<int>(v);
+    else if (k == "-negative") p.negative = static_cast<int>(v);
+    else if (k == "-seed") p.seed = static_cast<uint64_t>(v);
+    else if (k == "-sample_off") { p.sample = 0.0; i -= 1; }
+  }
+
+  std::mt19937_64 rng(p.seed);
+  // zipf-ish corpus (matches multiverso_tpu.data.corpus.synthetic_text)
+  std::vector<int> ids(static_cast<size_t>(p.tokens));
+  {
+    std::vector<double> w(static_cast<size_t>(p.vocab));
+    double sum = 0;
+    for (int i = 0; i < p.vocab; ++i) { w[static_cast<size_t>(i)] = 1.0 / std::pow(i + 1, 1.2); sum += w[static_cast<size_t>(i)]; }
+    std::discrete_distribution<int> dist(w.begin(), w.end());
+    for (auto& t : ids) t = dist(rng);
+  }
+
+  // unigram^0.75 negative-sampling table (reference/word2vec.c layout)
+  std::vector<int> unigram(kUnigramTableSize);
+  {
+    std::vector<long> counts(static_cast<size_t>(p.vocab), 0);
+    for (int t : ids) counts[static_cast<size_t>(t)]++;
+    double total = 0;
+    for (long c : counts) total += std::pow(static_cast<double>(c), 0.75);
+    int w = 0;
+    double cum = std::pow(static_cast<double>(counts[0]), 0.75) / total;
+    for (int i = 0; i < kUnigramTableSize; ++i) {
+      unigram[static_cast<size_t>(i)] = w;
+      if (i / static_cast<double>(kUnigramTableSize) > cum && w < p.vocab - 1) {
+        ++w;
+        cum += std::pow(static_cast<double>(counts[static_cast<size_t>(w)]), 0.75) / total;
+      }
+    }
+  }
+
+  const int D = p.dim;
+  std::vector<float> syn0(static_cast<size_t>(p.vocab) * static_cast<size_t>(D));
+  std::vector<float> syn1(static_cast<size_t>(p.vocab) * static_cast<size_t>(D), 0.0f);
+  std::uniform_real_distribution<float> uinit(-0.5f / static_cast<float>(D), 0.5f / static_cast<float>(D));
+  for (auto& x : syn0) x = uinit(rng);
+
+  std::vector<float> exp_table = BuildExpTable();
+  std::vector<float> neu1e(static_cast<size_t>(D));
+  std::uniform_int_distribution<int> uwin(1, p.window);
+  std::uniform_int_distribution<int> utab(0, kUnigramTableSize - 1);
+
+  auto t0 = std::chrono::steady_clock::now();
+  // subsample frequent words exactly like the python pipeline
+  // (keep = min(1, sqrt(t/f) + t/f)); words/sec still counts raw tokens
+  std::vector<int> kept_ids;
+  if (p.sample > 0) {
+    std::vector<long> counts(static_cast<size_t>(p.vocab), 0);
+    for (int t : ids) counts[static_cast<size_t>(t)]++;
+    std::vector<float> keep(static_cast<size_t>(p.vocab));
+    for (int w = 0; w < p.vocab; ++w) {
+      double f = counts[static_cast<size_t>(w)] / static_cast<double>(p.tokens);
+      double kp = f > 0 ? std::sqrt(p.sample / f) + p.sample / f : 1.0;
+      keep[static_cast<size_t>(w)] = static_cast<float>(kp < 1.0 ? kp : 1.0);
+    }
+    std::uniform_real_distribution<float> ur(0.0f, 1.0f);
+    kept_ids.reserve(ids.size());
+    for (int t : ids)
+      if (ur(rng) < keep[static_cast<size_t>(t)]) kept_ids.push_back(t);
+  } else {
+    kept_ids = ids;
+  }
+  long pairs = 0;
+  const long n = static_cast<long>(kept_ids.size());
+  std::swap(ids, kept_ids);
+  for (long pos = 0; pos < n; ++pos) {
+    int b = uwin(rng);
+    for (long c = pos - b; c <= pos + b; ++c) {
+      if (c == pos || c < 0 || c >= n) continue;
+      // skip-gram: predict context from center; hot loop identical in
+      // structure to the reference Trainer's TrainSample
+      float* v = &syn0[static_cast<size_t>(ids[static_cast<size_t>(pos)]) * static_cast<size_t>(D)];
+      for (int d = 0; d < D; ++d) neu1e[static_cast<size_t>(d)] = 0.0f;
+      for (int k = 0; k <= p.negative; ++k) {
+        int target;
+        float label;
+        if (k == 0) { target = ids[static_cast<size_t>(c)]; label = 1.0f; }
+        else { target = unigram[static_cast<size_t>(utab(rng))]; label = 0.0f; }
+        float* u = &syn1[static_cast<size_t>(target) * static_cast<size_t>(D)];
+        float dot = 0.0f;
+        for (int d = 0; d < D; ++d) dot += v[d] * u[d];
+        float g = (label - Sigmoid(exp_table, dot)) * p.alpha;
+        for (int d = 0; d < D; ++d) neu1e[static_cast<size_t>(d)] += g * u[d];
+        for (int d = 0; d < D; ++d) u[d] += g * v[d];
+      }
+      for (int d = 0; d < D; ++d) v[d] += neu1e[static_cast<size_t>(d)];
+      ++pairs;
+    }
+  }
+  auto t1 = std::chrono::steady_clock::now();
+  double secs = std::chrono::duration<double>(t1 - t0).count();
+  // guard against the optimizer deleting the training loop
+  volatile float sink = syn0[0] + syn1[static_cast<size_t>(p.vocab) * static_cast<size_t>(D) - 1];
+  (void)sink;
+  std::printf(
+      "{\"words_per_sec\": %.1f, \"pairs_per_sec\": %.1f, \"tokens\": %ld, "
+      "\"kept_tokens\": %ld, \"pairs\": %ld, \"secs\": %.3f, \"dim\": %d, "
+      "\"window\": %d, \"negative\": %d, \"vocab\": %d, \"sample\": %g}\n",
+      static_cast<double>(p.tokens) / secs, static_cast<double>(pairs) / secs,
+      p.tokens, n, pairs, secs, D, p.window, p.negative, p.vocab, p.sample);
+  return 0;
+}

@@ -61,7 +61,10 @@ def test_build_alias_degenerate():
     ("cbow", "ns"), ("cbow", "hs"),
 ])
 def test_variants_loss_decreases(mesh_dp8, tmp_path, model, objective):
-    corpus, _ = _clustered_corpus(tmp_path, n_sents=300)
+    # cbow yields ~1 example/token vs skip-gram's ~6 pairs; size the
+    # corpus so both produce >= 6 full superstep calls
+    corpus, _ = _clustered_corpus(
+        tmp_path, n_sents=300 if model == "skipgram" else 600)
     cfg = W2VConfig(embedding_dim=16, window=3, negative=4, model=model,
                     objective=objective, batch_size=256, steps_per_call=4,
                     learning_rate=0.05, epochs=1, subsample=0, seed=1)
