@@ -82,14 +82,11 @@ def main() -> None:
     need_calls = WARMUP_CALLS + TIMED_CALLS
     calls = []
     buf_s, buf_t = [], []
-    tokens_consumed_per_epoch = corpus.num_tokens
-    pairs_total = 0
     it = corpus.skipgram_batches(BATCH, window=WINDOW, seed=1,
                                  epochs=need_calls)  # replay as needed
     for src, tgt in it:
         buf_s.append(src)
         buf_t.append(tgt)
-        pairs_total += len(src)
         if len(buf_s) == STEPS_PER_CALL:
             calls.append(app._place(np.stack(buf_s), np.stack(buf_t)))
             buf_s, buf_t = [], []

@@ -27,25 +27,36 @@ import os
 import platform
 import subprocess
 import sys
+import tempfile
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 OUT = os.path.join(HERE, "baseline_cpu.json")
 
-# must match bench.py's corpus/model config for an apples-to-apples run
-BENCH_ARGS = ["-vocab", "10000", "-tokens", "400000", "-dim", "100",
-              "-window", "5", "-negative", "5", "-seed", "1"]
+sys.path.insert(0, REPO)
+import bench  # noqa: E402  — single source of the shared bench config
 
 
 def measure(repeats: int = 3) -> dict:
     subprocess.run(["make", "-C", os.path.join(REPO, "native"),
                     "w2v_bench"], check=True, capture_output=True)
     binary = os.path.join(REPO, "native", "build", "w2v_bench")
+    # train on the IDENTICAL corpus file bench.py uses (same generator,
+    # same params, same seed) — apples-to-apples by construction
+    from multiverso_tpu.data.corpus import synthetic_text
     runs = []
-    for _ in range(repeats):
-        out = subprocess.run([binary] + BENCH_ARGS, check=True,
-                             capture_output=True, text=True).stdout
-        runs.append(json.loads(out))
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "corpus.txt")
+        synthetic_text(path, num_tokens=bench.TOKENS,
+                       vocab_size=bench.VOCAB, seed=1)
+        args = [binary, "-corpus", path, "-dim", str(bench.DIM),
+                "-window", str(bench.WINDOW),
+                "-negative", str(bench.NEGATIVE),
+                "-alpha", str(bench.LR), "-seed", "1"]
+        for _ in range(repeats):
+            out = subprocess.run(args, check=True, capture_output=True,
+                                 text=True).stdout
+            runs.append(json.loads(out))
     best = max(runs, key=lambda r: r["words_per_sec"])
     return {
         "metric": "word2vec words/sec (one CPU worker)",

@@ -64,7 +64,7 @@ class W2VConfig:
     learning_rate: float = 0.025
     min_lr_frac: float = 1e-4   # linear decay floor (lr * frac)
     epochs: int = 1
-    subsample: float = 1e-3
+    subsample: Optional[float] = None   # None -> keep the corpus's setting
     unigram_power: float = 0.75
     max_code_len: int = 40      # HS: Huffman code pad length
     seed: int = 0
@@ -111,9 +111,10 @@ class WordEmbedding:
         self.config = config
         self.mesh = mesh if mesh is not None else core.mesh()
         c = config
-        # the config owns the subsampling threshold (word2vec's -sample);
-        # push it into the corpus so the two can't silently disagree
-        corpus.set_subsample(c.subsample)
+        # an explicit config subsample (word2vec's -sample) overrides the
+        # corpus's; None defers to whatever the corpus was built with
+        if c.subsample is not None:
+            corpus.set_subsample(c.subsample)
         v, d = corpus.vocab_size, c.embedding_dim
         rng = np.random.default_rng(c.seed)
         # reference init: input embeddings ~ U(-0.5/dim, 0.5/dim), output 0
@@ -293,9 +294,22 @@ class WordEmbedding:
             if total_steps is not None \
                     and call_no * c.steps_per_call >= total_steps:
                 break
-        # trailing partial buffer is dropped (like per-batch remainders):
-        # a shorter scan length would force a full XLA recompile for one
-        # leftover call's worth of pairs
+        if call_no == 0 and srcs_buf:
+            # corpus smaller than one superstep: pad by cycling the
+            # buffered batches to the static scan length (slight pair
+            # over-weighting beats training nothing / a full recompile)
+            log.warn("w2v corpus yields < %d batches; cycling %d to fill "
+                     "one superstep", c.steps_per_call, len(srcs_buf))
+            reps = [srcs_buf[i % len(srcs_buf)]
+                    for i in range(c.steps_per_call)]
+            rept = [tgts_buf[i % len(tgts_buf)]
+                    for i in range(c.steps_per_call)]
+            losses.append(self._dispatch(np.stack(reps), np.stack(rept),
+                                         0, est_calls))
+            call_no = 1
+        # trailing partial buffer is otherwise dropped (like per-batch
+        # remainders): a shorter scan length would force a full XLA
+        # recompile for one leftover call's worth of pairs
         self.w_in.wait()
         dt = time.perf_counter() - t0
         words = self.corpus.num_tokens * c.epochs

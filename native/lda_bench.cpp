@@ -1,0 +1,228 @@
+// CPU baseline for the LightLDA benchmark: a faithful single-worker
+// implementation of the reference sampler (SURVEY.md §3.6 — LightLDA's
+// O(1)-per-token Metropolis-Hastings with alias tables: word-proposal
+// alias tables rebuilt per sweep, O(1) doc-proposal via the z-array
+// trick, 2-step MH), measured in doc-tokens/sec.
+//
+// Like w2v_bench.cpp this exists because the reference is unrunnable in
+// this container (SURVEY.md §0); the ≥8×-vs-16-CPU-workers north star is
+// scored against 16 × this single-worker number (perfect-scaling
+// assumption, generous to the reference).
+//
+// Build: make lda_bench. Output: one JSON line.
+
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <algorithm>
+#include <random>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Params {
+  int vocab = 50000;
+  int docs = 20000;
+  long tokens = 2'000'000;
+  int topics = 1000;
+  int sweeps = 3;
+  int mh_steps = 2;
+  double beta = 0.01;
+  double alpha = -1.0;  // <0 -> 50/K
+  uint64_t seed = 1;
+};
+
+// Vose alias table over K outcomes.
+struct Alias {
+  std::vector<float> prob;
+  std::vector<int32_t> alias;
+  float total = 0.0f;  // unnormalized mass (for proposal densities)
+};
+
+void BuildAlias(const std::vector<double>& w, Alias* out) {
+  const int k = static_cast<int>(w.size());
+  out->prob.resize(static_cast<size_t>(k));
+  out->alias.resize(static_cast<size_t>(k));
+  double total = 0;
+  for (double x : w) total += x;
+  out->total = static_cast<float>(total);
+  std::vector<int> small, large;
+  std::vector<double> scaled(static_cast<size_t>(k));
+  small.reserve(static_cast<size_t>(k));
+  large.reserve(static_cast<size_t>(k));
+  for (int i = 0; i < k; ++i) {
+    scaled[static_cast<size_t>(i)] = w[static_cast<size_t>(i)] * k / total;
+    (scaled[static_cast<size_t>(i)] < 1.0 ? small : large).push_back(i);
+  }
+  while (!small.empty() && !large.empty()) {
+    int s = small.back(); small.pop_back();
+    int l = large.back(); large.pop_back();
+    out->prob[static_cast<size_t>(s)] = static_cast<float>(scaled[static_cast<size_t>(s)]);
+    out->alias[static_cast<size_t>(s)] = l;
+    scaled[static_cast<size_t>(l)] -= 1.0 - scaled[static_cast<size_t>(s)];
+    (scaled[static_cast<size_t>(l)] < 1.0 ? small : large).push_back(l);
+  }
+  for (int i : large) out->prob[static_cast<size_t>(i)] = 1.0f;
+  for (int i : small) out->prob[static_cast<size_t>(i)] = 1.0f;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Params p;
+  for (int i = 1; i + 1 < argc; i += 2) {
+    std::string k = argv[i];
+    if (k == "-alpha") { p.alpha = std::atof(argv[i + 1]); continue; }
+    if (k == "-beta") { p.beta = std::atof(argv[i + 1]); continue; }
+    long v = std::atol(argv[i + 1]);
+    if (k == "-vocab") p.vocab = static_cast<int>(v);
+    else if (k == "-docs") p.docs = static_cast<int>(v);
+    else if (k == "-tokens") p.tokens = v;
+    else if (k == "-topics") p.topics = static_cast<int>(v);
+    else if (k == "-sweeps") p.sweeps = static_cast<int>(v);
+    else if (k == "-mh_steps") p.mh_steps = static_cast<int>(v);
+    else if (k == "-seed") p.seed = static_cast<uint64_t>(v);
+  }
+  const int V = p.vocab, D = p.docs, K = p.topics;
+  const long T = p.tokens;
+  const double alpha = p.alpha > 0 ? p.alpha : 50.0 / K;
+  const double beta = p.beta, vbeta = V * beta;
+
+  std::mt19937_64 rng(p.seed);
+  // zipf-ish corpus grouped by doc (same shape as the TPU bench's
+  // synthetic stream)
+  std::vector<int32_t> tw(static_cast<size_t>(T)), td(static_cast<size_t>(T));
+  {
+    std::vector<double> w(static_cast<size_t>(V));
+    for (int i = 0; i < V; ++i) w[static_cast<size_t>(i)] = 1.0 / std::pow(i + 1, 1.1);
+    std::discrete_distribution<int> dist(w.begin(), w.end());
+    std::uniform_int_distribution<int> ud(0, D - 1);
+    for (long i = 0; i < T; ++i) tw[static_cast<size_t>(i)] = dist(rng);
+    for (long i = 0; i < T; ++i) td[static_cast<size_t>(i)] = ud(rng);
+    std::sort(td.begin(), td.end());
+  }
+  // doc ranges (td sorted)
+  std::vector<long> doc_start(static_cast<size_t>(D) + 1, 0);
+  for (long i = 0; i < T; ++i) doc_start[static_cast<size_t>(td[static_cast<size_t>(i)]) + 1]++;
+  for (int d = 0; d < D; ++d) doc_start[static_cast<size_t>(d) + 1] += doc_start[static_cast<size_t>(d)];
+
+  // init
+  std::vector<int32_t> z(static_cast<size_t>(T));
+  std::vector<int32_t> nwk(static_cast<size_t>(V) * static_cast<size_t>(K), 0);
+  std::vector<int32_t> ndk(static_cast<size_t>(D) * static_cast<size_t>(K), 0);
+  std::vector<int32_t> nk(static_cast<size_t>(K), 0);
+  {
+    std::uniform_int_distribution<int> uk(0, K - 1);
+    for (long i = 0; i < T; ++i) {
+      int k = uk(rng);
+      z[static_cast<size_t>(i)] = k;
+      nwk[static_cast<size_t>(tw[static_cast<size_t>(i)]) * static_cast<size_t>(K) + static_cast<size_t>(k)]++;
+      ndk[static_cast<size_t>(td[static_cast<size_t>(i)]) * static_cast<size_t>(K) + static_cast<size_t>(k)]++;
+      nk[static_cast<size_t>(k)]++;
+    }
+  }
+
+  std::uniform_real_distribution<float> ur(0.0f, 1.0f);
+  std::uniform_int_distribution<int> uk(0, K - 1);
+  std::vector<Alias> word_alias(static_cast<size_t>(V));
+  std::vector<double> wbuf(static_cast<size_t>(K));
+
+  auto posterior = [&](long i, int k) -> double {
+    // p(z_i = k | rest) with token i removed, unnormalized
+    const int w = tw[static_cast<size_t>(i)], d = td[static_cast<size_t>(i)];
+    const int self = (z[static_cast<size_t>(i)] == k) ? 1 : 0;
+    return (ndk[static_cast<size_t>(d) * static_cast<size_t>(K) + static_cast<size_t>(k)] - self + alpha) *
+           (nwk[static_cast<size_t>(w) * static_cast<size_t>(K) + static_cast<size_t>(k)] - self + beta) /
+           (nk[static_cast<size_t>(k)] - self + vbeta);
+  };
+
+  auto t0 = std::chrono::steady_clock::now();
+  for (int sweep = 0; sweep < p.sweeps; ++sweep) {
+    // rebuild the stale word-proposal alias tables (per-slice in the
+    // reference; per-sweep here)
+    for (int w = 0; w < V; ++w) {
+      for (int k = 0; k < K; ++k)
+        wbuf[static_cast<size_t>(k)] = nwk[static_cast<size_t>(w) * static_cast<size_t>(K) + static_cast<size_t>(k)] + beta;
+      BuildAlias(wbuf, &word_alias[static_cast<size_t>(w)]);
+    }
+    for (long i = 0; i < T; ++i) {
+      const int w = tw[static_cast<size_t>(i)], d = td[static_cast<size_t>(i)];
+      const long dlo = doc_start[static_cast<size_t>(d)], dhi = doc_start[static_cast<size_t>(d) + 1];
+      const double dlen = static_cast<double>(dhi - dlo);
+      int cur = z[static_cast<size_t>(i)];
+      for (int mh = 0; mh < p.mh_steps; ++mh) {
+        // --- word proposal (stale alias) ---
+        {
+          const Alias& a = word_alias[static_cast<size_t>(w)];
+          int j = uk(rng);
+          int prop = (ur(rng) < a.prob[static_cast<size_t>(j)]) ? j : a.alias[static_cast<size_t>(j)];
+          if (prop != cur) {
+            // q_w is the stale table's density; it cancels only
+            // approximately, so apply the full MH ratio
+            const double qn = nwk[static_cast<size_t>(w) * static_cast<size_t>(K) + static_cast<size_t>(prop)] + beta;
+            const double qo = nwk[static_cast<size_t>(w) * static_cast<size_t>(K) + static_cast<size_t>(cur)] + beta;
+            const double pi = posterior(i, prop) * qo /
+                              (posterior(i, cur) * qn);
+            if (ur(rng) < pi) cur = prop;
+          }
+        }
+        // --- doc proposal (O(1) via the z-array trick) ---
+        {
+          int prop;
+          const double pa = K * alpha / (dlen + K * alpha);
+          if (ur(rng) < pa) {
+            prop = uk(rng);
+          } else {
+            long j = dlo + static_cast<long>(ur(rng) * dlen);
+            if (j >= dhi) j = dhi - 1;
+            prop = z[static_cast<size_t>(j)];
+          }
+          if (prop != cur) {
+            const double qn = ndk[static_cast<size_t>(d) * static_cast<size_t>(K) + static_cast<size_t>(prop)] + alpha;
+            const double qo = ndk[static_cast<size_t>(d) * static_cast<size_t>(K) + static_cast<size_t>(cur)] + alpha;
+            const double pi = posterior(i, prop) * qo /
+                              (posterior(i, cur) * qn);
+            if (ur(rng) < pi) cur = prop;
+          }
+        }
+      }
+      if (cur != z[static_cast<size_t>(i)]) {
+        const int old = z[static_cast<size_t>(i)];
+        nwk[static_cast<size_t>(w) * static_cast<size_t>(K) + static_cast<size_t>(old)]--;
+        ndk[static_cast<size_t>(d) * static_cast<size_t>(K) + static_cast<size_t>(old)]--;
+        nk[static_cast<size_t>(old)]--;
+        nwk[static_cast<size_t>(w) * static_cast<size_t>(K) + static_cast<size_t>(cur)]++;
+        ndk[static_cast<size_t>(d) * static_cast<size_t>(K) + static_cast<size_t>(cur)]++;
+        nk[static_cast<size_t>(cur)]++;
+        z[static_cast<size_t>(i)] = cur;
+      }
+    }
+  }
+  auto t1 = std::chrono::steady_clock::now();
+  double secs = std::chrono::duration<double>(t1 - t0).count();
+
+  // model log-likelihood (point estimate), to show sampling is real
+  double ll = 0;
+  for (long i = 0; i < T; i += 97) {  // subsample tokens for speed
+    const int w = tw[static_cast<size_t>(i)], d = td[static_cast<size_t>(i)];
+    const long dlen = doc_start[static_cast<size_t>(d) + 1] - doc_start[static_cast<size_t>(d)];
+    double s = 0;
+    for (int k = 0; k < K; ++k) {
+      s += (ndk[static_cast<size_t>(d) * static_cast<size_t>(K) + static_cast<size_t>(k)] + alpha) / (dlen + K * alpha) *
+           (nwk[static_cast<size_t>(w) * static_cast<size_t>(K) + static_cast<size_t>(k)] + beta) / (nk[static_cast<size_t>(k)] + vbeta);
+    }
+    ll += std::log(s);
+  }
+  ll /= static_cast<double>((T + 96) / 97);
+
+  std::printf(
+      "{\"doc_tokens_per_sec\": %.1f, \"tokens\": %ld, \"sweeps\": %d, "
+      "\"secs\": %.3f, \"topics\": %d, \"vocab\": %d, \"docs\": %d, "
+      "\"mh_steps\": %d, \"loglik\": %.4f}\n",
+      static_cast<double>(T) * p.sweeps / secs, T, p.sweeps, secs, K, V, D,
+      p.mh_steps, ll);
+  return 0;
+}

@@ -13,7 +13,10 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
+#include <fstream>
 #include <random>
+#include <string>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -58,25 +61,49 @@ inline float Sigmoid(const std::vector<float>& table, float x) {
 
 int main(int argc, char** argv) {
   Params p;
-  for (int i = 1; i + 1 < argc; i += 2) {
+  std::string corpus_path;
+  for (int i = 1; i < argc; ++i) {
     std::string k = argv[i];
-    long v = std::atol(argv[i + 1]);
+    if (k == "-sample_off") { p.sample = 0.0; continue; }  // no operand
+    if (i + 1 >= argc) break;
+    if (k == "-corpus") { corpus_path = argv[++i]; continue; }
+    if (k == "-alpha") { p.alpha = std::atof(argv[++i]); continue; }
+    long v = std::atol(argv[++i]);
     if (k == "-vocab") p.vocab = static_cast<int>(v);
     else if (k == "-tokens") p.tokens = v;
     else if (k == "-dim") p.dim = static_cast<int>(v);
     else if (k == "-window") p.window = static_cast<int>(v);
     else if (k == "-negative") p.negative = static_cast<int>(v);
     else if (k == "-seed") p.seed = static_cast<uint64_t>(v);
-    else if (k == "-sample_off") { p.sample = 0.0; i -= 1; }
   }
 
   std::mt19937_64 rng(p.seed);
-  // zipf-ish corpus (matches multiverso_tpu.data.corpus.synthetic_text)
-  std::vector<int> ids(static_cast<size_t>(p.tokens));
-  {
+  std::vector<int> ids;
+  if (!corpus_path.empty()) {
+    // read the SAME text file the TPU bench trains on, so the two
+    // benches' corpora are identical by construction
+    std::ifstream f(corpus_path);
+    if (!f) { std::fprintf(stderr, "cannot open %s\n", corpus_path.c_str()); return 1; }
+    std::unordered_map<std::string, int> vocab_map;
+    std::string tok;
+    while (f >> tok) {
+      auto it = vocab_map.find(tok);
+      int id;
+      if (it == vocab_map.end()) {
+        id = static_cast<int>(vocab_map.size());
+        vocab_map.emplace(tok, id);
+      } else {
+        id = it->second;
+      }
+      ids.push_back(id);
+    }
+    p.vocab = static_cast<int>(vocab_map.size());
+    p.tokens = static_cast<long>(ids.size());
+  } else {
+    // synthetic fallback: zipf-ish corpus
+    ids.resize(static_cast<size_t>(p.tokens));
     std::vector<double> w(static_cast<size_t>(p.vocab));
-    double sum = 0;
-    for (int i = 0; i < p.vocab; ++i) { w[static_cast<size_t>(i)] = 1.0 / std::pow(i + 1, 1.2); sum += w[static_cast<size_t>(i)]; }
+    for (int i = 0; i < p.vocab; ++i) w[static_cast<size_t>(i)] = 1.0 / std::pow(i + 1, 1.2);
     std::discrete_distribution<int> dist(w.begin(), w.end());
     for (auto& t : ids) t = dist(rng);
   }

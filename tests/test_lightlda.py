@@ -1,0 +1,156 @@
+"""apps/lightlda: parsing, count invariants, convergence vs a sequential
+numpy collapsed-Gibbs oracle (the strongest correctness check: the
+batch-parallel TPU sampler must mix like sequential Gibbs)."""
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.apps.lightlda import LDAConfig, LightLDA, load_docs
+from multiverso_tpu.data.corpus import synthetic_docs
+from multiverso_tpu.tables import base as table_base
+
+
+@pytest.fixture(autouse=True)
+def _clean_tables():
+    yield
+    table_base.reset_tables()
+
+
+@pytest.fixture(scope="module")
+def docs(tmp_path_factory):
+    path = tmp_path_factory.mktemp("lda") / "docs.txt"
+    synthetic_docs(str(path), num_docs=150, vocab_size=300,
+                   avg_doc_len=40, num_topics=8, seed=0)
+    return load_docs(str(path))
+
+
+def test_load_docs(tmp_path):
+    p = tmp_path / "d.txt"
+    p.write_text("0:2 3:1\n1:1\n")
+    tw, td, vocab = load_docs(str(p))
+    assert vocab == 4
+    assert list(tw) == [0, 0, 3, 1]   # count 2 expands to two tokens
+    assert list(td) == [0, 0, 0, 1]
+
+
+def test_invariants_after_training(mesh_dp8, docs):
+    tw, td, V = docs
+    app = LightLDA(tw, td, V,
+                   LDAConfig(num_topics=8, batch_tokens=512,
+                             steps_per_call=4, seed=1), mesh=mesh_dp8,
+                   name="lda_inv")
+    app.train(num_iterations=3)
+    nwk = app.word_topics()
+    nk = np.asarray(app.summary.get())
+    ndk = app.doc_topics()
+    assert nwk.sum() == app.num_tokens
+    assert np.array_equal(nk[: app.K], nwk.sum(0))
+    assert np.array_equal(ndk.sum(1),
+                          np.bincount(td, minlength=app.num_docs))
+    assert (nwk >= 0).all() and (ndk >= 0).all() and (nk >= 0).all()
+
+
+def test_loglik_rises(mesh_dp8, docs):
+    tw, td, V = docs
+    app = LightLDA(tw, td, V,
+                   LDAConfig(num_topics=8, batch_tokens=512,
+                             steps_per_call=4, seed=2), mesh=mesh_dp8,
+                   name="lda_ll")
+    app.train(num_iterations=8)
+    assert app.ll_history[-1] > app.ll_history[0]
+    assert np.all(np.isfinite(app.ll_history))
+
+
+def test_matches_sequential_gibbs_oracle(mesh_dp8, docs):
+    """After the same number of sweeps, the batch-parallel sampler must
+    reach the same likelihood as sequential collapsed Gibbs."""
+    tw, td, V = docs
+    K = 8
+    alpha, beta = 50.0 / K, 0.01
+    sweeps = 12
+
+    # -- numpy sequential oracle
+    D, T = td.max() + 1, len(tw)
+    rng = np.random.default_rng(1)
+    z = rng.integers(0, K, T)
+    nwk = np.zeros((V, K), np.int64)
+    ndk = np.zeros((D, K), np.int64)
+    nk = np.zeros(K, np.int64)
+    np.add.at(nwk, (tw, z), 1)
+    np.add.at(ndk, (td, z), 1)
+    np.add.at(nk, z, 1)
+    for _ in range(sweeps):
+        for i in range(T):
+            w, d = tw[i], td[i]
+            k = z[i]
+            nwk[w, k] -= 1
+            ndk[d, k] -= 1
+            nk[k] -= 1
+            p = (ndk[d] + alpha) * (nwk[w] + beta) / (nk + V * beta)
+            k = rng.choice(K, p=p / p.sum())
+            z[i] = k
+            nwk[w, k] += 1
+            ndk[d, k] += 1
+            nk[k] += 1
+    theta = (ndk + alpha) / (ndk.sum(1, keepdims=True) + K * alpha)
+    phi = (nwk + beta) / (nk + V * beta)
+    oracle_ll = float(np.mean(np.log((theta[td] * phi[tw]).sum(1))))
+
+    # -- ours
+    app = LightLDA(tw, td, V,
+                   LDAConfig(num_topics=K, batch_tokens=512,
+                             steps_per_call=4, seed=1), mesh=mesh_dp8,
+                   name="lda_oracle")
+    app.train(num_iterations=sweeps)
+    ours = app.ll_history[-1]
+    assert ours > oracle_ll - 0.1, \
+        f"batch sampler ll {ours:.4f} vs oracle {oracle_ll:.4f}"
+
+
+def test_checkpoint_roundtrip(mesh_dp8, docs, tmp_path):
+    tw, td, V = docs
+    app = LightLDA(tw, td, V,
+                   LDAConfig(num_topics=8, batch_tokens=512,
+                             steps_per_call=4, seed=3), mesh=mesh_dp8,
+                   name="lda_ckpt")
+    app.train(num_iterations=2)
+    app.store(f"file://{tmp_path}/lda")
+    nwk = app.word_topics()
+    app2 = LightLDA(tw, td, V,
+                    LDAConfig(num_topics=8, batch_tokens=512,
+                              steps_per_call=4, seed=3), mesh=mesh_dp8,
+                    name="lda_ckpt2")
+    app2.load(f"file://{tmp_path}/lda")
+    np.testing.assert_array_equal(app2.word_topics(), nwk)
+    np.testing.assert_array_equal(app2.doc_topics(), app.doc_topics())
+    # resumed sweeps must keep counts consistent (no negative counts)
+    app2.train(num_iterations=1)
+    assert (app2.word_topics() >= 0).all()
+    # mismatched seed must be rejected (z permutation would not line up)
+    app3 = LightLDA(tw, td, V,
+                    LDAConfig(num_topics=8, batch_tokens=512,
+                              steps_per_call=4, seed=9), mesh=mesh_dp8,
+                    name="lda_ckpt3")
+    with pytest.raises(ValueError, match="seed"):
+        app3.load(f"file://{tmp_path}/lda")
+
+
+def test_batch_divisibility_error(mesh_dp8, docs):
+    tw, td, V = docs
+    with pytest.raises(ValueError, match="divisible"):
+        LightLDA(tw, td, V,
+                 LDAConfig(num_topics=8, batch_tokens=100,
+                           steps_per_call=2), mesh=mesh_dp8,
+                 name="lda_bad")
+
+
+def test_top_words_shape(mesh_dp8, docs):
+    tw, td, V = docs
+    app = LightLDA(tw, td, V,
+                   LDAConfig(num_topics=8, batch_tokens=512,
+                             steps_per_call=4), mesh=mesh_dp8,
+                   name="lda_top")
+    app.train(num_iterations=1)
+    top = app.top_words(0, k=5)
+    assert top.shape == (5,)
+    assert (top < V).all()
