@@ -11,8 +11,8 @@ Protocol (recorded in benchmarks/lda_results.json):
   proposal, 2 MH rounds), one worker. The 16-worker cluster is scored as
   16x this (perfect scaling, zero PS cost — generous to the reference).
 - TPU: the exact vectorized collapsed-Gibbs sampler (apps/lightlda),
-  batch 500k tokens (0.05%% of the 1B-token target corpus — negligible
-  AD-LDA staleness; 5%% of this 10M benchmark corpus, the ratio the
+  batch 500k tokens (0.05% of the 1B-token target corpus — negligible
+  AD-LDA staleness; 5% of this 10M benchmark corpus, the ratio the
   oracle-match test validates). Steady-state sweep, compile excluded,
   host-transfer fence.
 - Note the quality asymmetry favoring the baseline in this comparison:

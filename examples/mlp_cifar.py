@@ -1,0 +1,127 @@
+"""Data-parallel MLP via the binding-compat API — the analog of the
+reference's `binding/python/examples/theano/` MLP (BASELINE config #4:
+"multiverso-python Theano MLP on CIFAR-10"; SURVEY.md §3.6 row 4).
+
+The training shape mirrors the reference example exactly (SURVEY.md
+§4.4): a local framework train step updates local params, then
+``ParamManager.sync_all_param`` ships the *delta* since the last sync
+through the ArrayTable and pulls the merged view back — workers never
+overwrite each other, concurrent updates merge additively. Here the
+"local framework" is a jitted jax step instead of a Theano function; the
+sync path is identical.
+
+Run: python examples/mlp_cifar.py -epochs=3
+"""
+
+from __future__ import annotations
+
+import sys
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from multiverso_tpu import core
+from multiverso_tpu.bindings.jax_ext import ParamManager
+from multiverso_tpu.utils import configure, log
+
+INPUT_DIM = 32 * 32 * 3
+NUM_CLASSES = 10
+
+
+def synthetic_cifar(n: int, seed: int = 0,
+                    signal: float = 2.0) -> Tuple[np.ndarray, np.ndarray]:
+    """CIFAR-shaped data with a planted linear class signal."""
+    rng = np.random.default_rng(seed)
+    directions = rng.normal(0, 1, (NUM_CLASSES, INPUT_DIM))
+    directions /= np.linalg.norm(directions, axis=1, keepdims=True)
+    y = rng.integers(0, NUM_CLASSES, n).astype(np.int32)
+    X = rng.normal(0, 1, (n, INPUT_DIM)) + signal * directions[y]
+    return X.astype(np.float32), y
+
+
+def init_mlp(hidden: Tuple[int, ...] = (256, 128),
+             seed: int = 0) -> Dict[str, Any]:
+    rng = np.random.default_rng(seed)
+    sizes = (INPUT_DIM,) + tuple(hidden) + (NUM_CLASSES,)
+    params = {}
+    for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+        params[f"w{i}"] = jnp.asarray(
+            rng.normal(0, np.sqrt(2.0 / a), (a, b)), jnp.float32)
+        params[f"b{i}"] = jnp.zeros((b,), jnp.float32)
+    return params
+
+
+def forward(params: Dict[str, Any], x: jax.Array) -> jax.Array:
+    n_layers = len(params) // 2
+    h = x
+    for i in range(n_layers):
+        h = h @ params[f"w{i}"] + params[f"b{i}"]
+        if i < n_layers - 1:
+            h = jax.nn.relu(h)
+    return h
+
+
+@partial(jax.jit, static_argnums=(3,))
+def train_step(params, x, y, lr: float):
+    def loss_fn(p):
+        logp = jax.nn.log_softmax(forward(p, x))
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+    return params, loss
+
+
+@jax.jit
+def predict(params, x):
+    return jnp.argmax(forward(params, x), axis=1)
+
+
+def accuracy(params, X, y) -> float:
+    return float(np.mean(np.asarray(predict(params, jnp.asarray(X))) == y))
+
+
+def train(X: np.ndarray, y: np.ndarray, *, hidden=(256, 128),
+          epochs: int = 3, batch_size: int = 128, lr: float = 0.05,
+          sync_every: int = 1, seed: int = 0,
+          manager: ParamManager = None) -> Tuple[Dict[str, Any], float]:
+    """The reference example's loop: local step, then table delta-sync."""
+    params = init_mlp(hidden, seed)
+    pm = manager if manager is not None \
+        else ParamManager(params, name="mlp_cifar")
+    n = len(X)
+    loss = float("nan")
+    for epoch in range(epochs):
+        order = np.random.default_rng(seed + epoch).permutation(n)
+        for it, start in enumerate(range(0, n - batch_size + 1,
+                                         batch_size)):
+            idx = order[start:start + batch_size]
+            params, loss = train_step(params, jnp.asarray(X[idx]),
+                                      jnp.asarray(y[idx]), lr)
+            if (it + 1) % sync_every == 0:
+                params = pm.sync_all_param(params)
+        params = pm.sync_all_param(params)
+        log.info("mlp epoch %d: loss=%.4f acc=%.4f", epoch, float(loss),
+                 accuracy(params, X, y))
+    return params, float(loss)
+
+
+def main(argv=None) -> None:
+    configure.define_int("epochs", 3, "training epochs", overwrite=True)
+    configure.define_int("batch_size", 128, "minibatch size", overwrite=True)
+    configure.define_float("lr", 0.05, "learning rate", overwrite=True)
+    configure.define_int("n_samples", 20000, "synthetic sample count", overwrite=True)
+    core.init(argv)
+    X, y = synthetic_cifar(configure.get_flag("n_samples"))
+    params, _ = train(X, y, epochs=configure.get_flag("epochs"),
+                      batch_size=configure.get_flag("batch_size"),
+                      lr=configure.get_flag("lr"))
+    log.info("final accuracy: %.4f", accuracy(params, X, y))
+    core.barrier()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

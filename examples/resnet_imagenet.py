@@ -1,0 +1,238 @@
+"""Data-parallel ResNet trainer — the analog of the reference's
+multiverso-torch ResNet-50/ImageNet config (BASELINE config #5;
+SURVEY.md §3.5 Torch binding row): "async PS → sync ICI all-reduce".
+
+The reference trains torch ResNet with each worker Add/Get-ing deltas
+through the parameter server every minibatch. TPU-native, that whole
+round trip is ONE fused jitted step: the batch is sharded over the mesh
+``"data"`` axis, the loss gradient's output sharding equals the
+(data-replicated) param sharding, so XLA inserts the psum over ICI, and
+the SGD+momentum update runs in-place on donated buffers — sync
+all-reduce data parallelism with no PS in the loop.
+
+The model is a from-scratch jax ResNet (conv/GroupNorm/relu residual
+stages, v1.5-style strides). ``resnet_tiny`` trains in tests;
+``resnet50`` is the reference-parity configuration.
+
+Run: python examples/resnet_imagenet.py -arch=tiny -steps=20
+"""
+
+from __future__ import annotations
+
+import sys
+from functools import partial
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from multiverso_tpu import core
+from multiverso_tpu.utils import configure, dashboard, log
+
+ARCHS = {
+    # (stage block counts, stage widths, bottleneck?)
+    "tiny": ((1, 1), (16, 32), False),
+    "resnet18": ((2, 2, 2, 2), (64, 128, 256, 512), False),
+    "resnet50": ((3, 4, 6, 3), (256, 512, 1024, 2048), True),
+}
+
+
+def synthetic_imagenet(n: int, size: int = 32, num_classes: int = 10,
+                       seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Image-shaped data with a planted per-class channel/spatial bias."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, num_classes, n).astype(np.int32)
+    patterns = rng.normal(0, 1, (num_classes, size, size, 3))
+    X = rng.normal(0, 1, (n, size, size, 3)) + 1.5 * patterns[y]
+    return X.astype(np.float32), y
+
+
+# -- model ----------------------------------------------------------------
+
+def _conv_init(rng, kh, kw, cin, cout):
+    fan_in = kh * kw * cin
+    return jnp.asarray(rng.normal(0, np.sqrt(2.0 / fan_in),
+                                  (kh, kw, cin, cout)), jnp.float32)
+
+
+def conv(x, w, stride: int = 1):
+    return lax.conv_general_dilated(
+        x, w, (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def group_norm(x, gamma, beta_, groups: int = 8):
+    n, h, w, c = x.shape
+    g = min(groups, c)
+    xg = x.reshape(n, h, w, g, c // g)
+    mu = xg.mean(axis=(1, 2, 4), keepdims=True)
+    var = xg.var(axis=(1, 2, 4), keepdims=True)
+    xg = (xg - mu) / jnp.sqrt(var + 1e-5)
+    return xg.reshape(n, h, w, c) * gamma + beta_
+
+
+def init_resnet(arch: str = "tiny", num_classes: int = 10,
+                seed: int = 0) -> Dict[str, Any]:
+    blocks, widths, bottleneck = ARCHS[arch]
+    rng = np.random.default_rng(seed)
+    params: Dict[str, Any] = {
+        "stem": _conv_init(rng, 3, 3, 3, widths[0] if not bottleneck
+                           else widths[0] // 4),
+    }
+    cin = widths[0] if not bottleneck else widths[0] // 4
+    params["stem_g"] = jnp.ones((cin,), jnp.float32)
+    params["stem_b"] = jnp.zeros((cin,), jnp.float32)
+    for s, (nb, width) in enumerate(zip(blocks, widths)):
+        for b in range(nb):
+            pre = f"s{s}b{b}"
+            stride = 2 if (s > 0 and b == 0) else 1
+            mid = width // 4 if bottleneck else width
+            if bottleneck:
+                params[f"{pre}_c1"] = _conv_init(rng, 1, 1, cin, mid)
+                params[f"{pre}_c2"] = _conv_init(rng, 3, 3, mid, mid)
+                params[f"{pre}_c3"] = _conv_init(rng, 1, 1, mid, width)
+            else:
+                params[f"{pre}_c1"] = _conv_init(rng, 3, 3, cin, width)
+                params[f"{pre}_c2"] = _conv_init(rng, 3, 3, width, width)
+            for i, ch in enumerate(
+                    (mid, mid, width) if bottleneck else (width, width)):
+                params[f"{pre}_g{i}"] = jnp.ones((ch,), jnp.float32)
+                params[f"{pre}_b{i}"] = jnp.zeros((ch,), jnp.float32)
+            if stride != 1 or cin != width:
+                params[f"{pre}_proj"] = _conv_init(rng, 1, 1, cin, width)
+            cin = width
+    params["head_w"] = jnp.asarray(
+        rng.normal(0, 0.01, (cin, num_classes)), jnp.float32)
+    params["head_b"] = jnp.zeros((num_classes,), jnp.float32)
+    return params
+
+
+def forward(params: Dict[str, Any], x: jax.Array, arch: str) -> jax.Array:
+    blocks, widths, bottleneck = ARCHS[arch]
+    h = conv(x, params["stem"])
+    h = jax.nn.relu(group_norm(h, params["stem_g"], params["stem_b"]))
+    for s, (nb, width) in enumerate(zip(blocks, widths)):
+        for b in range(nb):
+            pre = f"s{s}b{b}"
+            stride = 2 if (s > 0 and b == 0) else 1
+            shortcut = h
+            if f"{pre}_proj" in params:
+                shortcut = conv(h, params[f"{pre}_proj"], stride)
+            if bottleneck:
+                h = jax.nn.relu(group_norm(
+                    conv(h, params[f"{pre}_c1"]),
+                    params[f"{pre}_g0"], params[f"{pre}_b0"]))
+                h = jax.nn.relu(group_norm(
+                    conv(h, params[f"{pre}_c2"], stride),
+                    params[f"{pre}_g1"], params[f"{pre}_b1"]))
+                h = group_norm(conv(h, params[f"{pre}_c3"]),
+                               params[f"{pre}_g2"], params[f"{pre}_b2"])
+            else:
+                h = jax.nn.relu(group_norm(
+                    conv(h, params[f"{pre}_c1"], stride),
+                    params[f"{pre}_g0"], params[f"{pre}_b0"]))
+                h = group_norm(conv(h, params[f"{pre}_c2"]),
+                               params[f"{pre}_g1"], params[f"{pre}_b1"])
+            h = jax.nn.relu(h + shortcut)
+    h = h.mean(axis=(1, 2))
+    return h @ params["head_w"] + params["head_b"]
+
+
+# -- trainer --------------------------------------------------------------
+
+class ResNetTrainer:
+    """Sync-DP trainer: one fused jitted step, psum over ICI."""
+
+    def __init__(self, arch: str = "tiny", num_classes: int = 10, *,
+                 learning_rate: float = 0.1, momentum: float = 0.9,
+                 mesh=None, seed: int = 0) -> None:
+        self.arch = arch
+        self.mesh = mesh if mesh is not None else core.mesh()
+        self.lr, self.mu = learning_rate, momentum
+        self.params = init_resnet(arch, num_classes, seed)
+        self.velocity = jax.tree.map(jnp.zeros_like, self.params)
+        # params replicated across the mesh (the model is small relative
+        # to HBM; the reference replicates per worker too)
+        replicated = NamedSharding(self.mesh, P())
+        self.params = jax.device_put(self.params, replicated)
+        self.velocity = jax.device_put(self.velocity, replicated)
+        self._data_sh = NamedSharding(self.mesh,
+                                      P(core.DATA_AXIS, None, None, None))
+        self._label_sh = NamedSharding(self.mesh, P(core.DATA_AXIS))
+        arch_name = arch
+
+        @partial(jax.jit, donate_argnums=(0, 1),
+                 out_shardings=(replicated, replicated, None))
+        def step(params, velocity, x, y, lr):
+            def loss_fn(p):
+                logp = jax.nn.log_softmax(forward(p, x, arch_name))
+                return -jnp.mean(
+                    jnp.take_along_axis(logp, y[:, None], axis=1))
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            velocity = jax.tree.map(lambda v, g: self.mu * v + g,
+                                    velocity, grads)
+            params = jax.tree.map(lambda p, v: p - lr * v,
+                                  params, velocity)
+            return params, velocity, loss
+
+        self._step = step
+
+        @jax.jit
+        def _predict(params, x):
+            return jnp.argmax(forward(params, x, arch_name), axis=1)
+
+        self._predict = _predict
+
+    def train_step(self, x: np.ndarray, y: np.ndarray,
+                   lr: float = None) -> jax.Array:
+        xs = jax.device_put(x, self._data_sh)
+        ys = jax.device_put(y, self._label_sh)
+        with dashboard.profile("resnet.step"):
+            self.params, self.velocity, loss = self._step(
+                self.params, self.velocity, xs, ys,
+                jnp.float32(lr if lr is not None else self.lr))
+        return loss
+
+    def fit(self, X: np.ndarray, y: np.ndarray, *, steps: int,
+            batch_size: int = 256, seed: int = 0) -> List[float]:
+        rng = np.random.default_rng(seed)
+        losses = []
+        for _ in range(steps):
+            idx = rng.integers(0, len(X), batch_size)
+            losses.append(self.train_step(X[idx], y[idx]))
+        return [float(l) for l in losses]
+
+    def accuracy(self, X: np.ndarray, y: np.ndarray,
+                 batch: int = 512) -> float:
+        hits = 0
+        for lo in range(0, len(X), batch):
+            pred = np.asarray(self._predict(
+                self.params, jnp.asarray(X[lo:lo + batch])))
+            hits += int((pred == y[lo:lo + batch]).sum())
+        return hits / len(X)
+
+
+def main(argv=None) -> None:
+    configure.define_string("arch", "tiny", "tiny | resnet18 | resnet50", overwrite=True)
+    configure.define_int("steps", 50, "training steps", overwrite=True)
+    configure.define_int("batch_size", 256, "global batch size", overwrite=True)
+    configure.define_float("lr", 0.1, "learning rate", overwrite=True)
+    configure.define_int("image_size", 32, "synthetic image size", overwrite=True)
+    core.init(argv)
+    X, y = synthetic_imagenet(8192, size=configure.get_flag("image_size"))
+    trainer = ResNetTrainer(configure.get_flag("arch"),
+                            learning_rate=configure.get_flag("lr"))
+    losses = trainer.fit(X, y, steps=configure.get_flag("steps"),
+                         batch_size=configure.get_flag("batch_size"))
+    log.info("resnet %s: loss %.4f -> %.4f, accuracy %.4f",
+             configure.get_flag("arch"), losses[0], losses[-1],
+             trainer.accuracy(X, y))
+    core.barrier()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -105,12 +105,13 @@ class LightLDA:
         self.K = c.num_topics
         self.num_docs = int(token_docs.max()) + 1 if len(token_docs) else 1
         self.num_tokens = len(token_words)
-        if len(token_docs) and np.any(np.diff(token_docs) < 0):
+        if c.sampler == "mh" and len(token_docs) \
+                and np.any(np.diff(token_docs) < 0):
             # doc_start offsets (MH doc proposal) assume a doc-contiguous
             # stream; an interleaved stream would silently sample the
-            # wrong doc's topics
+            # wrong doc's topics (gibbs is order-agnostic)
             raise ValueError("token_docs must be doc-contiguous "
-                             "(non-decreasing doc ids)")
+                             "(non-decreasing doc ids) for sampler='mh'")
         if c.precision not in ("float32", "bfloat16"):
             raise ValueError(f"precision must be 'float32' or 'bfloat16', "
                              f"got {c.precision!r}")
@@ -166,19 +167,21 @@ class LightLDA:
                  np.arange(T_pad, dtype=np.int32),
                  self._mask.astype(np.int32))))
 
-        # doc structure for the MH doc-proposal (z-array trick): the
-        # incoming stream is doc-contiguous, so doc d's tokens live at
-        # original positions [doc_start[d], doc_start[d]+doc_len[d]);
-        # inv_perm maps an original position to its shuffled position
-        # (= the z index space). One scratch-doc entry covers padding.
-        doc_len = np.bincount(token_docs, minlength=self.num_docs) \
-            if len(token_docs) else np.zeros(self.num_docs, np.int64)
-        doc_len = np.append(doc_len, max(T_pad - self.num_tokens, 1))
-        doc_start = np.concatenate([[0], np.cumsum(doc_len)])[:-1]
-        inv_perm = np.argsort(perm).astype(np.int32)
-        self._doc_len = jnp.asarray(doc_len.astype(np.int32))
-        self._doc_start = jnp.asarray(doc_start.astype(np.int32))
-        self._inv_perm = jnp.asarray(inv_perm)
+        if c.sampler == "mh":
+            # doc structure for the MH doc-proposal (z-array trick): the
+            # stream is doc-contiguous (validated above), so doc d's
+            # tokens live at original positions [doc_start[d],
+            # doc_start[d]+doc_len[d]); inv_perm maps an original
+            # position to its shuffled position (= the z index space).
+            # One scratch-doc entry covers padding. Gibbs never touches
+            # these — don't spend the [T_pad] device memory there.
+            doc_len = np.bincount(token_docs, minlength=self.num_docs) \
+                if len(token_docs) else np.zeros(self.num_docs, np.int64)
+            doc_len = np.append(doc_len, max(T_pad - self.num_tokens, 1))
+            doc_start = np.concatenate([[0], np.cumsum(doc_len)])[:-1]
+            self._doc_len = jnp.asarray(doc_len.astype(np.int32))
+            self._doc_start = jnp.asarray(doc_start.astype(np.int32))
+            self._inv_perm = jnp.asarray(np.argsort(perm).astype(np.int32))
 
         # random initial assignments + count build (one jitted scatter)
         rng = np.random.default_rng(c.seed)
@@ -518,13 +521,14 @@ class LightLDA:
 def main(argv=None) -> None:
     """CLI mirroring the reference lightlda binary's flags."""
     from multiverso_tpu.utils import configure
-    configure.define_string("input_file", "", "docs in word:count format")
-    configure.define_int("num_topics", 100, "topics")
-    configure.define_float("alpha", -1.0, "doc-topic prior (<0 -> 50/K)")
-    configure.define_float("beta", 0.01, "word-topic prior")
-    configure.define_int("num_iterations", 10, "Gibbs sweeps")
-    configure.define_int("batch_tokens", 4096, "tokens per scan step")
-    configure.define_string("output_file", "", "model checkpoint prefix")
+    configure.define_string("input_file", "", "docs in word:count format", overwrite=True)
+    configure.define_int("num_topics", 100, "topics", overwrite=True)
+    configure.define_float("alpha", -1.0, "doc-topic prior (<0 -> 50/K)",
+                           overwrite=True)
+    configure.define_float("beta", 0.01, "word-topic prior", overwrite=True)
+    configure.define_int("num_iterations", 10, "Gibbs sweeps", overwrite=True)
+    configure.define_int("batch_tokens", 4096, "tokens per scan step", overwrite=True)
+    configure.define_string("output_file", "", "model checkpoint prefix", overwrite=True)
     core.init(argv)
     path = configure.get_flag("input_file")
     if not path:

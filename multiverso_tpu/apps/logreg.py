@@ -263,15 +263,15 @@ class LogisticRegression:
 def main(argv=None) -> None:
     """CLI entry mirroring the reference binary's config-file interface."""
     from multiverso_tpu.utils import configure
-    configure.define_string("train_file", "", "libsvm training data")
-    configure.define_string("test_file", "", "libsvm test data")
-    configure.define_int("input_dimension", 784, "feature dimension")
-    configure.define_int("output_dimension", 10, "number of classes")
-    configure.define_int("minibatch_size", 256, "minibatch size")
-    configure.define_int("train_epoch", 1, "epochs")
-    configure.define_float("learning_rate", 0.1, "learning rate")
-    configure.define_float("regular_lambda", 0.0, "L2 coefficient")
-    configure.define_string("output_model_file", "", "checkpoint URI")
+    configure.define_string("train_file", "", "libsvm training data", overwrite=True)
+    configure.define_string("test_file", "", "libsvm test data", overwrite=True)
+    configure.define_int("input_dimension", 784, "feature dimension", overwrite=True)
+    configure.define_int("output_dimension", 10, "number of classes", overwrite=True)
+    configure.define_int("minibatch_size", 256, "minibatch size", overwrite=True)
+    configure.define_int("train_epoch", 1, "epochs", overwrite=True)
+    configure.define_float("learning_rate", 0.1, "learning rate", overwrite=True)
+    configure.define_float("regular_lambda", 0.0, "L2 coefficient", overwrite=True)
+    configure.define_string("output_model_file", "", "checkpoint URI", overwrite=True)
     core.init(argv)
     # the global updater_type default is "default" (plain add) — for a
     # gradient-descent app that means ascent; this app's default is sgd

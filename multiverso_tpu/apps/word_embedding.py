@@ -373,17 +373,17 @@ class WordEmbedding:
 def main(argv=None) -> None:
     """CLI mirroring the reference's word2vec-style argv."""
     from multiverso_tpu.utils import configure
-    configure.define_string("train_file", "", "corpus text file")
-    configure.define_int("size", 100, "embedding dimension")
-    configure.define_int("window", 5, "context window")
+    configure.define_string("train_file", "", "corpus text file", overwrite=True)
+    configure.define_int("size", 100, "embedding dimension", overwrite=True)
+    configure.define_int("window", 5, "context window", overwrite=True)
     configure.define_int("negative", 5, "negative samples (0 -> HS)")
-    configure.define_bool("cbow", False, "CBOW instead of skip-gram")
-    configure.define_int("epoch", 1, "epochs")
-    configure.define_int("batch_size", 1024, "pairs per step")
-    configure.define_float("alpha", 0.025, "initial learning rate")
-    configure.define_float("sample", 1e-3, "subsampling threshold")
-    configure.define_int("min_count", 5, "vocab min count")
-    configure.define_string("output_file", "", "embedding checkpoint prefix")
+    configure.define_bool("cbow", False, "CBOW instead of skip-gram", overwrite=True)
+    configure.define_int("epoch", 1, "epochs", overwrite=True)
+    configure.define_int("batch_size", 1024, "pairs per step", overwrite=True)
+    configure.define_float("alpha", 0.025, "initial learning rate", overwrite=True)
+    configure.define_float("sample", 1e-3, "subsampling threshold", overwrite=True)
+    configure.define_int("min_count", 5, "vocab min count", overwrite=True)
+    configure.define_string("output_file", "", "embedding checkpoint prefix", overwrite=True)
     core.init(argv)
     train_file = configure.get_flag("train_file")
     if not train_file:

@@ -38,17 +38,23 @@ class FlagRegistry:
         self._lock = threading.Lock()
 
     def define(self, name: str, default: Any, help_str: str,
-               parser: Callable[[str], Any]) -> None:
+               parser: Callable[[str], Any],
+               overwrite: bool = False) -> None:
         with self._lock:
-            if name in self._entries:
+            if name in self._entries and not overwrite:
                 # Re-definition with identical default is a no-op (module
-                # reloads in tests); conflicting re-definition is an error.
+                # reloads in tests); conflicting re-definition is an error
+                # unless the caller owns the flag (overwrite=True — app
+                # mains redefining another app's CLI flag in-process,
+                # where the reference would be separate binaries).
                 existing = self._entries[name]
-                if existing.default != default:
-                    raise ValueError(
-                        f"flag {name!r} already defined with default "
-                        f"{existing.default!r}, conflicting default {default!r}")
-                return
+                if existing.default == default:
+                    return
+                raise ValueError(
+                    f"flag {name!r} already defined with default "
+                    f"{existing.default!r}, conflicting default {default!r}")
+            # overwrite installs a FRESH entry: the value resets to the
+            # new default so a previous app's argv cannot leak through
             self._entries[name] = _FlagEntry(name, default, help_str, parser,
                                              default)
 
@@ -117,20 +123,24 @@ def _parse_bool(raw: str) -> bool:
     raise ValueError(f"cannot parse bool flag value {raw!r}")
 
 
-def define_string(name: str, default: str, help_str: str = "") -> None:
-    _REGISTRY.define(name, default, help_str, str)
+def define_string(name: str, default: str, help_str: str = "",
+                  overwrite: bool = False) -> None:
+    _REGISTRY.define(name, default, help_str, str, overwrite)
 
 
-def define_int(name: str, default: int, help_str: str = "") -> None:
-    _REGISTRY.define(name, default, help_str, int)
+def define_int(name: str, default: int, help_str: str = "",
+               overwrite: bool = False) -> None:
+    _REGISTRY.define(name, default, help_str, int, overwrite)
 
 
-def define_float(name: str, default: float, help_str: str = "") -> None:
-    _REGISTRY.define(name, default, help_str, float)
+def define_float(name: str, default: float, help_str: str = "",
+                 overwrite: bool = False) -> None:
+    _REGISTRY.define(name, default, help_str, float, overwrite)
 
 
-def define_bool(name: str, default: bool, help_str: str = "") -> None:
-    _REGISTRY.define(name, default, help_str, _parse_bool)
+def define_bool(name: str, default: bool, help_str: str = "",
+                overwrite: bool = False) -> None:
+    _REGISTRY.define(name, default, help_str, _parse_bool, overwrite)
 
 
 def get_flag(name: str) -> Any:

@@ -1,0 +1,105 @@
+"""Delta quantization filters — the reference's optional compression of
+matrix deltas before send (upstream layout
+`include/multiverso/util/quantization_util.h`, SURVEY.md §3.7 [L]:
+1-bit and rounding quantizers).
+
+On TPU there is no wire to compress for the in-program collectives, but
+the same filters matter for DCN-crossing transfers (multi-slice grads,
+host checkpoint streams) and for memory-footprint control. Both
+quantizers are pure jittable functions.
+
+- :class:`OneBitQuantizer` — sign bit + per-block mean magnitude, with
+  local error feedback (the residual is carried and added to the next
+  delta, the standard 1-bit-SGD trick the reference family used).
+- :class:`RoundingQuantizer` — stochastic rounding to int8/int16 with a
+  per-block scale; unbiased (E[dequant] = value).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _block_view(x: jax.Array, block: int) -> Tuple[jax.Array, int]:
+    """Flatten and zero-pad to whole blocks; returns ([n_blocks, block],
+    original size)."""
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    pad = (-n) % block
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros(pad, flat.dtype)])
+    return flat.reshape(-1, block), n
+
+
+@dataclasses.dataclass(frozen=True)
+class OneBitQuantizer:
+    """sign(delta) + per-block mean |delta|, with error feedback."""
+    block: int = 512
+
+    @partial(jax.jit, static_argnums=0)
+    def quantize(self, delta: jax.Array,
+                 residual: Optional[jax.Array] = None):
+        """Returns (bits uint8 [n_blocks, block/8...] packed as int8 sign
+        in {0,1}, scales f32 [n_blocks], new_residual like delta)."""
+        if residual is not None:
+            delta = delta + residual
+        blocks, n = _block_view(delta, self.block)
+        # exclude the final block's zero pads from the sign counts —
+        # they would dilute pos_scale (pads sign as positive)
+        valid = (jnp.arange(blocks.size).reshape(blocks.shape) < n)
+        sign = (blocks >= 0)
+        pos = sign & valid
+        neg = (~sign) & valid
+        # one scale per block per sign-side: mean magnitude of that side
+        pos_scale = jnp.sum(jnp.where(pos, blocks, 0.0), axis=1) / \
+            jnp.maximum(jnp.sum(pos, axis=1), 1)
+        neg_scale = jnp.sum(jnp.where(neg, -blocks, 0.0), axis=1) / \
+            jnp.maximum(jnp.sum(neg, axis=1), 1)
+        deq = jnp.where(sign, pos_scale[:, None], -neg_scale[:, None])
+        new_residual = (blocks - deq).reshape(-1)[:n].reshape(delta.shape)
+        return (sign.astype(jnp.int8), pos_scale.astype(jnp.float32),
+                neg_scale.astype(jnp.float32), new_residual)
+
+    @partial(jax.jit, static_argnums=(0, 4))
+    def dequantize(self, sign, pos_scale, neg_scale, shape):
+        deq = jnp.where(sign.astype(bool), pos_scale[:, None],
+                        -neg_scale[:, None])
+        n = int(np.prod(shape))
+        return deq.reshape(-1)[:n].reshape(shape)
+
+
+@dataclasses.dataclass(frozen=True)
+class RoundingQuantizer:
+    """Unbiased stochastic rounding to a fixed-point grid."""
+    bits: int = 8                 # 8 -> int8, 16 -> int16
+    block: int = 512
+
+    @property
+    def _qmax(self) -> int:
+        return (1 << (self.bits - 1)) - 1
+
+    @partial(jax.jit, static_argnums=0)
+    def quantize(self, delta: jax.Array, key: jax.Array):
+        """Returns (q int8/int16 [n_blocks, block], scales f32)."""
+        blocks, n = _block_view(delta, self.block)
+        scale = jnp.max(jnp.abs(blocks), axis=1) / self._qmax
+        scale = jnp.maximum(scale, 1e-30)
+        scaled = blocks / scale[:, None]
+        low = jnp.floor(scaled)
+        p_up = scaled - low                       # P(round up), unbiased
+        up = jax.random.uniform(key, scaled.shape) < p_up
+        q = jnp.clip(low + up, -self._qmax, self._qmax)
+        dtype = jnp.int8 if self.bits <= 8 else jnp.int16
+        return q.astype(dtype), scale.astype(jnp.float32)
+
+    @partial(jax.jit, static_argnums=(0, 3))
+    def dequantize(self, q, scale, shape):
+        deq = q.astype(jnp.float32) * scale[:, None]
+        n = int(np.prod(shape))
+        return deq.reshape(-1)[:n].reshape(shape)

@@ -134,8 +134,12 @@ def test_mh_interleaved_docs_rejected(mesh_dp8):
     td = np.array([0, 1, 0, 1], np.int32)   # not doc-contiguous
     with pytest.raises(ValueError, match="contiguous"):
         LightLDA(tw, td, 4, LDAConfig(num_topics=4, batch_tokens=8,
-                                      steps_per_call=1), mesh=mesh_dp8,
-                 name="lda_interleaved")
+                                      steps_per_call=1, sampler="mh"),
+                 mesh=mesh_dp8, name="lda_interleaved")
+    # gibbs is order-agnostic: the same stream must be accepted
+    LightLDA(tw, td, 4, LDAConfig(num_topics=4, batch_tokens=8,
+                                  steps_per_call=1), mesh=mesh_dp8,
+             name="lda_interleaved_gibbs")
 
 
 def test_bad_precision_rejected(mesh_dp8, docs):

@@ -1,0 +1,80 @@
+"""utils/quantization: round-trip bounds, unbiasedness, error feedback."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from multiverso_tpu.utils.quantization import (OneBitQuantizer,
+                                               RoundingQuantizer)
+
+
+def test_onebit_roundtrip_shape():
+    q = OneBitQuantizer(block=64)
+    x = jnp.asarray(np.random.default_rng(0).normal(0, 1, (37, 13)),
+                    jnp.float32)
+    sign, ps, ns, resid = q.quantize(x)
+    deq = q.dequantize(sign, ps, ns, x.shape)
+    assert deq.shape == x.shape
+    # error feedback: residual == x - dequantized
+    np.testing.assert_allclose(np.asarray(resid),
+                               np.asarray(x) - np.asarray(deq), atol=1e-5)
+
+
+def test_onebit_error_feedback_converges():
+    """Accumulated 1-bit quantized deltas track the true sum (the
+    1-bit-SGD guarantee: error feedback keeps the bias bounded)."""
+    q = OneBitQuantizer(block=128)
+    rng = np.random.default_rng(1)
+    true_sum = np.zeros((256,), np.float32)
+    quant_sum = np.zeros((256,), np.float32)
+    resid = jnp.zeros((256,), jnp.float32)
+    for i in range(200):
+        delta = rng.normal(0, 1, 256).astype(np.float32)
+        true_sum += delta
+        sign, ps, ns, resid = q.quantize(jnp.asarray(delta), resid)
+        quant_sum += np.asarray(q.dequantize(sign, ps, ns, (256,)))
+    # the residual bounds the gap between the streams
+    gap = np.abs(true_sum - quant_sum)
+    assert gap.max() <= np.abs(np.asarray(resid)).max() + 1e-4
+
+
+def test_onebit_preserves_sign_and_scale():
+    q = OneBitQuantizer(block=8)
+    x = jnp.asarray([1.0, 1.0, 1.0, 1.0, -2.0, -2.0, -2.0, -2.0])
+    sign, ps, ns, _ = q.quantize(x)
+    deq = np.asarray(q.dequantize(sign, ps, ns, (8,)))
+    np.testing.assert_allclose(deq[:4], 1.0, atol=1e-6)
+    np.testing.assert_allclose(deq[4:], -2.0, atol=1e-6)
+
+
+def test_rounding_unbiased():
+    q = RoundingQuantizer(bits=8, block=256)
+    x = jnp.asarray(np.random.default_rng(2).normal(0, 1, 256),
+                    jnp.float32)
+    acc = np.zeros(256)
+    n = 300
+    for i in range(n):
+        qq, scale = q.quantize(x, jax.random.PRNGKey(i))
+        acc += np.asarray(q.dequantize(qq, scale, (256,)))
+    # mean of stochastic roundings converges to x
+    np.testing.assert_allclose(acc / n, np.asarray(x), atol=0.01)
+
+
+def test_rounding_error_bound():
+    q = RoundingQuantizer(bits=16, block=128)
+    x = jnp.asarray(np.random.default_rng(3).normal(0, 5, 1000),
+                    jnp.float32)
+    qq, scale = q.quantize(x, jax.random.PRNGKey(0))
+    deq = np.asarray(q.dequantize(qq, scale, (1000,)))
+    # per-element error bounded by one grid cell of its block
+    step = np.repeat(np.asarray(scale), 128)[:1000]
+    assert np.all(np.abs(deq - np.asarray(x)) <= step + 1e-6)
+
+
+def test_rounding_int8_range():
+    q = RoundingQuantizer(bits=8, block=64)
+    x = jnp.asarray(np.random.default_rng(4).normal(0, 100, 64),
+                    jnp.float32)
+    qq, _ = q.quantize(x, jax.random.PRNGKey(0))
+    assert qq.dtype == jnp.int8
+    assert int(np.abs(np.asarray(qq)).max()) <= 127
