@@ -1,0 +1,60 @@
+import sys, time; sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+V, D, B, K, S = 4096, 128, 2048, 5, 8
+LR = 0.01
+rng = np.random.default_rng(0)
+p = 1.0/np.arange(1, V-47) ** 1.2; p = p/p.sum()
+srcs = rng.choice(V-48, (S, B), p=p).astype(np.int32)
+tgts = rng.choice(V-48, (S, B*(1+K)), p=p).astype(np.int32)
+
+def kernel(srcs_ref, tgts_ref, w_in_ref, w_out_ref, w_in_out, w_out_out):
+    s = pl.program_id(0)
+    def body(i, _):
+        c = srcs_ref[s, i]
+        v = w_in_out[pl.ds(c, 1), :]
+        grad_v = jnp.zeros((1, D), jnp.float32)
+        for k in range(1 + K):
+            t = tgts_ref[s, i * (1 + K) + k]
+            u = w_out_out[pl.ds(t, 1), :]
+            dot = jnp.sum(v * u)
+            label = 1.0 if k == 0 else 0.0
+            g = (jax.nn.sigmoid(dot) - label) * LR
+            grad_v = grad_v + g * u
+            w_out_out[pl.ds(t, 1), :] = u - g * v
+        w_in_out[pl.ds(c, 1), :] = v - grad_v
+        return 0
+    jax.lax.fori_loop(0, B, body, 0)
+
+grid_spec = pltpu.PrefetchScalarGridSpec(
+    num_scalar_prefetch=2,
+    grid=(S,),
+    in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+              pl.BlockSpec(memory_space=pltpu.VMEM)],
+    out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+               pl.BlockSpec(memory_space=pltpu.VMEM)],
+)
+
+@jax.jit
+def pallas_step(w_in, w_out, srcs, tgts):
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((V, D), jnp.float32),
+                   jax.ShapeDtypeStruct((V, D), jnp.float32)],
+        input_output_aliases={2: 0, 3: 1},
+    )(srcs, tgts, w_in, w_out)
+
+w_in = jnp.asarray(rng.uniform(-0.01, 0.01, (V, D)), jnp.float32)
+w_out = jnp.zeros((V, D), jnp.float32)
+s_d, t_d = jnp.asarray(srcs), jnp.asarray(tgts)
+w_in, w_out = pallas_step(w_in, w_out, s_d, t_d)
+print("compiled; w_out[0,0] =", float(np.asarray(w_out)[0,0]), flush=True)
+t0 = time.perf_counter(); N = 5
+for _ in range(N):
+    w_in, w_out = pallas_step(w_in, w_out, s_d, t_d)
+float(np.asarray(w_out)[0,0])
+dt = (time.perf_counter()-t0)/N
+print(f"pallas: {S*B/dt/1e6:.2f}M pairs/s ({dt*1e3:.1f} ms/call)", flush=True)
