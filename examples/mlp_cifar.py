@@ -44,13 +44,15 @@ def synthetic_cifar(n: int, seed: int = 0,
 
 def init_mlp(hidden: Tuple[int, ...] = (256, 128),
              seed: int = 0) -> Dict[str, Any]:
+    # "local" worker params still live on the MESH (replicated), not the
+    # default device — the platforms may differ (TPU default, CPU mesh)
     rng = np.random.default_rng(seed)
     sizes = (INPUT_DIM,) + tuple(hidden) + (NUM_CLASSES,)
     params = {}
     for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
-        params[f"w{i}"] = jnp.asarray(
-            rng.normal(0, np.sqrt(2.0 / a), (a, b)), jnp.float32)
-        params[f"b{i}"] = jnp.zeros((b,), jnp.float32)
+        params[f"w{i}"] = core.place(
+            rng.normal(0, np.sqrt(2.0 / a), (a, b)).astype(np.float32))
+        params[f"b{i}"] = core.place(np.zeros((b,), np.float32))
     return params
 
 
@@ -81,7 +83,8 @@ def predict(params, x):
 
 
 def accuracy(params, X, y) -> float:
-    return float(np.mean(np.asarray(predict(params, jnp.asarray(X))) == y))
+    return float(np.mean(np.asarray(
+        predict(params, core.place(np.asarray(X)))) == y))
 
 
 def train(X: np.ndarray, y: np.ndarray, *, hidden=(256, 128),
@@ -99,8 +102,8 @@ def train(X: np.ndarray, y: np.ndarray, *, hidden=(256, 128),
         for it, start in enumerate(range(0, n - batch_size + 1,
                                          batch_size)):
             idx = order[start:start + batch_size]
-            params, loss = train_step(params, jnp.asarray(X[idx]),
-                                      jnp.asarray(y[idx]), lr)
+            params, loss = train_step(params, core.place(X[idx]),
+                                      core.place(y[idx]), lr)
             if (it + 1) % sync_every == 0:
                 params = pm.sync_all_param(params)
         params = pm.sync_all_param(params)

@@ -54,8 +54,8 @@ def synthetic_imagenet(n: int, size: int = 32, num_classes: int = 10,
 
 def _conv_init(rng, kh, kw, cin, cout):
     fan_in = kh * kw * cin
-    return jnp.asarray(rng.normal(0, np.sqrt(2.0 / fan_in),
-                                  (kh, kw, cin, cout)), jnp.float32)
+    return rng.normal(0, np.sqrt(2.0 / fan_in),
+                      (kh, kw, cin, cout)).astype(np.float32)
 
 
 def conv(x, w, stride: int = 1):
@@ -83,8 +83,8 @@ def init_resnet(arch: str = "tiny", num_classes: int = 10,
                            else widths[0] // 4),
     }
     cin = widths[0] if not bottleneck else widths[0] // 4
-    params["stem_g"] = jnp.ones((cin,), jnp.float32)
-    params["stem_b"] = jnp.zeros((cin,), jnp.float32)
+    params["stem_g"] = np.ones((cin,), np.float32)
+    params["stem_b"] = np.zeros((cin,), np.float32)
     for s, (nb, width) in enumerate(zip(blocks, widths)):
         for b in range(nb):
             pre = f"s{s}b{b}"
@@ -99,14 +99,14 @@ def init_resnet(arch: str = "tiny", num_classes: int = 10,
                 params[f"{pre}_c2"] = _conv_init(rng, 3, 3, width, width)
             for i, ch in enumerate(
                     (mid, mid, width) if bottleneck else (width, width)):
-                params[f"{pre}_g{i}"] = jnp.ones((ch,), jnp.float32)
-                params[f"{pre}_b{i}"] = jnp.zeros((ch,), jnp.float32)
+                params[f"{pre}_g{i}"] = np.ones((ch,), np.float32)
+                params[f"{pre}_b{i}"] = np.zeros((ch,), np.float32)
             if stride != 1 or cin != width:
                 params[f"{pre}_proj"] = _conv_init(rng, 1, 1, cin, width)
             cin = width
-    params["head_w"] = jnp.asarray(
-        rng.normal(0, 0.01, (cin, num_classes)), jnp.float32)
-    params["head_b"] = jnp.zeros((num_classes,), jnp.float32)
+    params["head_w"] = rng.normal(
+        0, 0.01, (cin, num_classes)).astype(np.float32)
+    params["head_b"] = np.zeros((num_classes,), np.float32)
     return params
 
 
@@ -152,13 +152,14 @@ class ResNetTrainer:
         self.arch = arch
         self.mesh = mesh if mesh is not None else core.mesh()
         self.lr, self.mu = learning_rate, momentum
-        self.params = init_resnet(arch, num_classes, seed)
-        self.velocity = jax.tree.map(jnp.zeros_like, self.params)
-        # params replicated across the mesh (the model is small relative
-        # to HBM; the reference replicates per worker too)
+        # init_resnet returns host numpy; ONE placement onto the mesh —
+        # nothing ever materialises on the process default device (its
+        # platform may differ from the mesh's)
         replicated = NamedSharding(self.mesh, P())
-        self.params = jax.device_put(self.params, replicated)
-        self.velocity = jax.device_put(self.velocity, replicated)
+        host = init_resnet(arch, num_classes, seed)
+        self.params = jax.device_put(host, replicated)
+        self.velocity = jax.device_put(
+            jax.tree.map(np.zeros_like, host), replicated)
         self._data_sh = NamedSharding(self.mesh,
                                       P(core.DATA_AXIS, None, None, None))
         self._label_sh = NamedSharding(self.mesh, P(core.DATA_AXIS))
@@ -194,7 +195,7 @@ class ResNetTrainer:
         with dashboard.profile("resnet.step"):
             self.params, self.velocity, loss = self._step(
                 self.params, self.velocity, xs, ys,
-                jnp.float32(lr if lr is not None else self.lr))
+                np.float32(lr if lr is not None else self.lr))
         return loss
 
     def fit(self, X: np.ndarray, y: np.ndarray, *, steps: int,
@@ -203,15 +204,20 @@ class ResNetTrainer:
         losses = []
         for _ in range(steps):
             idx = rng.integers(0, len(X), batch_size)
-            losses.append(self.train_step(X[idx], y[idx]))
-        return [float(l) for l in losses]
+            # sync per step: unbounded async dispatch of cross-device
+            # all-reduces can starve XLA:CPU's 40s collective rendezvous
+            # when the host has fewer cores than mesh devices (virtual
+            # test meshes); one step in flight is plenty for an example
+            losses.append(float(self.train_step(X[idx], y[idx])))
+        return losses
 
     def accuracy(self, X: np.ndarray, y: np.ndarray,
                  batch: int = 512) -> float:
         hits = 0
         for lo in range(0, len(X), batch):
             pred = np.asarray(self._predict(
-                self.params, jnp.asarray(X[lo:lo + batch])))
+                self.params,
+                core.place(X[lo:lo + batch], mesh=self.mesh)))
             hits += int((pred == y[lo:lo + batch]).sum())
         return hits / len(X)
 

@@ -126,9 +126,11 @@ class LightLDA:
                                   mesh=self.mesh, name=f"{name}_summary")
         self._scratch_word = self.word_topic.padded_shape[0] - 1
 
-        # worker-local doc-topic counts (+1 scratch doc for padded lanes)
+        # worker-local doc-topic counts (+1 scratch doc for padded lanes);
+        # placed on the mesh, NOT the default device (platform may differ)
         self._scratch_doc = self.num_docs
-        self._ndk = jnp.zeros((self.num_docs + 1, self.K), jnp.int32)
+        self._ndk = core.place(
+            np.zeros((self.num_docs + 1, self.K), np.int32), mesh=self.mesh)
 
         # token stream, padded to a whole number of superstep calls
         B, S = c.batch_tokens, c.steps_per_call
@@ -179,14 +181,15 @@ class LightLDA:
                 if len(token_docs) else np.zeros(self.num_docs, np.int64)
             doc_len = np.append(doc_len, max(T_pad - self.num_tokens, 1))
             doc_start = np.concatenate([[0], np.cumsum(doc_len)])[:-1]
-            self._doc_len = jnp.asarray(doc_len.astype(np.int32))
-            self._doc_start = jnp.asarray(doc_start.astype(np.int32))
-            self._inv_perm = jnp.asarray(np.argsort(perm).astype(np.int32))
+            self._doc_len = self._place(doc_len.astype(np.int32), P())
+            self._doc_start = self._place(doc_start.astype(np.int32), P())
+            self._inv_perm = self._place(np.argsort(perm).astype(np.int32),
+                                         P())
 
         # random initial assignments + count build (one jitted scatter)
         rng = np.random.default_rng(c.seed)
         z0 = rng.integers(0, self.K, T_pad).astype(np.int32)
-        self._z = jnp.asarray(z0)
+        self._z = self._place(z0, P())
         self._init_counts()
         self._build_superstep()
         if c.sampler == "mh":
@@ -194,7 +197,7 @@ class LightLDA:
         elif c.sampler != "gibbs":
             raise ValueError(f"sampler must be 'gibbs' or 'mh', "
                              f"got {c.sampler!r}")
-        self._key = jax.random.PRNGKey(c.seed)
+        self._key = core.prng_key(c.seed, mesh=self.mesh)
         self._calls_done = 0
         self.ll_history: list = []
 
@@ -211,9 +214,9 @@ class LightLDA:
             nk = nk.at[z].add(m)
             return nwk, ndk, nk
 
-        nwk, ndk, nk = build(self._z, jnp.asarray(self._tw),
-                             jnp.asarray(self._td),
-                             jnp.asarray(self._mask.astype(np.int32)))
+        nwk, ndk, nk = build(self._z, self._place(self._tw, P()),
+                             self._place(self._td, P()),
+                             self._place(self._mask.astype(np.int32), P()))
         self.word_topic.param = jax.device_put(nwk,
                                                self.word_topic.sharding)
         self._ndk = ndk
@@ -291,14 +294,18 @@ class LightLDA:
         @jax.jit
         def loglik(nwk, ndk, nk, ws, ds, mask):
             # per-token predictive LL under point estimates:
-            # log sum_k theta_dk * phi_wk
+            # log sum_k theta_dk * phi_wk. Operands are the pre-placed
+            # [S, B] superstep inputs (mask int32) — flatten here rather
+            # than re-uploading the corpus from host every eval.
+            ws, ds = ws.reshape(-1), ds.reshape(-1)
+            m = mask.reshape(-1).astype(jnp.float32)
             A = jnp.take(ndk, ds, axis=0).astype(jnp.float32)
             W = jnp.take(nwk, ws, axis=0).astype(jnp.float32)
             S = nk[:K].astype(jnp.float32)
             theta = (A + alpha) / (A.sum(1, keepdims=True) + K * alpha)
             phi = (W + beta) / (S + vbeta)
             ll = jnp.log(jnp.maximum((theta * phi).sum(1), 1e-30))
-            return (ll * mask).sum()
+            return (ll * m).sum()
 
         self._loglik = loglik
 
@@ -463,15 +470,13 @@ class LightLDA:
 
     def loglik(self) -> float:
         """Mean per-token predictive log-likelihood (the reference's
-        `Eval` role)."""
+        `Eval` role). Evaluates over the pre-placed device-resident call
+        slices — the token stream is static, so no host re-upload."""
         total = 0.0
-        B = self.config.batch_tokens * self.config.steps_per_call
-        for lo in range(0, len(self._tw), B):
+        for ws, ds, _idxs, msks in self._calls:
             total += float(self._loglik(
                 self.word_topic.param, self._ndk, self.summary.param,
-                jnp.asarray(self._tw[lo:lo + B]),
-                jnp.asarray(self._td[lo:lo + B]),
-                jnp.asarray(self._mask[lo:lo + B].astype(np.float32))))
+                ws, ds, msks))
         return total / max(self.num_tokens, 1)
 
     def doc_topics(self) -> np.ndarray:
@@ -494,7 +499,9 @@ class LightLDA:
         savez_stream(f"{uri_prefix}.state.npz",
                      {"magic": "multiverso_tpu.lda_state.v1",
                       "num_tokens": self.num_tokens,
-                      "perm_seed": self.config.seed},
+                      "perm_seed": self.config.seed,
+                      "t_pad": int(self._z.shape[0]),
+                      "calls_done": self._calls_done},
                      {"z": np.asarray(self._z),
                       "ndk": np.asarray(self._ndk)})
 
@@ -514,8 +521,19 @@ class LightLDA:
                 f"{manifest['perm_seed']}, app has seed "
                 f"{self.config.seed}: z is indexed in the seed-derived "
                 "stream permutation, so the seeds must match to resume")
-        self._z = jnp.asarray(data["z"])
-        self._ndk = jnp.asarray(data["ndk"])
+        # T_pad depends on batch_tokens * steps_per_call: a geometry
+        # mismatch would yield a wrong-length z whose out-of-range scatters
+        # silently corrupt counts (JAX clamps/drops OOB indices)
+        if len(data["z"]) != int(self._z.shape[0]):
+            raise ValueError(
+                f"checkpoint z length {len(data['z'])} != app stream "
+                f"length {int(self._z.shape[0])}: batch_tokens/"
+                "steps_per_call must match the checkpointing run to resume")
+        self._z = self._place(np.asarray(data["z"]), P())
+        self._ndk = self._place(np.asarray(data["ndk"]), P())
+        # resume the RNG sequence where the checkpoint left off; replaying
+        # consumed fold_in keys would correlate sweeps across the resume
+        self._calls_done = int(manifest.get("calls_done", 0))
 
 
 def main(argv=None) -> None:

@@ -52,6 +52,12 @@ class LogRegConfig:
     objective: str = "softmax"      # "softmax" | "sigmoid"
     seed: int = 0
 
+    def __post_init__(self) -> None:
+        if self.objective == "sigmoid" and self.num_classes != 2:
+            raise ValueError(
+                "objective='sigmoid' is the binary objective; it requires "
+                f"num_classes == 2, got {self.num_classes}")
+
 
 def read_libsvm(path: str, input_dim: int, dtype=np.float32,
                 one_based: Optional[bool] = None
@@ -61,10 +67,22 @@ def read_libsvm(path: str, input_dim: int, dtype=np.float32,
     The reference's `Sample` reader (Applications/LogisticRegression).
     Canonical libsvm is 1-based; ``one_based=None`` autodetects: a file
     containing index 0 is 0-based, one containing index == input_dim is
-    1-based (ambiguous files default to 0-based). Returns dense (X, y) —
-    dense is the TPU-friendly layout; the sparse path of the reference
-    maps to the KVTable app variant.
+    1-based; ambiguous files default to 1-based (the libsvm convention —
+    and pass the SAME explicit ``one_based`` for train and test files so
+    an ambiguous one cannot silently shift feature columns between them).
+    Returns dense (X, y) — dense is the TPU-friendly layout; the sparse
+    path of the reference maps to the KVTable app variant
+    (:mod:`multiverso_tpu.apps.sparse_logreg`).
     """
+    labels, rows = _parse_libsvm(path)
+    if one_based is None:
+        one_based = _resolve_base(*_base_markers(rows, input_dim),
+                                  what=repr(path), input_dim=input_dim)
+    return _densify(labels, rows, input_dim, one_based, dtype)
+
+
+def _parse_libsvm(path: str):
+    """One parse pass: (labels list, rows list of [(idx, val), ...])."""
     labels, rows = [], []
     with open(path) as f:
         for line in f:
@@ -74,17 +92,35 @@ def read_libsvm(path: str, input_dim: int, dtype=np.float32,
             labels.append(float(parts[0]))
             rows.append([(int(t[0]), float(t[1])) for t in
                          (tok.split(":") for tok in parts[1:])])
-    if one_based is None:
-        seen = [i for r in rows for i, _ in r]
-        has_zero = any(i == 0 for i in seen)
-        has_dim = any(i == input_dim for i in seen)
-        if has_zero and has_dim:
-            raise ValueError(
-                f"{path!r}: contains both index 0 and index {input_dim} — "
-                "cannot autodetect base; pass one_based explicitly")
-        one_based = has_dim
+    return labels, rows
+
+
+def _base_markers(rows, input_dim: int) -> Tuple[bool, bool]:
+    has_zero = has_dim = False
+    for r in rows:
+        for i, _ in r:
+            has_zero |= i == 0
+            has_dim |= i == input_dim
+    return has_zero, has_dim
+
+
+def _resolve_base(has_zero: bool, has_dim: bool, *, what: str,
+                  input_dim: int) -> bool:
+    """THE autodetect rule (single definition — read_libsvm and
+    detect_libsvm_base must never disagree on the same file): index 0 ⇒
+    0-based, index == input_dim ⇒ 1-based, both ⇒ error, neither ⇒
+    1-based (the libsvm convention)."""
+    if has_zero and has_dim:
+        raise ValueError(
+            f"{what}: contains both index 0 and index {input_dim} — "
+            "cannot autodetect base; pass one_based explicitly")
+    return not has_zero
+
+
+def _densify(labels, rows, input_dim: int, one_based: bool, dtype
+             ) -> Tuple[np.ndarray, np.ndarray]:
     off = 1 if one_based else 0
-    xs, ys = [], labels
+    xs = []
     for r in rows:
         row = np.zeros(input_dim, dtype=dtype)
         for i, val in r:
@@ -96,11 +132,24 @@ def read_libsvm(path: str, input_dim: int, dtype=np.float32,
             row[j] = val
         xs.append(row)
     X = np.stack(xs) if xs else np.zeros((0, input_dim), dtype)
-    y = np.asarray(ys)
+    y = np.asarray(labels)
     # labels may be {-1,+1} (binary libsvm) or {0..C-1}
     if set(np.unique(y)) <= {-1.0, 1.0}:
         y = (y > 0).astype(np.int32)
     return X, y.astype(np.int32)
+
+
+def detect_libsvm_base(paths, input_dim: int) -> bool:
+    """Detect the index base JOINTLY over several libsvm files (train +
+    test must agree or feature columns silently shift between them).
+    Same rule as ``read_libsvm``'s autodetect (shared ``_resolve_base``)."""
+    has_zero = has_dim = False
+    for path in paths:
+        hz, hd = _base_markers(_parse_libsvm(path)[1], input_dim)
+        has_zero |= hz
+        has_dim |= hd
+    return _resolve_base(has_zero, has_dim, what=repr(list(paths)),
+                         input_dim=input_dim)
 
 
 def synthetic_blobs(n: int, input_dim: int, num_classes: int,
@@ -237,7 +286,7 @@ class LogisticRegression:
     # -- inference / eval --------------------------------------------------
 
     def predict(self, X: np.ndarray) -> np.ndarray:
-        xs = jnp.asarray(X, jnp.float32)
+        xs = core.place(np.asarray(X, np.float32), mesh=self.mesh)
         return np.asarray(self._predict(self.table.param, xs))
 
     def accuracy(self, X: np.ndarray, y: np.ndarray) -> float:
@@ -289,15 +338,31 @@ def main(argv=None) -> None:
     )
     app = LogisticRegression(cfg)
     train_file = configure.get_flag("train_file")
+    test_file = configure.get_flag("test_file")
+    # parse each file ONCE, then detect the index base jointly over all of
+    # them: per-file detection could assign different bases to train and
+    # test, silently shifting feature columns between them
+    parsed = {f: _parse_libsvm(f) for f in (train_file, test_file) if f}
+    base = True
+    if parsed:
+        has_zero = has_dim = False
+        for _, rows in parsed.values():
+            hz, hd = _base_markers(rows, cfg.input_dim)
+            has_zero |= hz
+            has_dim |= hd
+        base = _resolve_base(has_zero, has_dim,
+                             what=repr(list(parsed)),
+                             input_dim=cfg.input_dim)
     if train_file:
-        X, y = read_libsvm(train_file, cfg.input_dim)
+        X, y = _densify(*parsed[train_file], cfg.input_dim, base,
+                        np.float32)
     else:
         X, y = synthetic_blobs(20000, cfg.input_dim, cfg.num_classes)
     app.train(X, y)
     log.info("train accuracy: %.4f", app.accuracy(X, y))
-    test_file = configure.get_flag("test_file")
     if test_file:
-        Xt, yt = read_libsvm(test_file, cfg.input_dim)
+        Xt, yt = _densify(*parsed[test_file], cfg.input_dim, base,
+                          np.float32)
         log.info("test accuracy: %.4f", app.accuracy(Xt, yt))
     out = configure.get_flag("output_model_file")
     if out:

@@ -127,11 +127,14 @@ class WordEmbedding:
                                  name=f"{name}_out")
         self._scratch = self.w_in.padded_shape[0] - 1  # masked-lane row
 
-        # negative-sampling alias table (device-resident constants)
+        # negative-sampling alias table: device-resident constants, placed
+        # replicated ON THE MESH (a bare jnp.asarray would land them on the
+        # process default device, which may be a different platform)
+        rep = partial(core.place, mesh=self.mesh)
         if c.objective == "ns":
             p, a = build_alias(corpus.unigram_probs(c.unigram_power))
-            self._alias_prob = jnp.asarray(p)
-            self._alias_idx = jnp.asarray(a)
+            self._alias_prob = rep(p)
+            self._alias_idx = rep(a)
         elif c.objective == "hs":
             codes, points, lengths = corpus.huffman(c.max_code_len)
             L = c.max_code_len
@@ -139,16 +142,16 @@ class WordEmbedding:
             # scratch row so the scatter is shape-static
             msk = np.arange(L)[None, :] < lengths[:, None]
             pts = np.where(msk, points[:, :L], self._scratch)
-            self._hs_points = jnp.asarray(pts.astype(np.int32))
-            self._hs_codes = jnp.asarray(codes[:, :L].astype(np.float32))
-            self._hs_mask = jnp.asarray(msk.astype(np.float32))
+            self._hs_points = rep(pts.astype(np.int32))
+            self._hs_codes = rep(codes[:, :L].astype(np.float32))
+            self._hs_mask = rep(msk.astype(np.float32))
         else:
             raise ValueError(f"objective must be 'ns' or 'hs', "
                              f"got {c.objective!r}")
         if c.model not in ("skipgram", "cbow"):
             raise ValueError(f"model must be 'skipgram' or 'cbow', "
                              f"got {c.model!r}")
-        self._key = jax.random.PRNGKey(c.seed)
+        self._key = core.prng_key(c.seed, mesh=self.mesh)
         self._step_no = 0
         self.loss_history: list = []
         self._build_superstep()
@@ -336,7 +339,7 @@ class WordEmbedding:
         with dashboard.profile("w2v.superstep"):
             self.w_in.param, self.w_out.param, loss = self._superstep(
                 self.w_in.param, self.w_out.param, sd, td, key,
-                jnp.asarray(lrs))
+                core.place(lrs, mesh=self.mesh))
         self._step_no += s
         return loss
 
@@ -376,7 +379,7 @@ def main(argv=None) -> None:
     configure.define_string("train_file", "", "corpus text file", overwrite=True)
     configure.define_int("size", 100, "embedding dimension", overwrite=True)
     configure.define_int("window", 5, "context window", overwrite=True)
-    configure.define_int("negative", 5, "negative samples (0 -> HS)")
+    configure.define_int("negative", 5, "negative samples (0 -> HS)", overwrite=True)
     configure.define_bool("cbow", False, "CBOW instead of skip-gram", overwrite=True)
     configure.define_int("epoch", 1, "epochs", overwrite=True)
     configure.define_int("batch_size", 1024, "pairs per step", overwrite=True)

@@ -122,6 +122,43 @@ def is_initialized() -> bool:
     return _RT.initialized
 
 
+def place(value, spec: P = P(), *, mesh: Optional[Mesh] = None) -> jax.Array:
+    """Put a host value on the runtime mesh (replicated by default).
+
+    Every device array an app creates MUST go through this (or an explicit
+    ``NamedSharding`` ``device_put``): a bare ``jnp.asarray`` materialises
+    on the process *default* device, which may be a different platform than
+    the mesh — e.g. a TPU-default process building a CPU test mesh — and
+    then either crashes the default backend or poisons a jit with
+    mixed-platform operands.
+    """
+    m = mesh if mesh is not None else globals()["mesh"]()
+    return jax.device_put(value, NamedSharding(m, spec))
+
+
+def prng_key(seed: int, *, mesh: Optional[Mesh] = None) -> jax.Array:
+    """A PRNG key resident on the mesh, never on the default device.
+
+    ``jax.random.PRNGKey(int)`` runs its seed-mixing ops eagerly on the
+    default backend — which may be a different (even broken) platform than
+    the mesh. Instead the key data is built on host and placed: for the
+    default ``threefry2x32`` impl, ``PRNGKey(seed)`` is exactly the
+    ``uint32[2]`` array ``[seed >> 32, seed & 0xffffffff]``, with negative
+    seeds two's-complement wrapped — full 64-bit seed semantics preserved
+    (verified against ``jax.random.PRNGKey`` in tests).
+    """
+    impl = jax.config.jax_default_prng_impl
+    if impl != "threefry2x32":   # pragma: no cover - non-default impl
+        return place(jax.random.PRNGKey(seed), mesh=mesh)
+    # x64-off canonicalisation wraps the seed to int32 and the hi word of
+    # threefry_seed's 32-by-32 logical shift is 0 — verified equal to
+    # jax.random.PRNGKey for the int64 range in tests; beyond int64 numpy
+    # raises OverflowError exactly like jax's canonicalisation does
+    wrapped = int(np.asarray(seed).astype(np.int64).astype(np.int32))
+    data = np.array([0, wrapped & 0xFFFFFFFF], dtype=np.uint32)
+    return place(data, mesh=mesh)
+
+
 def shutdown(finalize: bool = True) -> None:
     """``MV_ShutDown`` equivalent: drop the mesh; optionally report timing."""
     with _RT.lock:

@@ -178,7 +178,7 @@ class Table:
 
     def _resolve_option(self, option: Optional[AddOption]) -> AddOption:
         opt = option if option is not None else self.default_option
-        return opt.as_jax()
+        return opt.as_jax(self.mesh)
 
     def _bump_step(self) -> None:
         with self._option_lock:

@@ -189,9 +189,10 @@ class KVTable:
         value)."""
         keys = self._check_keys(keys)
         buckets = self._buckets_of(keys)
-        vals, found = self._lookup(self.keys, self.values,
-                                   jnp.asarray(_split_keys(keys)),
-                                   jnp.asarray(buckets))
+        vals, found = self._lookup(
+            self.keys, self.values,
+            core.place(_split_keys(keys), mesh=self.mesh),
+            core.place(buckets, mesh=self.mesh))
         return np.asarray(vals), np.asarray(found)
 
     def add(self, keys, deltas, option: Optional[AddOption] = None,
@@ -235,11 +236,11 @@ class KVTable:
         for b, fill in planned_fill.items():
             self._bucket_fill[b] = fill
 
-        opt = (option or self.default_option).as_jax()
+        opt = (option or self.default_option).as_jax(self.mesh)
+        put = lambda a: core.place(a, mesh=self.mesh)
         self.keys, self.values, self.state = self._scatter_update(
-            self.keys, self.values, self.state, jnp.asarray(buckets),
-            jnp.asarray(slot_ids), jnp.asarray(_split_keys(keys)),
-            jnp.asarray(deltas), opt)
+            self.keys, self.values, self.state, put(buckets),
+            put(slot_ids), put(_split_keys(keys)), put(deltas), opt)
         with self._option_lock:
             self.default_option.step += 1
         handle = Handle(

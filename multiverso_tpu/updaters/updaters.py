@@ -8,6 +8,7 @@ from typing import Any, Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 @jax.tree_util.register_dataclass
@@ -26,13 +27,21 @@ class AddOption:
     lam: float = 1e-8           # epsilon / regularization knob
     step: int = 0               # global step counter (adam bias correction)
 
-    def as_jax(self) -> "AddOption":
+    def as_jax(self, mesh=None) -> "AddOption":
+        """Scalar leaves as device arrays. With ``mesh``, the scalars are
+        placed replicated on that mesh — NOT on the process default device,
+        which may be a different platform than the table's mesh."""
+        if mesh is None:
+            put = jnp.asarray
+        else:
+            from multiverso_tpu import core
+            put = lambda x, dt: core.place(np.asarray(x, dt), mesh=mesh)
         return AddOption(
-            learning_rate=jnp.asarray(self.learning_rate, jnp.float32),
-            momentum=jnp.asarray(self.momentum, jnp.float32),
-            rho=jnp.asarray(self.rho, jnp.float32),
-            lam=jnp.asarray(self.lam, jnp.float32),
-            step=jnp.asarray(self.step, jnp.int32),
+            learning_rate=put(self.learning_rate, jnp.float32),
+            momentum=put(self.momentum, jnp.float32),
+            rho=put(self.rho, jnp.float32),
+            lam=put(self.lam, jnp.float32),
+            step=put(self.step, jnp.int32),
         )
 
 

@@ -45,8 +45,9 @@ class OneBitQuantizer:
     @partial(jax.jit, static_argnums=0)
     def quantize(self, delta: jax.Array,
                  residual: Optional[jax.Array] = None):
-        """Returns (bits uint8 [n_blocks, block/8...] packed as int8 sign
-        in {0,1}, scales f32 [n_blocks], new_residual like delta)."""
+        """Returns (sign int8 [n_blocks, block] in {0,1} — UNPACKED, one
+        byte per element; use :meth:`pack_signs` for the 1-bit wire format
+        — pos/neg scales f32 [n_blocks], new_residual like delta)."""
         if residual is not None:
             delta = delta + residual
         blocks, n = _block_view(delta, self.block)
@@ -72,6 +73,24 @@ class OneBitQuantizer:
                         -neg_scale[:, None])
         n = int(np.prod(shape))
         return deq.reshape(-1)[:n].reshape(shape)
+
+    @partial(jax.jit, static_argnums=0)
+    def pack_signs(self, sign: jax.Array) -> jax.Array:
+        """[n_blocks, block] {0,1} → uint8 [n_blocks, block//8]: the actual
+        1-bit wire format (8 signs per byte, LSB-first) for DCN-crossing
+        transfers. ``block`` must be a multiple of 8 (default 512 is)."""
+        nb, blk = sign.shape
+        grouped = sign.astype(jnp.uint8).reshape(nb, blk // 8, 8)
+        shifts = jnp.arange(8, dtype=jnp.uint8)
+        return jnp.sum(grouped << shifts, axis=-1).astype(jnp.uint8)
+
+    @partial(jax.jit, static_argnums=0)
+    def unpack_signs(self, packed: jax.Array) -> jax.Array:
+        """uint8 [n_blocks, block//8] → int8 [n_blocks, block] {0,1}."""
+        nb, nbytes = packed.shape
+        shifts = jnp.arange(8, dtype=jnp.uint8)
+        bits = (packed[..., None] >> shifts) & jnp.uint8(1)
+        return bits.reshape(nb, nbytes * 8).astype(jnp.int8)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -133,3 +133,29 @@ def test_remainder_batch(mesh_dp8):
         name="lr_rem")
     app.train(X, y)
     assert app.accuracy(X, y) > 0.85
+
+
+def test_read_libsvm_ambiguous_defaults_one_based(tmp_path):
+    # neither index 0 nor index input_dim present: the libsvm convention
+    # (1-based) must win, and must match what a marker-bearing sibling
+    # file would get — columns may not silently shift between files
+    p = tmp_path / "ambig.libsvm"
+    p.write_text("1 2:5.0\n")
+    X, _ = read_libsvm(str(p), input_dim=4)
+    assert X[0, 1] == 5.0        # index 2, 1-based -> column 1
+
+
+def test_detect_libsvm_base_joint(tmp_path):
+    from multiverso_tpu.apps.logreg import detect_libsvm_base
+    train = tmp_path / "train.libsvm"
+    test = tmp_path / "test.libsvm"
+    train.write_text("1 0:1.0 2:2.0\n")    # has index 0 -> 0-based
+    test.write_text("0 2:3.0\n")           # ambiguous alone
+    assert detect_libsvm_base([str(train), str(test)], input_dim=4) is False
+    X, _ = read_libsvm(str(test), input_dim=4, one_based=False)
+    assert X[0, 2] == 3.0
+
+
+def test_sigmoid_requires_two_classes():
+    with pytest.raises(ValueError, match="sigmoid"):
+        LogRegConfig(input_dim=4, num_classes=3, objective="sigmoid")

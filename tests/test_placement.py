@@ -1,0 +1,167 @@
+"""Device-placement regression tests (VERDICT r1 #1).
+
+The driver runs ``dryrun_multichip`` with a CPU mesh inside a process whose
+DEFAULT device may be a TPU (and in the r1 driver env, a *broken* TPU
+client: any default-device array creation crashed with rc=1). Every device
+array an app creates must therefore be placed relative to its mesh, never
+via bare ``jnp.asarray`` / default ``device_put``.
+
+The rig: build the mesh over devices 4..7 ONLY. The process default device
+(device 0 — or the real TPU when the axon platform is up) is *outside* the
+mesh, so any stray default-device creation shows up as a live array on a
+non-mesh device.
+"""
+
+import gc
+
+import jax
+import numpy as np
+import pytest
+
+from multiverso_tpu import core
+from multiverso_tpu.tables import base as table_base
+
+
+@pytest.fixture()
+def offset_mesh(devices):
+    """2x2 mesh over CPU devices 4..7 — default device NOT in the mesh."""
+    m = core.init(devices=devices[4:8], data_parallel=2, model_parallel=2)
+    yield m
+    table_base.reset_tables()
+    core.shutdown()
+
+
+def _snapshot():
+    gc.collect()
+    return {id(a) for a in jax.live_arrays()}
+
+
+def _assert_no_strays(before, mesh):
+    gc.collect()
+    allowed = set(mesh.devices.flat)
+    strays = []
+    for a in jax.live_arrays():
+        if id(a) in before:
+            continue
+        try:
+            devs = set(a.devices())
+        except Exception:
+            continue    # deleted/donated buffers
+        if not devs <= allowed:
+            strays.append((a.shape, str(a.dtype),
+                           sorted(str(d) for d in devs)))
+    assert not strays, (
+        f"{len(strays)} array(s) created outside the mesh "
+        f"(default-device leak): {strays[:8]}")
+
+
+def _tiny_corpus(vocab=32, tokens=2048, seed=0):
+    from multiverso_tpu.data.native import CorpusData
+    from multiverso_tpu.data.corpus import Corpus
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab, tokens).astype(np.int32)
+    counts = np.bincount(ids, minlength=vocab).astype(np.int64)
+    data = CorpusData(words=[f"w{i}" for i in range(vocab)],
+                      counts=np.maximum(counts, 1), ids=ids,
+                      total_raw_tokens=tokens)
+    return Corpus(data, subsample=0)
+
+
+def test_w2v_ns_no_default_device_leak(offset_mesh):
+    from multiverso_tpu.apps.word_embedding import W2VConfig, WordEmbedding
+    corpus = _tiny_corpus()
+    before = _snapshot()
+    app = WordEmbedding(
+        corpus,
+        W2VConfig(embedding_dim=8, window=2, negative=2, batch_size=16,
+                  steps_per_call=2, epochs=1, subsample=0),
+        mesh=offset_mesh, name="plc_w2v")
+    app.train(total_steps=2)
+    assert np.all(np.isfinite(app.loss_history))
+    _assert_no_strays(before, offset_mesh)
+
+
+def test_w2v_hs_cbow_no_default_device_leak(offset_mesh):
+    from multiverso_tpu.apps.word_embedding import W2VConfig, WordEmbedding
+    corpus = _tiny_corpus()
+    before = _snapshot()
+    app = WordEmbedding(
+        corpus,
+        W2VConfig(embedding_dim=8, window=2, model="cbow", objective="hs",
+                  batch_size=16, steps_per_call=2, epochs=1, subsample=0),
+        mesh=offset_mesh, name="plc_w2v_hs")
+    app.train(total_steps=2)
+    assert np.all(np.isfinite(app.loss_history))
+    _assert_no_strays(before, offset_mesh)
+
+
+@pytest.mark.parametrize("sampler", ["gibbs", "mh"])
+def test_lda_no_default_device_leak(offset_mesh, sampler, tmp_path):
+    from multiverso_tpu.apps.lightlda import LDAConfig, LightLDA
+    rng = np.random.default_rng(0)
+    tw = rng.integers(0, 16, 48).astype(np.int32)
+    td = np.sort(rng.integers(0, 4, 48)).astype(np.int32)
+    before = _snapshot()
+    app = LightLDA(tw, td, 16,
+                   LDAConfig(num_topics=4, batch_tokens=8, steps_per_call=2,
+                             sampler=sampler, seed=0),
+                   mesh=offset_mesh, name=f"plc_lda_{sampler}")
+    app.sweep()
+    assert np.isfinite(app.loglik())
+    if sampler == "gibbs":
+        app.store(str(tmp_path / "ck"))
+        app.load(str(tmp_path / "ck"))
+        app.sweep()
+    _assert_no_strays(before, offset_mesh)
+
+
+def test_logreg_no_default_device_leak(offset_mesh):
+    from multiverso_tpu.apps.logreg import (LogisticRegression, LogRegConfig,
+                                            synthetic_blobs)
+    X, y = synthetic_blobs(64, input_dim=6, num_classes=3)
+    before = _snapshot()
+    app = LogisticRegression(
+        LogRegConfig(input_dim=6, num_classes=3, minibatch_size=16,
+                     epochs=1),
+        mesh=offset_mesh, name="plc_lr")
+    app.train(X, y)
+    app.predict(X[:8])
+    _assert_no_strays(before, offset_mesh)
+
+
+def test_tables_no_default_device_leak(offset_mesh):
+    from multiverso_tpu.tables import ArrayTable, KVTable, MatrixTable
+    before = _snapshot()
+    at = ArrayTable(10, "float32", mesh=offset_mesh, name="plc_at")
+    at.add(np.ones(10, np.float32))
+    at.get()
+    mt = MatrixTable(6, 4, "float32", updater="adagrad", mesh=offset_mesh,
+                     name="plc_mt")
+    mt.add_rows([1, 3], np.ones((2, 4), np.float32))
+    mt.get_rows([0, 1, 5])
+    kv = KVTable(64, value_dim=2, mesh=offset_mesh, name="plc_kv")
+    kv.add(np.array([7, 9], np.uint64), np.ones((2, 2), np.float32))
+    kv.get(np.array([7, 9, 11], np.uint64))
+    _assert_no_strays(before, offset_mesh)
+
+
+def test_dryrun_multichip_on_offset_devices(devices):
+    """The driver contract end-to-end, but importable-path level: the
+    graft entry must run the full multi-app dryrun without touching any
+    device outside the mesh it builds."""
+    import __graft_entry__ as ge
+    ge.dryrun_multichip(4)
+
+
+def test_prng_key_matches_jax_semantics(offset_mesh):
+    """core.prng_key must reproduce jax.random.PRNGKey exactly (incl.
+    negative and >=2**32 seeds) while living on the mesh."""
+    for seed in (0, 1, 42, -1, -12345, 2**31 - 1, -2**31, 2**32,
+                 2**32 + 7, -2**31 - 1, 2**63 - 1):
+        mine = core.prng_key(seed, mesh=offset_mesh)
+        ref = jax.random.PRNGKey(seed)
+        np.testing.assert_array_equal(np.asarray(mine), np.asarray(ref),
+                                      err_msg=f"seed={seed}")
+        assert set(mine.devices()) <= set(offset_mesh.devices.flat)
+    with pytest.raises(OverflowError):   # beyond int64, like jax
+        core.prng_key(2**63, mesh=offset_mesh)

@@ -78,3 +78,15 @@ def test_rounding_int8_range():
     qq, _ = q.quantize(x, jax.random.PRNGKey(0))
     assert qq.dtype == jnp.int8
     assert int(np.abs(np.asarray(qq)).max()) <= 127
+
+
+def test_onebit_sign_packing_roundtrip():
+    q = OneBitQuantizer(block=64)
+    rng = np.random.default_rng(5)
+    delta = jnp.asarray(rng.normal(0, 1, (130,)).astype(np.float32))
+    sign, ps, ns, _ = q.quantize(delta)
+    packed = q.pack_signs(sign)
+    assert packed.dtype == jnp.uint8
+    assert packed.shape == (sign.shape[0], sign.shape[1] // 8)  # true 1-bit
+    assert np.array_equal(np.asarray(q.unpack_signs(packed)),
+                          np.asarray(sign))
