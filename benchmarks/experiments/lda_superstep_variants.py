@@ -1,0 +1,294 @@
+"""LDA Gibbs superstep variant shootout (single real TPU chip).
+
+Round-2 profiling of the production superstep (apps/lightlda.py) showed
+~31% of device time in raw `copy` ops: the [B,K]=2GB posterior
+intermediates get layout-copied around XLA's reduce_window cumsum
+(f32[500000,8,128]{0,1,2} -> {0,2,1} transpose copies), plus the gather
+outputs copied before the posterior fusion.  Variants here attack that:
+
+- v0_current: the production body (baseline).
+- v1_twolevel: hierarchical inverse-CDF — probs.reshape(B,C,L), chunk
+  sums [B,C], tiny cumsum picks chunk, re-gather the chosen [B,L] chunk,
+  small cumsum picks lane.  No [B,K] cumsum, no transpose copy.
+- v2_twolevel_bf16: v1 with the posterior stored bf16 (halves the probs
+  traffic; the two-level re-normalization keeps sampling resolution at
+  the 128-lane level, not the 1024-level that made bf16 lossy before).
+- v3_nk_colsum: v1 + drop the nk carry, recompute summary as a column
+  sum of nwk (exact: nwk receives the same decrements).
+
+Run:  python benchmarks/experiments/lda_superstep_variants.py
+"""
+
+import sys, time, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+V, D, T, K = 50_000, 100_000, 10_000_000, 1024
+B = 500_000
+ALPHA, BETA = 50.0 / K, 0.01
+VBETA = V * BETA
+
+
+def make_data(seed=0):
+    rng = np.random.default_rng(seed)
+    p = 1.0 / np.arange(1, V + 1) ** 1.1
+    p /= p.sum()
+    tw = rng.choice(V, T, p=p).astype(np.int32)
+    td = np.sort(rng.integers(0, D, T)).astype(np.int32)
+    z = rng.integers(0, K, T).astype(np.int32)
+    return tw, td, z
+
+
+def init_counts(tw, td, z):
+    nwk = np.zeros((V + 1, K), np.int32)
+    np.add.at(nwk, (tw, z), 1)
+    ndk = np.zeros((D + 1, K), np.int32)
+    np.add.at(ndk, (td, z), 1)
+    nk = np.bincount(z, minlength=K).astype(np.int32)
+    return nwk, ndk, nk
+
+
+# ---------------------------------------------------------------- v0
+def v0_body(nwk, ndk, nk, z, w, d, idx, msk, key):
+    zi = jnp.take(z, idx)
+    one = msk
+    nwk = nwk.at[w, zi].add(-one)
+    ndk = ndk.at[d, zi].add(-one)
+    oh_old = jax.nn.one_hot(zi, K, dtype=jnp.int32) * one[:, None]
+    nk = nk.at[:K].add(-oh_old.sum(0))
+    ft = jnp.float32
+    A = jnp.take(ndk, d, axis=0).astype(ft)
+    W = jnp.take(nwk, w, axis=0).astype(ft)
+    S = (nk[:K].astype(jnp.float32) + VBETA).astype(ft)
+    probs = jnp.maximum((A + ft(ALPHA)) * (W + ft(BETA)), ft(0.0)) / S
+    cdf = jnp.cumsum(probs, axis=1)
+    u = jax.random.uniform(key, (probs.shape[0], 1)).astype(ft) * cdf[:, -1:]
+    znew = jnp.minimum((cdf < u).sum(axis=1), K - 1).astype(jnp.int32)
+    nwk = nwk.at[w, znew].add(one)
+    ndk = ndk.at[d, znew].add(one)
+    oh_new = jax.nn.one_hot(znew, K, dtype=jnp.int32) * one[:, None]
+    nk = nk.at[:K].add(oh_new.sum(0))
+    z = z.at[idx].set(znew)
+    return nwk, ndk, nk, z
+
+
+# ---------------------------------------------------------------- v1/v2
+def twolevel_sample(probs, key, chunk=128):
+    """probs [B, K] (any float dtype) -> z [B] int32, two-level
+    inverse-CDF: chunk totals then within-chunk."""
+    Bn, Kn = probs.shape
+    C = Kn // chunk
+    p3 = probs.reshape(Bn, C, chunk)
+    csum = p3.sum(-1, dtype=jnp.float32)             # [B, C]
+    ccdf = jnp.cumsum(csum, axis=1)                  # [B, C] (small)
+    k1, k2 = jax.random.split(key)
+    u1 = jax.random.uniform(k1, (Bn, 1)) * ccdf[:, -1:]
+    c = jnp.minimum((ccdf < u1).sum(1), C - 1).astype(jnp.int32)  # [B]
+    sub = jnp.take_along_axis(p3, c[:, None, None], axis=1)[:, 0, :]
+    sub = sub.astype(jnp.float32)                    # [B, chunk]
+    scdf = jnp.cumsum(sub, axis=1)
+    u2 = jax.random.uniform(k2, (Bn, 1)) * scdf[:, -1:]
+    lane = jnp.minimum((scdf < u2).sum(1), chunk - 1).astype(jnp.int32)
+    return c * chunk + lane
+
+
+def make_v12_body(ft):
+    def body(nwk, ndk, nk, z, w, d, idx, msk, key):
+        zi = jnp.take(z, idx)
+        one = msk
+        nwk = nwk.at[w, zi].add(-one)
+        ndk = ndk.at[d, zi].add(-one)
+        oh_old = jax.nn.one_hot(zi, K, dtype=jnp.int32) * one[:, None]
+        nk = nk.at[:K].add(-oh_old.sum(0))
+        A = jnp.take(ndk, d, axis=0).astype(ft)
+        W = jnp.take(nwk, w, axis=0).astype(ft)
+        S = (nk[:K].astype(jnp.float32) + VBETA).astype(ft)
+        probs = jnp.maximum((A + ft(ALPHA)) * (W + ft(BETA)), ft(0.0)) / S
+        znew = twolevel_sample(probs, key)
+        nwk = nwk.at[w, znew].add(one)
+        ndk = ndk.at[d, znew].add(one)
+        oh_new = jax.nn.one_hot(znew, K, dtype=jnp.int32) * one[:, None]
+        nk = nk.at[:K].add(oh_new.sum(0))
+        z = z.at[idx].set(znew)
+        return nwk, ndk, nk, z
+    return body
+
+
+# ---------------------------------------------------------------- v3
+def v3_body(nwk, ndk, nk, z, w, d, idx, msk, key):
+    """nk is NOT a carry: recomputed as colsum(nwk) after the decrement
+    scatter — identical value (nwk received the same batch decrement),
+    kills both one-hot reductions and the nk scatter."""
+    zi = jnp.take(z, idx)
+    one = msk
+    nwk = nwk.at[w, zi].add(-one)
+    ndk = ndk.at[d, zi].add(-one)
+    nk = nwk[:V].sum(0)                               # [K] int32
+    ft = jnp.float32
+    A = jnp.take(ndk, d, axis=0).astype(ft)
+    W = jnp.take(nwk, w, axis=0).astype(ft)
+    S = nk.astype(jnp.float32) + VBETA
+    probs = jnp.maximum((A + ft(ALPHA)) * (W + ft(BETA)), ft(0.0)) / S
+    znew = twolevel_sample(probs, key)
+    nwk = nwk.at[w, znew].add(one)
+    ndk = ndk.at[d, znew].add(one)
+    z = z.at[idx].set(znew)
+    return nwk, ndk, nk, z
+
+
+def bench(name, body, tw, td, z0, sweeps=2):
+    nwk0, ndk0, nk0 = init_counts(tw, td, z0)
+    nwk = jnp.asarray(nwk0); ndk = jnp.asarray(ndk0)
+    nk = jnp.asarray(np.concatenate([nk0, [0]]) if False else nk0)
+    z = jnp.asarray(z0)
+    tws = jnp.asarray(tw); tds = jnp.asarray(td)
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+    def step(nwk, ndk, nk, z, w, d, idx, msk, key):
+        return body(nwk, ndk, nk, z, w, d, idx, msk, key)
+
+    nsteps = T // B
+    key = jax.random.PRNGKey(0)
+    idxs = [jnp.arange(i * B, (i + 1) * B, dtype=jnp.int32)
+            for i in range(nsteps)]
+    ws = [jnp.take(tws, ix) for ix in idxs]
+    ds = [jnp.take(tds, ix) for ix in idxs]
+    msk = jnp.ones(B, jnp.int32)
+
+    def sweep(nwk, ndk, nk, z, base):
+        for i in range(nsteps):
+            k = jax.random.fold_in(key, base + i)
+            nwk, ndk, nk, z = step(nwk, ndk, nk, z, ws[i], ds[i],
+                                   idxs[i], msk, k)
+        return nwk, ndk, nk, z
+
+    nwk, ndk, nk, z = sweep(nwk, ndk, nk, z, 0)   # compile + warm
+    jax.block_until_ready(nk)
+    t0 = time.perf_counter()
+    for s in range(sweeps):
+        nwk, ndk, nk, z = sweep(nwk, ndk, nk, z, (s + 1) * nsteps)
+    jax.block_until_ready(nk)
+    dt = time.perf_counter() - t0
+    tps = T * sweeps / dt
+    # sanity: counts conserved
+    tot = int(jnp.sum(nk[:K]))
+    print(f"{name:24s} {tps/1e6:8.2f}M tok/s   ({dt:.3f}s/{sweeps} sweeps)"
+          f"  nk_total={tot} (expect {T})")
+    return tps
+
+
+if __name__ == "__main__":
+    tw, td, z0 = make_data()
+    results = {}
+    results["v0_current"] = bench("v0_current", v0_body, tw, td, z0)
+    results["v1_twolevel"] = bench(
+        "v1_twolevel", make_v12_body(jnp.float32), tw, td, z0)
+    results["v2_twolevel_bf16"] = bench(
+        "v2_twolevel_bf16", make_v12_body(jnp.bfloat16), tw, td, z0)
+    results["v3_nk_colsum"] = bench("v3_nk_colsum", v3_body, tw, td, z0)
+    best = max(results, key=results.get)
+    print(f"best: {best} at {results[best]/1e6:.2f}M tok/s "
+          f"(baseline target 16.3M for 8x)")
+
+
+# ---------------------------------------------------------------- v4/v5
+# Tile-aligned counts: [N, K] -> [N, C=K/128, 128] so one logical row is
+# exactly one (8,128) int32 TPU tile -> a random-row gather reads 4KB of
+# payload instead of a 32KB tile-span (8x read amplification measured on
+# the 2-D layout).  Own-token count removal happens IN-REGISTER (fused
+# iota-compare subtract) instead of via decrement scatters: standard
+# collapsed Gibbs (remove self), batch-stale for *other* tokens (AD-LDA),
+# and it halves the scatter traffic.  nk is recomputed by column sum
+# (exact; nwk received identical updates).
+L_LANES = 128
+
+
+def make_v45_body(ft):
+    C = K // L_LANES
+
+    def body(nwk3, ndk3, nk, z, w, d, idx, msk, key):
+        zi = jnp.take(z, idx)                         # [B]
+        one = msk
+        A = jnp.take(ndk3, d, axis=0)                 # [B, C, 128] int32
+        W = jnp.take(nwk3, w, axis=0)                 # [B, C, 128] int32
+        kk = (jnp.arange(C * L_LANES, dtype=jnp.int32)
+              .reshape(1, C, L_LANES))
+        self_oh = ((kk == zi[:, None, None]) & (one[:, None, None] > 0))
+        Af = (A - self_oh).astype(ft)
+        Wf = (W - self_oh).astype(ft)
+        S = (nk.reshape(1, C, L_LANES).astype(jnp.float32) + VBETA)
+        probs = jnp.maximum((Af + ft(ALPHA)) * (Wf + ft(BETA)), ft(0.0)) \
+            / S.astype(ft)
+        # note: S keeps the token's own count (a +1 in a ~T/K-sized
+        # denominator) — the standard sparse-LDA-style approximation;
+        # the numerator (the sharp factor) removes self exactly.
+        cs = probs.sum(-1, dtype=jnp.float32)         # [B, C]
+        ccdf = jnp.cumsum(cs, axis=1)
+        k1, k2 = jax.random.split(key)
+        u1 = jax.random.uniform(k1, (cs.shape[0], 1)) * ccdf[:, -1:]
+        c = jnp.minimum((ccdf < u1).sum(1), C - 1).astype(jnp.int32)
+        sub = jnp.take_along_axis(
+            probs, c[:, None, None], axis=1)[:, 0, :].astype(jnp.float32)
+        scdf = jnp.cumsum(sub, axis=1)
+        u2 = jax.random.uniform(k2, (cs.shape[0], 1)) * scdf[:, -1:]
+        lane = jnp.minimum((scdf < u2).sum(1),
+                           L_LANES - 1).astype(jnp.int32)
+        znew = jnp.where(one > 0, c * L_LANES + lane, zi)
+        # apply the net move: -1 at old, +1 at new (2 scatters per array)
+        cold, lold = zi // L_LANES, zi % L_LANES
+        cnew, lnew = znew // L_LANES, znew % L_LANES
+        nwk3 = nwk3.at[w, cold, lold].add(-one)
+        nwk3 = nwk3.at[w, cnew, lnew].add(one)
+        ndk3 = ndk3.at[d, cold, lold].add(-one)
+        ndk3 = ndk3.at[d, cnew, lnew].add(one)
+        nk = nwk3[:V].sum(0).reshape(-1)
+        z = z.at[idx].set(znew)
+        return nwk3, ndk3, nk, z
+    return body
+
+
+def bench3(name, body, tw, td, z0, sweeps=2):
+    C = K // L_LANES
+    nwk0, ndk0, nk0 = init_counts(tw, td, z0)
+    nwk = jnp.asarray(nwk0.reshape(V + 1, C, L_LANES))
+    ndk = jnp.asarray(ndk0.reshape(D + 1, C, L_LANES))
+    nk = jnp.asarray(nk0)
+    z = jnp.asarray(z0)
+    tws = jnp.asarray(tw); tds = jnp.asarray(td)
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+    def step(nwk, ndk, nk, z, w, d, idx, msk, key):
+        return body(nwk, ndk, nk, z, w, d, idx, msk, key)
+
+    nsteps = T // B
+    key = jax.random.PRNGKey(0)
+    idxs = [jnp.arange(i * B, (i + 1) * B, dtype=jnp.int32)
+            for i in range(nsteps)]
+    ws = [jnp.take(tws, ix) for ix in idxs]
+    ds = [jnp.take(tds, ix) for ix in idxs]
+    msk = jnp.ones(B, jnp.int32)
+    nwk, ndk, nk, z = step(nwk, ndk, nk, z, ws[0], ds[0], idxs[0], msk, key)
+    _ = np.asarray(nk)
+    t0 = time.perf_counter()
+    nrun = 0
+    for s in range(sweeps):
+        for i in range(nsteps):
+            if s == 0 and i == 0:
+                continue
+            k = jax.random.fold_in(key, s * nsteps + i)
+            nwk, ndk, nk, z = step(nwk, ndk, nk, z, ws[i], ds[i],
+                                   idxs[i], msk, k)
+            nrun += 1
+    _ = np.asarray(nk)
+    dt = time.perf_counter() - t0
+    tps = nrun * B / dt
+    tot = int(np.asarray(nk).sum())
+    print(f"{name:24s} {tps/1e6:8.2f}M tok/s   ({dt:.3f}s/{nrun} steps)"
+          f"  nk_total={tot} (expect {T})")
+    return tps

@@ -152,9 +152,12 @@ def prng_key(seed: int, *, mesh: Optional[Mesh] = None) -> jax.Array:
         return place(jax.random.PRNGKey(seed), mesh=mesh)
     # x64-off canonicalisation wraps the seed to int32 and the hi word of
     # threefry_seed's 32-by-32 logical shift is 0 — verified equal to
-    # jax.random.PRNGKey for the int64 range in tests; beyond int64 numpy
-    # raises OverflowError exactly like jax's canonicalisation does
-    wrapped = int(np.asarray(seed).astype(np.int64).astype(np.int32))
+    # jax.random.PRNGKey for the int64 range in tests; beyond int64 raise
+    # OverflowError exactly like jax's canonicalisation does (numpy 2.x
+    # would silently give uint64/object dtype instead of raising)
+    if not (-(2 ** 63) <= int(seed) < 2 ** 63):
+        raise OverflowError(f"seed {seed} out of int64 range")
+    wrapped = int(np.asarray(int(seed), dtype=np.int64).astype(np.int32))
     data = np.array([0, wrapped & 0xFFFFFFFF], dtype=np.uint32)
     return place(data, mesh=mesh)
 

@@ -94,27 +94,57 @@ class Handle:
     """Async completion handle (the reference's Waiter, SURVEY.md §3.7):
     wraps dispatched device values; ``wait()`` blocks until they land.
 
-    An add-handle's buffer may be donated to a LATER update before
-    ``wait()`` is called (donation deletes the buffer on TPU). Updates
-    apply in program order, so waiting on the table's *current* buffers
-    subsumes waiting on the older ones — ``fallback`` provides them.
+    Contract (explicit, generation-based — no exception sniffing):
+
+    - A **get-handle** wraps a stable snapshot buffer (never donated);
+      ``wait()`` blocks on it and returns exactly that snapshot.
+    - An **add-handle** records the table and the *generation* its update
+      produced. Updates apply in program order, so by the time the
+      table's current buffers are ready, every generation ≤ the current
+      one has been applied. ``wait()`` on an add-handle therefore blocks
+      on the table's live buffers and returns the CURRENT param value —
+      which is the handle's own result only while the handle is the
+      latest update; a superseded handle returns the newer state (use
+      :meth:`superseded` to distinguish). The original buffer is never
+      touched after donation.
     """
 
-    def __init__(self, values: Any, fallback=None) -> None:
+    def __init__(self, values: Any = None, *, table: "Table" = None,
+                 generation: Optional[int] = None) -> None:
+        if (values is None) == (table is None):
+            raise ValueError("Handle wraps either snapshot values or a "
+                             "(table, generation) pair")
         self._values = values
-        self._fallback = fallback
+        self._table = table
+        self._generation = generation
+
+    @property
+    def generation(self) -> Optional[int]:
+        """The table generation this add-handle's update produced
+        (None for get-handles)."""
+        return self._generation
+
+    def superseded(self) -> bool:
+        """True when a later update has been applied to the table since
+        this handle was issued: ``wait()`` will return the newer state."""
+        return (self._table is not None
+                and self._table.generation > self._generation)
+
+    def done(self) -> bool:
+        """Non-blocking completion check."""
+        values = self._values if self._table is None \
+            else self._table._live_buffers()
+        return all(getattr(v, "is_ready", lambda: True)()
+                   for v in jax.tree.leaves(values))
 
     def wait(self) -> Any:
-        try:
+        if self._table is None:
             jax.block_until_ready(self._values)
-        except RuntimeError:
-            if self._fallback is None:
-                raise
-            # the original buffer was donated to a later update; the live
-            # table buffers subsume it — return those, never the dead array
-            self._values = self._fallback()
-            jax.block_until_ready(self._values)
-        return self._values
+            return self._values
+        # program order: the current buffers being ready implies this
+        # handle's generation has been applied
+        jax.block_until_ready(self._table._live_buffers())
+        return self._table._live_value()
 
     # the reference's GetAsync returns data through the waiting buffer;
     # here the handle carries the result.
@@ -139,6 +169,9 @@ class Table:
         self.updater: Updater = get_updater(updater_name)
         self.default_option = default_option or AddOption()
         self._option_lock = threading.Lock()
+        # monotonically increasing update counter backing the Handle
+        # generation contract (bumped on every applied update/load)
+        self.generation = 0
 
         # pad leading dim to a multiple of the model-axis size
         # (subclasses override _pad_lead to reserve scratch rows)
@@ -183,6 +216,7 @@ class Table:
     def _bump_step(self) -> None:
         with self._option_lock:
             self.default_option.step += 1
+            self.generation += 1
 
     # -- the Get/Add contract ---------------------------------------------
 
@@ -234,7 +268,7 @@ class Table:
         self.param, self.state = self._apply(self.param, self.state,
                                              delta, opt)
         self._bump_step()
-        handle = Handle(self.param, fallback=lambda: self.param)
+        handle = Handle(table=self, generation=self.generation)
         if sync:
             handle.wait()
         return handle
@@ -243,7 +277,16 @@ class Table:
 
     def wait(self) -> None:
         """Block until all outstanding updates on this table are applied."""
-        jax.block_until_ready((self.param, self.state))
+        jax.block_until_ready(self._live_buffers())
+
+    def _live_buffers(self) -> Any:
+        """The buffers an add-handle's wait() blocks on (KVTable adds its
+        key store)."""
+        return (self.param, self.state)
+
+    def _live_value(self) -> Any:
+        """What an add-handle's wait() returns: the current param array."""
+        return self.param
 
     # -- checkpoint (ServerTable::Store/Load) ------------------------------
 

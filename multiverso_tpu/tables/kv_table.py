@@ -99,6 +99,7 @@ class KVTable:
         self.updater = get_updater(updater_name)
         self.default_option = default_option or AddOption()
         self._option_lock = threading.Lock()
+        self.generation = 0
 
         shards = self.mesh.shape[core.MODEL_AXIS]
         buckets = -(-capacity // self.slots)
@@ -243,15 +244,20 @@ class KVTable:
             put(slot_ids), put(_split_keys(keys)), put(deltas), opt)
         with self._option_lock:
             self.default_option.step += 1
-        handle = Handle(
-            self.values,
-            fallback=lambda: self.values)
+            self.generation += 1
+        handle = Handle(table=self, generation=self.generation)
         if sync:
             handle.wait()
         return handle
 
     def wait(self) -> None:
-        jax.block_until_ready((self.keys, self.values, self.state))
+        jax.block_until_ready(self._live_buffers())
+
+    def _live_buffers(self):
+        return (self.keys, self.values, self.state)
+
+    def _live_value(self):
+        return self.values
 
     def __len__(self) -> int:
         return len(self._slot_map)

@@ -180,8 +180,7 @@ class MatrixTable(Table):
             self.param, self.state = self._gather_apply_scatter(
                 self.param, self.state, padded, pd, mask, opt)
         self._bump_step()
-        handle = Handle(self.param,
-                        fallback=lambda: self.param)
+        handle = Handle(table=self, generation=self.generation)
         if sync:
             handle.wait()
         return handle

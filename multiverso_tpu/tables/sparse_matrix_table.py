@@ -96,7 +96,7 @@ class SparseMatrixTable(MatrixTable):
             pvals = -lr * pvals
         self.param = self._coo_scatter_add(self.param, prows, pcols, pvals)
         self._bump_step()
-        handle = Handle(self.param, fallback=lambda: self.param)
+        handle = Handle(table=self, generation=self.generation)
         if sync:
             handle.wait()
         return handle

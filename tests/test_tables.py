@@ -351,13 +351,28 @@ class TestCheckpoint:
         core.init(devices=devices, data_parallel=4, model_parallel=2)
 
     def test_add_handle_wait_after_later_add(self, mesh8):
-        # regression: an add-handle whose buffer was donated to a later
-        # update must still complete wait() (via the fallback)
+        # the generation contract: an add-handle superseded by a later
+        # update completes wait() and returns the CURRENT (newer) state
         t = ArrayTable(8, updater="default")
         h1 = t.add_async(np.ones(8, np.float32))
-        t.add(np.ones(8, np.float32))
-        h1.wait()
+        assert h1.generation == 1 and not h1.superseded()
+        h2 = t.add_async(np.ones(8, np.float32))
+        assert h2.generation == 2
+        assert h1.superseded() and not h2.superseded()
+        got = h1.wait()   # defined: returns the state at generation >= 1
+        np.testing.assert_allclose(np.asarray(got)[:8], 2 * np.ones(8))
         np.testing.assert_allclose(t.get(), 2 * np.ones(8))
+        assert h1.done() and h2.done()
+
+    def test_get_handle_is_stable_snapshot(self, mesh8):
+        # a get-handle returns the value at issue time even after later
+        # adds (snapshot buffer, never donated), and has no generation
+        t = ArrayTable(8, updater="default")
+        t.add(np.ones(8, np.float32), sync=True)
+        h = t.get_async()
+        assert h.generation is None
+        t.add(np.ones(8, np.float32), sync=True)
+        np.testing.assert_allclose(np.asarray(h.wait()), np.ones(8))
 
     def test_get_jax_snapshot_survives_add(self, mesh8):
         # regression: add() donates the param buffer; get_jax must return a
