@@ -110,8 +110,7 @@ def main() -> None:
     def dispatch(i, placed):
         key = jax.random.fold_in(app._key, i)
         s, t = placed
-        app.w_in.param, app.w_out.param, loss = app._superstep(
-            app.w_in.param, app.w_out.param, s, t, key, lrs_dev)
+        _, loss = app._fused((), s, t, key, lrs_dev)
         return loss
 
     warm_loss = None

@@ -1,0 +1,110 @@
+"""Bisect the 35x gap: v0 body standalone measures ~169M tok/s while the
+production app superstep measures ~4.8M on the same chip. Ingredients
+added one at a time on top of v0:
+
+- a: v0 baseline (contiguous idx, doc-sorted stream)
+- b: + permuted stream (production shuffles tokens for mixing)
+- c: + lax.scan(S=1) wrapper with [S, B] inputs
+- d: + named out_shardings + P(None, 'data')-placed inputs on a 1-chip
+     mesh (full production shape)
+
+Run: python benchmarks/experiments/lda_harness_bisect.py
+"""
+
+import sys, time, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from lda_superstep_variants import (V, D, T, K, B, ALPHA, BETA, VBETA,
+                                    make_data, init_counts, v0_body)
+
+
+def run(name, permute, use_scan, use_mesh, sweeps=2):
+    tw, td, z0 = make_data()
+    if permute:
+        perm = np.random.default_rng(7).permutation(T)
+        tw, td = tw[perm], td[perm]
+        # z stays aligned with stream positions (z0 is iid anyway)
+    nwk0, ndk0, nk0 = init_counts(tw, td, z0)
+
+    place = jnp.asarray
+    out_sh = None
+    if use_mesh:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1),
+                    ("data", "model"))
+        def place(a, spec=P()):
+            return jax.device_put(a, NamedSharding(mesh, spec))
+        wt_sh = NamedSharding(mesh, P("model", None))
+        sum_sh = NamedSharding(mesh, P("model"))
+        out_sh = (wt_sh, None, sum_sh, None)
+
+    nwk = place(nwk0); ndk = place(ndk0); nk = place(nk0); z = place(z0)
+    tws = jnp.asarray(tw); tds = jnp.asarray(td)
+    nsteps = T // B
+    key = jax.random.PRNGKey(0)
+    msk = jnp.ones(B, jnp.int32)
+
+    if use_scan:
+        def sbody(carry, inp):
+            return v0_body(*carry, *inp), ()
+
+        kw = {"out_shardings": out_sh} if out_sh else {}
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3), **kw)
+        def step(nwk, ndk, nk, z, ws, ds, idxs, msks, key):
+            keys = jax.random.split(key, ws.shape[0])
+            (nwk, ndk, nk, z), _ = lax.scan(
+                sbody, (nwk, ndk, nk, z), (ws, ds, idxs, msks, keys))
+            return nwk, ndk, nk, z
+
+        def inputs(i):
+            ix = np.arange(i * B, (i + 1) * B, dtype=np.int32)
+            if use_mesh:
+                from jax.sharding import PartitionSpec as P
+                sp = P(None, "data")
+                return tuple(place(a.reshape(1, B), sp) for a in
+                             (tw[ix], td[ix], ix, np.ones(B, np.int32)))
+            return tuple(jnp.asarray(a.reshape(1, B)) for a in
+                         (tw[ix], td[ix], ix, np.ones(B, np.int32)))
+    else:
+        kw = {"out_shardings": out_sh} if out_sh else {}
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3), **kw)
+        def step(nwk, ndk, nk, z, w, d, idx, m, key):
+            return v0_body(nwk, ndk, nk, z, w, d, idx, m, key)
+
+        def inputs(i):
+            ix = jnp.arange(i * B, (i + 1) * B, dtype=jnp.int32)
+            return (jnp.take(tws, ix), jnp.take(tds, ix), ix, msk)
+
+    calls = [inputs(i) for i in range(nsteps)]
+
+    def sweep(nwk, ndk, nk, z, base):
+        for i in range(nsteps):
+            k = jax.random.fold_in(key, base + i)
+            nwk, ndk, nk, z = step(nwk, ndk, nk, z, *calls[i], k)
+        return nwk, ndk, nk, z
+
+    nwk, ndk, nk, z = sweep(nwk, ndk, nk, z, 0)
+    # block_until_ready returns early for donated-alias buffers on this
+    # platform (see bench.py); a host transfer is the only reliable fence
+    tot = int(np.asarray(nk).sum())
+    t0 = time.perf_counter()
+    for s in range(sweeps):
+        nwk, ndk, nk, z = sweep(nwk, ndk, nk, z, (s + 1) * nsteps)
+    tot = int(np.asarray(nk).sum())
+    dt = time.perf_counter() - t0
+    print(f"{name:36s} {T * sweeps / dt / 1e6:8.2f}M tok/s  "
+          f"({dt:.3f}s/{sweeps} sweeps)  nk_total={tot}")
+
+
+if __name__ == "__main__":
+    run("a_v0", False, False, False)
+    run("b_permuted", True, False, False)
+    run("c_permuted_scan", True, True, False)
+    run("d_permuted_scan_mesh", True, True, True)

@@ -1,0 +1,217 @@
+"""Pallas fused posterior+sample kernel probe for LDA Gibbs.
+
+Why: isolation probes (lda_tile_probe.py) show the XLA posterior+sample
+pipeline costs ~57ms/step beyond the gathers — XLA materializes ~6 [B,K]
+HBM intermediates (probs, cdf, one-hots, layout copies). A Pallas kernel
+keeps everything after the row gathers in VMEM: per block of TB tokens,
+compute the collapsed posterior (A+a)(W+b)/S over the 8x128 topic tile,
+two-level inverse-CDF sample (chunk totals -> lane), and accumulate the
+topic-summary delta in VMEM across the sequential grid.
+
+Counts are tile-aligned [N, C=K/128, 128] so one logical row is one
+(8,128) int32 tile (4KB payload per gathered row, not a 32KB tile-span).
+
+Self-removal is in-register (iota==z compare-subtract), standard
+collapsed Gibbs for the own token, batch-stale for others (AD-LDA), and
+the summary S keeps the own count (+1 in a ~T/K denominator) — the same
+approximation stack as v4/v5 in lda_superstep_variants.py.
+
+Run: python benchmarks/experiments/lda_pallas_probe.py
+"""
+
+import sys, time, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from lda_superstep_variants import (V, D, T, K, B, ALPHA, BETA, VBETA,
+                                    make_data, init_counts)
+
+C = K // 128
+TB = 256            # tokens per kernel block (512 overflows 16MB VMEM)
+
+
+def sample_kernel(A_ref, W_ref, nk_ref, zi_ref, msk_ref, u1_ref, u2_ref,
+                  znew_ref, nkd_ref):
+    """One block: [TB, C, 128] posterior -> znew [TB, 1], nk delta
+    accumulated across the (sequential) grid into nkd_ref [C, 128]."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        nkd_ref[:] = jnp.zeros_like(nkd_ref)
+
+    A = A_ref[:]                                   # [TB, C, 128] int32
+    W = W_ref[:]
+    zi = zi_ref[:]                                 # [TB, 1] int32
+    one = msk_ref[:]                               # [TB, 1] int32
+    # topic index per (c, l) lane
+    kc = jax.lax.broadcasted_iota(jnp.int32, (TB, C, 128), 1)
+    kl = jax.lax.broadcasted_iota(jnp.int32, (TB, C, 128), 2)
+    kk = kc * 128 + kl
+    self_oh = ((kk == zi[:, :, None]) & (one[:, :, None] > 0))
+    soh = self_oh.astype(jnp.int32)
+    Af = (A - soh).astype(jnp.float32)
+    Wf = (W - soh).astype(jnp.float32)
+    S = nk_ref[:].astype(jnp.float32) + VBETA      # [C, 128]
+    probs = jnp.maximum((Af + ALPHA) * (Wf + BETA), 0.0) / S[None]
+    # two-level inverse-CDF: chunk totals then within-chunk lanes.
+    # cumsum has no Pallas TPU lowering — use triangular matmuls
+    # (tiny on the MXU) instead.
+    cs = probs.sum(-1)                             # [TB, C]
+    ci = jax.lax.broadcasted_iota(jnp.int32, (C, C), 0)
+    cj = jax.lax.broadcasted_iota(jnp.int32, (C, C), 1)
+    tric = (ci <= cj).astype(jnp.float32)          # [C, C] lower-tri^T
+    ccdf = jnp.dot(cs, tric, preferred_element_type=jnp.float32)
+    u1 = u1_ref[:]                                 # [TB, 1]
+    t1 = u1 * ccdf[:, -1:]
+    c = jnp.minimum((ccdf < t1).sum(1), C - 1).astype(jnp.int32)  # [TB]
+    csel = (kc[:, :, 0] == c[:, None])             # [TB, C]
+    sub = (probs * csel[:, :, None]).sum(1)        # [TB, 128]
+    li = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0)
+    lj = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 1)
+    tril = (li <= lj).astype(jnp.float32)
+    scdf = jnp.dot(sub, tril, preferred_element_type=jnp.float32)
+    u2 = u2_ref[:]
+    t2 = u2 * scdf[:, -1:]
+    lane = jnp.minimum((scdf < t2).sum(1), 127).astype(jnp.int32)
+    zn = c * 128 + lane
+    znew = jnp.where(one[:, 0] > 0, zn, zi[:, 0])  # [TB]
+    znew_ref[:] = znew[:, None]
+    # summary delta: one-hot(new) - one-hot(old), masked
+    new_oh = ((kk == znew[:, None, None]) & (one[:, :, None] > 0))
+    nkd_ref[:] += (new_oh.astype(jnp.int32) - soh).sum(0)
+
+
+def fused_sample(A3, W3, nk3, zi, msk, u1, u2):
+    nblocks = B // TB
+    grid_spec = pl.GridSpec(
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec((TB, C, 128), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TB, C, 128), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, 128), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TB, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TB, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TB, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TB, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((TB, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, 128), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+    )
+    return pl.pallas_call(
+        sample_kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((B, 1), jnp.int32),
+                   jax.ShapeDtypeStruct((C, 128), jnp.int32)],
+    )(A3, W3, nk3, zi, msk, u1, u2)
+
+
+def full_step_body(nwk3, ndk3, nk, z, w, d, idx, msk, key):
+    """Complete superstep: gathers (XLA) + pallas sample + scatters."""
+    zi = jnp.take(z, idx)
+    one = msk
+    A3 = jnp.take(ndk3, d, axis=0)                 # [B, C, 128]
+    W3 = jnp.take(nwk3, w, axis=0)
+    k1, k2 = jax.random.split(key)
+    u1 = jax.random.uniform(k1, (B, 1))
+    u2 = jax.random.uniform(k2, (B, 1))
+    znew2, nkd = fused_sample(A3, W3, nk.reshape(C, 128), zi[:, None],
+                              one[:, None], u1, u2)
+    znew = znew2[:, 0]
+    cold, lold = zi // 128, zi % 128
+    cnew, lnew = znew // 128, znew % 128
+    nwk3 = nwk3.at[w, cold, lold].add(-one)
+    nwk3 = nwk3.at[w, cnew, lnew].add(one)
+    ndk3 = ndk3.at[d, cold, lold].add(-one)
+    ndk3 = ndk3.at[d, cnew, lnew].add(one)
+    nk = nk + nkd.reshape(-1)
+    z = z.at[idx].set(znew)
+    return nwk3, ndk3, nk, z
+
+
+def bench_full(sweeps=2):
+    tw, td, z0 = make_data()
+    perm = np.random.default_rng(7).permutation(T)
+    tw, td = tw[perm], td[perm]
+    nwk0, ndk0, nk0 = init_counts(tw, td, z0)
+    nwk = jnp.asarray(nwk0.reshape(V + 1, C, 128))
+    ndk = jnp.asarray(ndk0.reshape(D + 1, C, 128))
+    nk = jnp.asarray(nk0)
+    z = jnp.asarray(z0)
+    nsteps = T // B
+    key = jax.random.PRNGKey(0)
+
+    step = jax.jit(full_step_body, donate_argnums=(0, 1, 2, 3))
+    idxs = [jnp.arange(i * B, (i + 1) * B, dtype=jnp.int32)
+            for i in range(nsteps)]
+    ws = [jnp.asarray(tw[i * B:(i + 1) * B]) for i in range(nsteps)]
+    ds = [jnp.asarray(td[i * B:(i + 1) * B]) for i in range(nsteps)]
+    msk = jnp.ones(B, jnp.int32)
+
+    def sweep(nwk, ndk, nk, z, base):
+        for i in range(nsteps):
+            k = jax.random.fold_in(key, base + i)
+            nwk, ndk, nk, z = step(nwk, ndk, nk, z, ws[i], ds[i],
+                                   idxs[i], msk, k)
+        return nwk, ndk, nk, z
+
+    nwk, ndk, nk, z = sweep(nwk, ndk, nk, z, 0)
+    tot = int(np.asarray(nk).sum())
+    print(f"after warm sweep: nk_total={tot} (expect {T})")
+    t0 = time.perf_counter()
+    for s in range(sweeps):
+        nwk, ndk, nk, z = sweep(nwk, ndk, nk, z, (s + 1) * nsteps)
+    tot = int(np.asarray(nk).sum())
+    dt = time.perf_counter() - t0
+    print(f"pallas_fused_step   {T*sweeps/dt/1e6:8.2f}M tok/s   "
+          f"({dt:.3f}s/{sweeps} sweeps)  nk_total={tot}")
+
+
+def bench_kernel_only():
+    """Time just the pallas kernel on pre-gathered operands."""
+    rng = np.random.default_rng(0)
+    A3 = jnp.asarray(rng.integers(0, 5, (B, C, 128)).astype(np.int32))
+    W3 = jnp.asarray(rng.integers(0, 50, (B, C, 128)).astype(np.int32))
+    nk3 = jnp.asarray(rng.integers(1000, 20000, (C, 128)).astype(np.int32))
+    zi = jnp.asarray(rng.integers(0, K, (B, 1)).astype(np.int32))
+    msk = jnp.ones((B, 1), jnp.int32)
+    u1 = jnp.asarray(rng.random((B, 1), np.float32))
+    u2 = jnp.asarray(rng.random((B, 1), np.float32))
+    f = jax.jit(fused_sample)
+    zn, nkd = f(A3, W3, nk3, zi, msk, u1, u2)
+    _ = np.asarray(zn)[0]
+    t0 = time.perf_counter()
+    n = 20
+    for _ in range(n):
+        zn, nkd = f(A3, W3, nk3, zi, msk, u1, u2)
+    _ = np.asarray(zn)[0]
+    dt = (time.perf_counter() - t0) / n
+    print(f"kernel_only         {dt*1e3:8.2f} ms/step   "
+          f"({B/dt/1e6:7.1f}M tok/s equiv)")
+    # sanity: znew histogram not degenerate
+    h = np.bincount(np.asarray(zn)[:, 0], minlength=K)
+    print(f"  znew spread: min={h.min()} max={h.max()} (B/K={B//K})")
+
+
+if __name__ == "__main__":
+    bench_kernel_only()
+    bench_full()

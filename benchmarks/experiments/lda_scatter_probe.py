@@ -1,0 +1,137 @@
+"""Probe the non-kernel pieces of the pallas LDA step on the tile-aligned
+[N, C, 128] layout: gathers, scatter variants, z update, and kernel
+micro-optimizations (precomputed 1/S).
+
+Run: python benchmarks/experiments/lda_scatter_probe.py
+"""
+
+import sys, time, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from lda_superstep_variants import (V, D, T, K, B, VBETA, make_data,
+                                    init_counts)
+
+C = K // 128
+
+
+def fence(x):
+    return np.asarray(x).ravel()[0]
+
+
+def time_fn(name, f, args, n=20):
+    out = f(*args)
+    fence(jax.tree.leaves(out)[0])
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = f(*args)
+    fence(jax.tree.leaves(out)[0])
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:34s} {dt*1e3:8.2f} ms/step  "
+          f"({B/dt/1e6:7.1f}M tok/s equiv)")
+    return dt
+
+
+def main():
+    tw, td, z0 = make_data()
+    perm = np.random.default_rng(7).permutation(T)
+    tw, td = tw[perm], td[perm]
+    nwk0, ndk0, nk0 = init_counts(tw, td, z0)
+    nwk3 = jnp.asarray(nwk0.reshape(V + 1, C, 128))
+    ndk3 = jnp.asarray(ndk0.reshape(D + 1, C, 128))
+    z = jnp.asarray(z0)
+    w = jnp.asarray(tw[:B]); d = jnp.asarray(td[:B])
+    idx = jnp.arange(B, dtype=jnp.int32)
+    one = jnp.ones(B, jnp.int32)
+    rng = np.random.default_rng(1)
+    zi = jnp.asarray(rng.integers(0, K, B).astype(np.int32))
+    znew = jnp.asarray(rng.integers(0, K, B).astype(np.int32))
+
+    @jax.jit
+    def g_both(nwk3, ndk3, w, d):
+        A = jnp.take(ndk3, d, axis=0)
+        W = jnp.take(nwk3, w, axis=0)
+        return A.sum() + W.sum()
+
+    @jax.jit
+    def sc_four(nwk3, ndk3, w, d, zi, znew, one):
+        cold, lold = zi // 128, zi % 128
+        cnew, lnew = znew // 128, znew % 128
+        nwk3 = nwk3.at[w, cold, lold].add(-one)
+        nwk3 = nwk3.at[w, cnew, lnew].add(one)
+        ndk3 = ndk3.at[d, cold, lold].add(-one)
+        ndk3 = ndk3.at[d, cnew, lnew].add(one)
+        return nwk3.sum() + ndk3.sum()
+
+    @jax.jit
+    def sc_combined(nwk3, ndk3, w, d, zi, znew, one):
+        # one scatter per array: concat (old, new) indices, values -/+1
+        cold, lold = zi // 128, zi % 128
+        cnew, lnew = znew // 128, znew % 128
+        cc = jnp.concatenate([cold, cnew])
+        ll = jnp.concatenate([lold, lnew])
+        vv = jnp.concatenate([-one, one])
+        ww = jnp.concatenate([w, w])
+        dd = jnp.concatenate([d, d])
+        nwk3 = nwk3.at[ww, cc, ll].add(vv)
+        ndk3 = ndk3.at[dd, cc, ll].add(vv)
+        return nwk3.sum() + ndk3.sum()
+
+    @jax.jit
+    def z_update(z, idx, znew):
+        return jnp.take(z, idx).sum() + z.at[idx].set(znew).sum()
+
+    # 2-D comparison scatter
+    nwk2 = jnp.asarray(nwk0)
+    ndk2 = jnp.asarray(ndk0)
+
+    @jax.jit
+    def sc_2d(nwk, ndk, w, d, zi, znew, one):
+        nwk = nwk.at[w, zi].add(-one)
+        nwk = nwk.at[w, znew].add(one)
+        ndk = ndk.at[d, zi].add(-one)
+        ndk = ndk.at[d, znew].add(one)
+        return nwk.sum() + ndk.sum()
+
+    print(f"== tile-aligned [N,{C},128] pieces (B={B}) ==")
+    time_fn("gathers A3+W3 (3-D)", g_both, (nwk3, ndk3, w, d))
+    time_fn("4 scatters (3-D)", sc_four, (nwk3, ndk3, w, d, zi, znew, one))
+    time_fn("2 combined scatters (3-D)", sc_combined,
+            (nwk3, ndk3, w, d, zi, znew, one))
+    time_fn("4 scatters (2-D ref)", sc_2d,
+            (nwk2, ndk2, w, d, zi, znew, one))
+    time_fn("z take+set", z_update, (z, idx, znew))
+
+    # sorted-by-row scatter: does presorting the indices help XLA?
+    order_w = jnp.asarray(np.argsort(np.asarray(w), kind="stable")
+                          .astype(np.int32))
+
+    @jax.jit
+    def sc_wsorted(nwk3, w, zi, znew, one, order_w):
+        ws = jnp.take(w, order_w)
+        zis = jnp.take(zi, order_w)
+        zns = jnp.take(znew, order_w)
+        os_ = jnp.take(one, order_w)
+        nwk3 = nwk3.at[ws, zis // 128, zis % 128].add(-os_)
+        nwk3 = nwk3.at[ws, zns // 128, zns % 128].add(os_)
+        return nwk3.sum()
+
+    @jax.jit
+    def sc_w_only(nwk3, w, zi, znew, one):
+        nwk3 = nwk3.at[w, zi // 128, zi % 128].add(-one)
+        nwk3 = nwk3.at[w, znew // 128, znew % 128].add(one)
+        return nwk3.sum()
+
+    time_fn("nwk 2 scatters, unsorted", sc_w_only,
+            (nwk3, w, zi, znew, one))
+    time_fn("nwk 2 scatters, w-presorted", sc_wsorted,
+            (nwk3, w, zi, znew, one, order_w))
+
+
+if __name__ == "__main__":
+    main()

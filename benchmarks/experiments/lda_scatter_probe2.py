@@ -1,0 +1,137 @@
+"""Scatter probe round 2: can the 27ms scatter wall move?
+
+- indices_are_sorted/unique_indices hints on presorted scatters
+- unique-row formulation: segment-sum per-row deltas (sorted static
+  segments, known at init) + one scatter with UNIQUE sorted row ids
+- scatter cost scaling with B (is it per-token or fixed?)
+- z via dynamic_slice instead of take/scatter
+
+Run: python benchmarks/experiments/lda_scatter_probe2.py
+"""
+
+import sys, time, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from lda_superstep_variants import V, D, T, K, B, make_data, init_counts
+
+C = K // 128
+
+
+def fence(x):
+    return np.asarray(x).ravel()[0]
+
+
+def time_fn(name, f, args, n=20, b=B):
+    out = f(*args)
+    fence(jax.tree.leaves(out)[0])
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = f(*args)
+    fence(jax.tree.leaves(out)[0])
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:40s} {dt*1e3:8.2f} ms  ({b/dt/1e6:7.1f}M tok/s equiv)")
+    return dt
+
+
+def main():
+    tw, td, z0 = make_data()
+    perm = np.random.default_rng(7).permutation(T)
+    tw, td = tw[perm], td[perm]
+    nwk0, ndk0, nk0 = init_counts(tw, td, z0)
+    nwk3 = jnp.asarray(nwk0.reshape(V + 1, C, 128))
+    rng = np.random.default_rng(1)
+    w_np = np.asarray(tw[:B])
+    zi = jnp.asarray(rng.integers(0, K, B).astype(np.int32))
+    znew = jnp.asarray(rng.integers(0, K, B).astype(np.int32))
+    one = jnp.ones(B, jnp.int32)
+
+    # static sort of the batch's word ids (computable at init)
+    order = np.argsort(w_np, kind="stable").astype(np.int32)
+    ws_np = w_np[order]
+    order_d = jnp.asarray(order)
+    ws = jnp.asarray(ws_np)
+    # static segment structure: unique rows + segment ids
+    uniq, seg_ids_np = np.unique(ws_np, return_inverse=True)
+    R = len(uniq)
+    Rpad = 1 << (R - 1).bit_length()
+    seg_ids = jnp.asarray(seg_ids_np.astype(np.int32))
+    uniq_rows = jnp.asarray(
+        np.pad(uniq, (0, Rpad - R), constant_values=V).astype(np.int32))
+    print(f"B={B}  unique rows in batch R={R} (pad {Rpad})")
+
+    @jax.jit
+    def sc_hinted(nwk3, ws, zi, znew, one, order_d):
+        zis = jnp.take(zi, order_d)
+        zns = jnp.take(znew, order_d)
+        os_ = jnp.take(one, order_d)
+        nwk3 = nwk3.at[ws, zis // 128, zis % 128].add(
+            -os_, indices_are_sorted=True)
+        nwk3 = nwk3.at[ws, zns // 128, zns % 128].add(
+            os_, indices_are_sorted=True)
+        return nwk3.sum()
+
+    @jax.jit
+    def sc_segsum(nwk3, zi, znew, one, order_d):
+        # per-row delta via segment-sum of one-hot diff over STATIC sorted
+        # segments; then ONE scatter with unique sorted row ids
+        zis = jnp.take(zi, order_d)
+        zns = jnp.take(znew, order_d)
+        os_ = jnp.take(one, order_d)
+        oh = (jax.nn.one_hot(zns, K, dtype=jnp.int8)
+              - jax.nn.one_hot(zis, K, dtype=jnp.int8)) * os_[:, None] \
+            .astype(jnp.int8)
+        delta = jax.ops.segment_sum(oh.astype(jnp.int32), seg_ids,
+                                    num_segments=Rpad,
+                                    indices_are_sorted=True)
+        return nwk3.at[uniq_rows].add(
+            delta.reshape(Rpad, C, 128),
+            indices_are_sorted=True, mode="drop").sum()
+
+    @jax.jit
+    def sc_plain2(nwk3, w, zi, znew, one):
+        nwk3 = nwk3.at[w, zi // 128, zi % 128].add(-one)
+        nwk3 = nwk3.at[w, znew // 128, znew % 128].add(one)
+        return nwk3.sum()
+
+    w_dev = jnp.asarray(w_np)
+    time_fn("nwk plain 2 scatters", sc_plain2,
+            (nwk3, w_dev, zi, znew, one))
+    time_fn("nwk sorted + indices_are_sorted", sc_hinted,
+            (nwk3, ws, zi, znew, one, order_d))
+    time_fn("nwk segsum + unique-row scatter", sc_segsum,
+            (nwk3, zi, znew, one, order_d))
+
+    # scaling with B
+    for b in (125_000, 250_000, 500_000):
+        wb = w_dev[:b]; zib = zi[:b]; znb = znew[:b]; ob = one[:b]
+        time_fn(f"nwk plain 2 scatters B={b}", sc_plain2,
+                (nwk3, wb, zib, znb, ob), b=b)
+
+    # z update: slice vs gather
+    z = jnp.asarray(z0)
+
+    @jax.jit
+    def z_slice(z, znew):
+        cur = lax.dynamic_slice_in_dim(z, 3 * B, B)
+        z = lax.dynamic_update_slice_in_dim(z, znew, 3 * B, 0)
+        return z.sum() + cur.sum()
+
+    idx = jnp.arange(3 * B, 4 * B, dtype=jnp.int32)
+
+    @jax.jit
+    def z_gather(z, idx, znew):
+        cur = jnp.take(z, idx)
+        z = z.at[idx].set(znew)
+        return z.sum() + cur.sum()
+
+    time_fn("z take+set (gather/scatter)", z_gather, (z, idx, znew))
+    time_fn("z dynamic_slice/update", z_slice, (z, znew))
+
+
+if __name__ == "__main__":
+    main()

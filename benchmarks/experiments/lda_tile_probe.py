@@ -1,0 +1,109 @@
+"""Round-2b LDA probes:
+
+1. Isolation: which piece of the v0 superstep dominates — row gathers,
+   posterior+sample, or the count scatters?
+2. v4/v5 tile-aligned counts ([N, K] -> [N, C, 128] so one logical row is
+   one (8,128) int32 tile): kills the 8x tile-span read amplification of
+   random row gathers on the 2-D layout. Defined last session (bench3 in
+   lda_superstep_variants) but never executed.
+
+Run: python benchmarks/experiments/lda_tile_probe.py
+"""
+
+import sys, time, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from lda_superstep_variants import (V, D, T, K, B, ALPHA, BETA, VBETA,
+                                    make_data, init_counts, v0_body,
+                                    twolevel_sample, make_v45_body, bench3,
+                                    L_LANES)
+
+
+def fence(x):
+    return np.asarray(x).ravel()[0]
+
+
+def time_step(name, step, args, n=20):
+    out = step(*args)          # compile
+    fence(jax.tree.leaves(out)[0])
+    t0 = time.perf_counter()
+    outs = None
+    for _ in range(n):
+        outs = step(*args)
+    fence(jax.tree.leaves(outs)[0])
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:32s} {dt*1e3:8.2f} ms/step   "
+          f"({B/dt/1e6:7.1f}M tok/s equiv)")
+    return dt
+
+
+def main():
+    tw, td, z0 = make_data()
+    perm = np.random.default_rng(7).permutation(T)
+    tw, td = tw[perm], td[perm]
+    nwk0, ndk0, nk0 = init_counts(tw, td, z0)
+
+    nwk = jnp.asarray(nwk0); ndk = jnp.asarray(ndk0)
+    nk = jnp.asarray(nk0); z = jnp.asarray(z0)
+    w = jnp.asarray(tw[:B]); d = jnp.asarray(td[:B])
+    idx = jnp.arange(B, dtype=jnp.int32)
+    msk = jnp.ones(B, jnp.int32)
+    key = jax.random.PRNGKey(0)
+
+    # -- isolation probes (no donation: keep inputs reusable) -------------
+    @jax.jit
+    def p_gathers(nwk, ndk, w, d):
+        A = jnp.take(ndk, d, axis=0)
+        W = jnp.take(nwk, w, axis=0)
+        return A.sum() + W.sum()
+
+    @jax.jit
+    def p_gather_sample(nwk, ndk, nk, w, d, key):
+        A = jnp.take(ndk, d, axis=0).astype(jnp.float32)
+        W = jnp.take(nwk, w, axis=0).astype(jnp.float32)
+        S = nk.astype(jnp.float32) + VBETA
+        probs = jnp.maximum((A + ALPHA) * (W + BETA), 0.0) / S
+        cdf = jnp.cumsum(probs, axis=1)
+        u = jax.random.uniform(key, (B, 1)) * cdf[:, -1:]
+        znew = jnp.minimum((cdf < u).sum(axis=1), K - 1).astype(jnp.int32)
+        return znew
+
+    @jax.jit
+    def p_scatters(nwk, ndk, w, d, zi, znew, one):
+        nwk = nwk.at[w, zi].add(-one)
+        ndk = ndk.at[d, zi].add(-one)
+        nwk = nwk.at[w, znew].add(one)
+        ndk = ndk.at[d, znew].add(one)
+        return nwk.sum() + ndk.sum()
+
+    @jax.jit
+    def p_onehot_nk(nk, zi, znew, one):
+        oh_old = jax.nn.one_hot(zi, K, dtype=jnp.int32) * one[:, None]
+        oh_new = jax.nn.one_hot(znew, K, dtype=jnp.int32) * one[:, None]
+        return nk + (oh_new - oh_old).sum(0)
+
+    zi = jnp.take(z, idx)
+    znew = jnp.roll(zi, 1)
+    print("== isolation (B=500k, non-donated) ==")
+    time_step("gathers_A_W", p_gathers, (nwk, ndk, w, d))
+    time_step("gather+posterior+sample", p_gather_sample,
+              (nwk, ndk, nk, w, d, key))
+    time_step("4x element scatters", p_scatters,
+              (nwk, ndk, w, d, zi, znew, msk))
+    time_step("one-hot nk reductions", p_onehot_nk, (nk, zi, znew, msk))
+
+    # -- tile-aligned variants (never run last session) -------------------
+    print("== tile-aligned [N, C, 128] variants ==")
+    bench3("v4_tile_f32", make_v45_body(jnp.float32), tw, td, z0)
+    bench3("v5_tile_bf16", make_v45_body(jnp.bfloat16), tw, td, z0)
+
+
+if __name__ == "__main__":
+    main()

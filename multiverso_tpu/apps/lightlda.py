@@ -49,7 +49,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from multiverso_tpu import core
 from multiverso_tpu.data.corpus import backend as data_backend
-from multiverso_tpu.tables import ArrayTable, SparseMatrixTable
+from multiverso_tpu.tables import (ArrayTable, SparseMatrixTable,
+                                   make_superstep)
 from multiverso_tpu.utils import dashboard, log
 
 
@@ -217,10 +218,9 @@ class LightLDA:
         nwk, ndk, nk = build(self._z, self._place(self._tw, P()),
                              self._place(self._td, P()),
                              self._place(self._mask.astype(np.int32), P()))
-        self.word_topic.param = jax.device_put(nwk,
-                                               self.word_topic.sharding)
+        self.word_topic.put_raw(nwk)
         self._ndk = ndk
-        self.summary.param = jax.device_put(nk, self.summary.sharding)
+        self.summary.put_raw(nk)
 
     # -- the Gibbs superstep ----------------------------------------------
 
@@ -229,10 +229,8 @@ class LightLDA:
         alpha, beta = self.alpha, self.beta
         vbeta = self.V * beta
         K = self.K
-        wt_sh = self.word_topic.sharding
-        sum_sh = self.summary.sharding
 
-        def body(carry, inp):
+        def scan_body(carry, inp):
             nwk, ndk, nk, z = carry
             w, d, idx, msk, key = inp
             zi = jnp.take(z, idx)
@@ -271,15 +269,18 @@ class LightLDA:
             z = z.at[idx].set(znew)
             return (nwk, ndk, nk, z), ()
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2, 3),
-                 out_shardings=(wt_sh, None, sum_sh, None))
-        def superstep(nwk, ndk, nk, z, ws, ds, idxs, msks, key):
+        def body(params, states, locals_, options, ws, ds, idxs, msks, key):
+            nwk, nk = params
+            ndk, z = locals_
             keys = jax.random.split(key, ws.shape[0])
             (nwk, ndk, nk, z), _ = lax.scan(
-                body, (nwk, ndk, nk, z), (ws, ds, idxs, msks, keys))
-            return nwk, ndk, nk, z
+                scan_body, (nwk, ndk, nk, z), (ws, ds, idxs, msks, keys))
+            return (nwk, nk), states, (ndk, z), None
 
-        self._superstep = superstep
+        # supported fused path: tables = (word_topic, summary); app-local
+        # carry = (doc-topic counts, z assignments)
+        self._fused = make_superstep((self.word_topic, self.summary), body,
+                                     name="lda_gibbs")
 
         @jax.jit
         def build_wcdf(nwk):
@@ -325,8 +326,6 @@ class LightLDA:
         alpha, beta = self.alpha, self.beta
         vbeta = self.V * beta
         K = self.K
-        wt_sh = self.word_topic.sharding
-        sum_sh = self.summary.sharding
         n_search = max(1, (K - 1).bit_length())
         doc_len, doc_start = self._doc_len, self._doc_start
         inv_perm = self._inv_perm
@@ -409,17 +408,18 @@ class LightLDA:
             z = z.at[idx].set(znew)
             return (nwk, ndk, nk, z), ()
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2, 3),
-                 out_shardings=(wt_sh, None, sum_sh, None))
-        def superstep_mh(nwk, ndk, nk, z, wcdf, nwk_stale, ws, ds, idxs,
-                         msks, key):
+        def fused_body(params, states, locals_, options, wcdf, nwk_stale,
+                       ws, ds, idxs, msks, key):
+            nwk, nk = params
+            ndk, z = locals_
             keys = jax.random.split(key, ws.shape[0])
             (nwk, ndk, nk, z), _ = lax.scan(
                 lambda carry, inp: body(wcdf, nwk_stale, carry, inp),
                 (nwk, ndk, nk, z), (ws, ds, idxs, msks, keys))
-            return nwk, ndk, nk, z
+            return (nwk, nk), states, (ndk, z), None
 
-        self._superstep_mh = superstep_mh
+        self._fused_mh = make_superstep(
+            (self.word_topic, self.summary), fused_body, name="lda_mh")
 
     def _place(self, arr: np.ndarray, spec) -> jax.Array:
         return jax.device_put(arr, NamedSharding(self.mesh, spec))
@@ -430,23 +430,20 @@ class LightLDA:
         """One full sampling pass over the corpus."""
         mh = self.config.sampler == "mh"
         if mh:
-            wcdf = self._build_wcdf(self.word_topic.param)
+            wcdf = self._build_wcdf(self.word_topic.raw())
             # pre-sweep snapshot for the stale proposal density (the live
             # param buffer is donated by the first superstep call)
-            nwk_stale = self.word_topic.param + 0
+            nwk_stale = self.word_topic.raw() + 0
         for ws, ds, idxs, msks in self._calls:
             key = jax.random.fold_in(self._key, self._calls_done)
             self._calls_done += 1
             if mh:
-                (self.word_topic.param, self._ndk, self.summary.param,
-                 self._z) = self._superstep_mh(
-                    self.word_topic.param, self._ndk, self.summary.param,
-                    self._z, wcdf, nwk_stale, ws, ds, idxs, msks, key)
+                (self._ndk, self._z), _ = self._fused_mh(
+                    (self._ndk, self._z), wcdf, nwk_stale,
+                    ws, ds, idxs, msks, key)
             else:
-                (self.word_topic.param, self._ndk, self.summary.param,
-                 self._z) = self._superstep(
-                    self.word_topic.param, self._ndk, self.summary.param,
-                    self._z, ws, ds, idxs, msks, key)
+                (self._ndk, self._z), _ = self._fused(
+                    (self._ndk, self._z), ws, ds, idxs, msks, key)
 
     def train(self, num_iterations: Optional[int] = None) -> float:
         """Run Gibbs sweeps; returns the final per-token log-likelihood."""
@@ -475,7 +472,7 @@ class LightLDA:
         total = 0.0
         for ws, ds, _idxs, msks in self._calls:
             total += float(self._loglik(
-                self.word_topic.param, self._ndk, self.summary.param,
+                self.word_topic.raw(), self._ndk, self.summary.raw(),
                 ws, ds, msks))
         return total / max(self.num_tokens, 1)
 

@@ -34,7 +34,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from multiverso_tpu import core
-from multiverso_tpu.tables import ArrayTable
+from multiverso_tpu.tables import ArrayTable, make_superstep
 from multiverso_tpu.updaters import AddOption
 from multiverso_tpu.utils import dashboard, log
 
@@ -211,16 +211,15 @@ class LogisticRegression:
     def _build_step(self) -> None:
         table = self.table
 
-        state_sh = jax.tree.map(lambda _: table.sharding, table.state)
-
-        @partial(jax.jit, donate_argnums=(0, 1),
-                 out_shardings=(table.sharding, state_sh, None))
-        def step(param, state, x, y, opt):
+        def body(params, states, locals_, options, x, y):
+            (param,), (state,), (opt,) = params, states, options
             loss, grad = jax.value_and_grad(self._loss)(param, x, y)
             param, state = table.updater.apply(param, state, grad, opt)
-            return param, state, loss
+            return (param,), (state,), locals_, loss
 
-        self._step = step
+        # supported fused path: grad + updater in one compiled program,
+        # donation/sharding/step-counting handled by the table layer
+        self._fused = make_superstep((table,), body, name="logreg_step")
 
         @jax.jit
         def predict(param, x):
@@ -264,11 +263,8 @@ class LogisticRegression:
         for start in range(0, n, c.minibatch_size):
             idx = order[start:start + c.minibatch_size]
             xs, ys = self._shard_batch(X[idx], y[idx])
-            opt = self.table._resolve_option(None)
             with dashboard.profile("logreg.step"):
-                self.table.param, self.table.state, loss = self._step(
-                    self.table.param, self.table.state, xs, ys, opt)
-            self.table._bump_step()
+                _, loss = self._fused((), xs, ys)
             losses.append(loss)
         mean_loss = float(np.mean([float(l) for l in losses]))
         dt = time.perf_counter() - t0
@@ -287,7 +283,7 @@ class LogisticRegression:
 
     def predict(self, X: np.ndarray) -> np.ndarray:
         xs = core.place(np.asarray(X, np.float32), mesh=self.mesh)
-        return np.asarray(self._predict(self.table.param, xs))
+        return np.asarray(self._predict(self.table.raw(), xs))
 
     def accuracy(self, X: np.ndarray, y: np.ndarray) -> float:
         return float(np.mean(self.predict(X) == y))

@@ -47,7 +47,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from multiverso_tpu import core
 from multiverso_tpu.data.corpus import Corpus
-from multiverso_tpu.tables import MatrixTable
+from multiverso_tpu.tables import MatrixTable, make_superstep
 from multiverso_tpu.utils import dashboard, log
 
 
@@ -201,10 +201,9 @@ class WordEmbedding:
 
     def _build_superstep(self) -> None:
         c = self.config
-        sh = self.w_in.sharding
         cbow = c.model == "cbow"
 
-        def body(carry, inp):
+        def scan_body(carry, inp):
             w_in, w_out = carry
             src, tgt, key, lr = inp
             if cbow:
@@ -229,15 +228,16 @@ class WordEmbedding:
                 w_in = w_in.at[src].add(-grad_v.astype(w_in.dtype))
             return (w_in, w_out), loss
 
-        @partial(jax.jit, donate_argnums=(0, 1),
-                 out_shardings=(sh, sh, None))
-        def superstep(w_in, w_out, srcs, tgts, key, lrs):
+        def body(params, states, locals_, options, srcs, tgts, key, lrs):
             keys = jax.random.split(key, srcs.shape[0])
-            (w_in, w_out), losses = lax.scan(
-                body, (w_in, w_out), (srcs, tgts, keys, lrs))
-            return w_in, w_out, losses.mean()
+            params, losses = lax.scan(
+                scan_body, params, (srcs, tgts, keys, lrs))
+            return params, states, locals_, losses.mean()
 
-        self._superstep = superstep
+        # the supported fused-update path: donation, out-shardings, and
+        # step/generation counting live in the table layer
+        self._fused = make_superstep((self.w_in, self.w_out), body,
+                                     name="w2v_superstep")
 
     # -- data placement ----------------------------------------------------
 
@@ -337,9 +337,8 @@ class WordEmbedding:
         key = jax.random.fold_in(self._key, call_no)
         sd, td = self._place(srcs, tgts)
         with dashboard.profile("w2v.superstep"):
-            self.w_in.param, self.w_out.param, loss = self._superstep(
-                self.w_in.param, self.w_out.param, sd, td, key,
-                core.place(lrs, mesh=self.mesh))
+            _, loss = self._fused((), sd, td, key,
+                                  core.place(lrs, mesh=self.mesh))
         self._step_no += s
         return loss
 

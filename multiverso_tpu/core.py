@@ -100,9 +100,38 @@ def init(argv: Optional[Sequence[str]] = None, *,
             # the XLA backend; jax raises if the backend is already up,
             # and that is a real misconfiguration — fail fast, a silent
             # fallback to single-host topology would train wrong.
-            port = configure.get_flag("port") or 8476
-            jax.distributed.initialize(
-                coordinator_address=f"{coordinator}:{port}")
+            # ``machine_file`` keeps the reference's flag shape: a FILE
+            # listing one host per line (first = coordinator; the count
+            # supplies -num_processes when unset). This host's rank comes
+            # from -process_id (or the platform's auto-detection on cloud
+            # TPU), NOT from the file — matching local addresses against
+            # the list is unreliable in containers. A bare ``host`` /
+            # ``host:port`` value is also accepted.
+            import os
+            if os.path.exists(coordinator):
+                with open(coordinator) as f:
+                    machines = [m for m in (ln.strip() for ln in f)
+                                if m and not m.startswith("#")]
+                if not machines:
+                    raise ValueError(
+                        f"machine_file {coordinator!r} lists no machines")
+                coordinator = machines[0]
+                if configure.get_flag("num_processes") == 0:
+                    configure.set_flag("num_processes", len(machines))
+            if ":" in coordinator:
+                address = coordinator
+            else:
+                port = configure.get_flag("port") or 8476
+                address = f"{coordinator}:{port}"
+            nproc = configure.get_flag("num_processes")
+            pid = configure.get_flag("process_id")
+            kwargs = {}
+            if nproc > 0:
+                kwargs["num_processes"] = nproc
+            if pid >= 0:
+                kwargs["process_id"] = pid
+            jax.distributed.initialize(coordinator_address=address,
+                                       **kwargs)
 
         devs = list(devices) if devices is not None else jax.devices()
         dp = data_parallel if data_parallel is not None \

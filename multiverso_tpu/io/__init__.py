@@ -1,7 +1,9 @@
 """URI-dispatched IO streams (SURVEY.md §3.7: reference
 `include/multiverso/io/{io.h,local_stream.h,hdfs_stream.h}`)."""
 
-from multiverso_tpu.io.stream import (Stream, StreamFactory, open_stream,
+from multiverso_tpu.io.stream import (Stream, StreamFactory,
+                                      mem_store_clear, open_stream,
                                       register_scheme)
 
-__all__ = ["Stream", "StreamFactory", "open_stream", "register_scheme"]
+__all__ = ["Stream", "StreamFactory", "mem_store_clear", "open_stream",
+           "register_scheme"]

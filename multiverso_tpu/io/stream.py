@@ -6,9 +6,14 @@ SURVEY.md §3.7 / §6.4): table checkpoints (`ServerTable::Store/Load`) and
 app data flow through a `Stream` opened by URI, so `file://` and `hdfs://`
 (and anything else registered) are interchangeable.
 
-Here `file://` (and bare paths) are implemented; other schemes register
-via :func:`register_scheme`. `hdfs://` is intentionally not implemented —
-no hdfs client exists in this image; attempting it raises a clear error.
+Here `file://` (and bare paths) and an in-process `mem://` scheme are
+implemented; other schemes register via :func:`register_scheme`.
+`hdfs://` is intentionally not implemented — no hdfs client exists in
+this image; attempting it raises a clear error.
+
+`mem://` is the second registered scheme (the reference proves its
+registry with hdfs): checkpoints round-trip through a process-wide byte
+store, which also lets tests exercise Store/Load without disk IO.
 """
 
 from __future__ import annotations
@@ -44,6 +49,45 @@ def _open_local(path: str, mode: str) -> Stream:
 
 
 register_scheme("file", _open_local)
+
+
+# -- mem:// — in-process byte store ----------------------------------------
+
+_MEM_STORE: Dict[str, bytes] = {}
+
+
+class _MemWriteStream(io.BytesIO):
+    """BytesIO that publishes its contents to the store on close."""
+
+    def __init__(self, path: str, initial: bytes = b"") -> None:
+        super().__init__()
+        self._path = path
+        if initial:
+            self.write(initial)
+
+    def close(self) -> None:
+        if not self.closed:
+            _MEM_STORE[self._path] = self.getvalue()
+        super().close()
+
+
+def _open_mem(path: str, mode: str) -> Stream:
+    if "w" in mode:
+        return _MemWriteStream(path)
+    if "a" in mode:
+        return _MemWriteStream(path, _MEM_STORE.get(path, b""))
+    try:
+        return io.BytesIO(_MEM_STORE[path])
+    except KeyError:
+        raise FileNotFoundError(f"mem://{path} does not exist") from None
+
+
+def mem_store_clear() -> None:
+    """Drop all mem:// objects (tests)."""
+    _MEM_STORE.clear()
+
+
+register_scheme("mem", _open_mem)
 
 
 def open_stream(uri: str, mode: str = "rb") -> Stream:

@@ -15,6 +15,7 @@ from multiverso_tpu.tables.matrix_table import MatrixTable, MatrixTableOption
 from multiverso_tpu.tables.sparse_matrix_table import (SparseMatrixTable,
                                                        SparseMatrixTableOption)
 from multiverso_tpu.tables.kv_table import KVTable, KVTableOption
+from multiverso_tpu.tables.superstep import FusedSuperstep, make_superstep
 
 TableOption = Union[ArrayTableOption, MatrixTableOption,
                     SparseMatrixTableOption, KVTableOption]
@@ -43,8 +44,8 @@ def create_table(option: TableOption):
 
 
 __all__ = [
-    "ArrayTable", "ArrayTableOption", "Handle", "KVTable", "KVTableOption",
-    "MatrixTable", "MatrixTableOption", "SparseMatrixTable",
+    "ArrayTable", "ArrayTableOption", "FusedSuperstep", "Handle", "KVTable",
+    "KVTableOption", "MatrixTable", "MatrixTableOption", "SparseMatrixTable",
     "SparseMatrixTableOption", "Table", "TableOption", "create_table",
-    "get_table", "num_tables", "reset_tables",
+    "get_table", "make_superstep", "num_tables", "reset_tables",
 ]

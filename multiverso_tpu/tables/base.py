@@ -190,6 +190,23 @@ class Table:
             lambda s: jax.device_put(s, self.sharding),
             self.updater.init_state(self.param))
         self._apply = jax.jit(self.updater.apply, donate_argnums=(0, 1))
+
+        # whole-table snapshot: logical region, REPLICATED output (the
+        # all-gather is the reference's whole-table Get; a replicated
+        # result is also host-readable on every process of a multi-host
+        # run, where a model-sharded array is not fully addressable)
+        replicated = NamedSharding(
+            self.mesh, P(*([None] * len(self.padded_shape))))
+        slices = tuple(slice(0, l) for l in self.logical_shape)
+
+        @partial(jax.jit, out_shardings=replicated)
+        def snapshot(param):
+            # jnp.copy guarantees a fresh buffer even when the slice is
+            # the whole array and shardings coincide — the snapshot must
+            # survive the next add's donation of the live buffer
+            return jnp.copy(param[slices])
+
+        self._snapshot = snapshot
         self.table_id = _register(self)
         log.debug("table %r id=%d shape=%s padded=%s updater=%s", name,
                   self.table_id, self.logical_shape, self.padded_shape,
@@ -213,10 +230,15 @@ class Table:
         opt = option if option is not None else self.default_option
         return opt.as_jax(self.mesh)
 
-    def _bump_step(self) -> None:
+    def _bump_step(self) -> int:
+        """Advance step + generation; returns the new generation. Handles
+        must be minted from the RETURNED value — reading self.generation
+        afterwards races with concurrent adds (a handle could carry a
+        later add's generation and never read as superseded)."""
         with self._option_lock:
             self.default_option.step += 1
             self.generation += 1
+            return self.generation
 
     # -- the Get/Add contract ---------------------------------------------
 
@@ -226,15 +248,31 @@ class Table:
         Use :meth:`get_jax` for a stable snapshot."""
         return self.param
 
+    def put_raw(self, padded: jax.Array) -> None:
+        """Replace table storage with a device value of the PADDED shape
+        (placed to the table's sharding). The supported way for apps to
+        install computed initial state (e.g. LDA's count build); advances
+        the generation so outstanding add-handles read as superseded.
+        Updater state is untouched."""
+        if tuple(padded.shape) != self.padded_shape:
+            raise ValueError(
+                f"table {self.name!r}: put_raw shape {tuple(padded.shape)} "
+                f"!= padded shape {self.padded_shape}")
+        if padded.dtype != self.dtype:
+            raise ValueError(
+                f"table {self.name!r}: put_raw dtype {padded.dtype} != "
+                f"table dtype {self.dtype}")
+        self.param = jax.device_put(padded, self.sharding)
+        with self._option_lock:
+            self.generation += 1
+
     def get_jax(self) -> jax.Array:
-        """Device-resident logical value (slices off padding).
+        """Device-resident logical value (slices off padding), replicated.
 
         Returns a fresh buffer: ``add`` donates the param buffer, so a
         zero-copy view would be invalidated by the next update.
         """
-        if self.padded_shape == self.logical_shape:
-            return jnp.copy(self.param)
-        return self.param[tuple(slice(0, l) for l in self.logical_shape)]
+        return self._snapshot(self.param)
 
     def get(self) -> np.ndarray:
         """Whole-table fetch to host (``WorkerTable::Get``)."""
@@ -267,8 +305,7 @@ class Table:
         opt = self._resolve_option(option)
         self.param, self.state = self._apply(self.param, self.state,
                                              delta, opt)
-        self._bump_step()
-        handle = Handle(table=self, generation=self.generation)
+        handle = Handle(table=self, generation=self._bump_step())
         if sync:
             handle.wait()
         return handle
@@ -336,6 +373,11 @@ class Table:
             lambda leaf, tmpl: jax.device_put(
                 repad(leaf, tmpl.shape, tmpl.dtype), self.sharding))
         self.default_option.step = int(manifest.get("step", 0))
+        # load replaces live state: outstanding add-handles must read as
+        # superseded (generation contract: bumped on every applied
+        # update/load)
+        with self._option_lock:
+            self.generation += 1
 
 
 # -- process-wide table registry (TableFactory / table ids) ---------------

@@ -126,7 +126,14 @@ class KVTable:
             self.updater.init_state(self.values))
         # host-side mirror of key→(bucket, slot): authoritative slot
         # assignment (insertion decisions are host-side; device arrays are
-        # the data plane)
+        # the data plane). That mirror is PER-PROCESS: two hosts inserting
+        # different keys would silently assign conflicting slots — fence
+        # it off until insertion is deterministic from the key alone.
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "KVTable slot assignment is host-side and per-process; "
+                "multi-host runs would silently desync. Use ArrayTable/"
+                "MatrixTable for multi-host, or shard keys per host.")
         self._slot_map: Dict[int, Tuple[int, int]] = {}
         self._bucket_fill = np.zeros(self.num_buckets, dtype=np.int32)
         self._build_jits()
@@ -245,7 +252,8 @@ class KVTable:
         with self._option_lock:
             self.default_option.step += 1
             self.generation += 1
-        handle = Handle(table=self, generation=self.generation)
+            gen = self.generation
+        handle = Handle(table=self, generation=gen)
         if sync:
             handle.wait()
         return handle
@@ -307,3 +315,6 @@ class KVTable:
             for s in range(int(self._bucket_fill[b])):
                 self._slot_map[int(joined[b, s])] = (b, s)
         self.default_option.step = int(manifest.get("step", 0))
+        # load replaces live state: outstanding add-handles read superseded
+        with self._option_lock:
+            self.generation += 1

@@ -179,8 +179,7 @@ class MatrixTable(Table):
             padded, mask, _, pd = self._pad_ids(ids, deltas)
             self.param, self.state = self._gather_apply_scatter(
                 self.param, self.state, padded, pd, mask, opt)
-        self._bump_step()
-        handle = Handle(table=self, generation=self.generation)
+        handle = Handle(table=self, generation=self._bump_step())
         if sync:
             handle.wait()
         return handle

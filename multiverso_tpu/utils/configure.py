@@ -177,6 +177,12 @@ define_string("machine_file", "",
               "coordinator address list for multi-host bootstrap "
               "(reference: ZMQ machine list; here: jax.distributed)")
 define_int("port", 0, "coordinator port for multi-host bootstrap")
+define_int("num_processes", 0,
+           "multi-host process count (0 = auto-detect from the platform; "
+           "required for CPU multi-process runs)")
+define_int("process_id", -1,
+           "this host's process id (-1 = auto-detect from the platform; "
+           "required for CPU multi-process runs)")
 define_int("data_parallel", 0,
            "data-parallel mesh axis size (0 = all local devices)")
 define_int("model_parallel", 1, "model-parallel mesh axis size")

@@ -1,0 +1,79 @@
+"""Child process for the 2-process multi-host test (SURVEY.md §5: the
+mpirun-np-N analog extended to REAL multi-process — two local processes
+with a CPU coordinator exercising init/barrier/table ops/logreg).
+
+Run by tests/test_multihost.py:  python _multihost_child.py <port> <pid>
+(env: JAX_PLATFORMS=cpu, XLA_FLAGS=--xla_force_host_platform_device_count=2)
+"""
+
+import sys
+
+import numpy as np
+
+
+def main() -> None:
+    port, pid = int(sys.argv[1]), int(sys.argv[2])
+
+    import jax
+    # the image's sitecustomize pins jax_platforms="axon,cpu" (overriding
+    # the JAX_PLATFORMS env var); force pure-CPU so two processes don't
+    # fight over the single tunneled TPU chip
+    jax.config.update("jax_platforms", "cpu")
+    from multiverso_tpu import core
+    from multiverso_tpu.tables import ArrayTable, KVTable, reset_tables
+
+    mesh = core.init([f"-machine_file=127.0.0.1:{port}",
+                      "-num_processes=2", f"-process_id={pid}",
+                      "-data_parallel=2", "-model_parallel=2"])
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 4, jax.devices()
+    assert core.size() == 2 and core.rank() == pid
+    assert core.num_workers() == 4 and core.num_servers() == 4
+
+    core.barrier()
+
+    # ArrayTable sharded over BOTH hosts' devices: add + replicated get
+    t = ArrayTable(10, "float32", updater="sgd")
+    from multiverso_tpu.updaters import AddOption
+    t.add(np.arange(10, dtype=np.float32),
+          option=AddOption(learning_rate=0.5), sync=True)
+    np.testing.assert_allclose(t.get(), -0.5 * np.arange(10), rtol=1e-6)
+
+    # a second update through the fused-superstep path
+    from multiverso_tpu.tables import make_superstep
+
+    def body(params, states, locals_, options):
+        (p,) = params
+        return (p + 1.0,), states, locals_, p.sum()
+
+    fused = make_superstep((t,), body)
+    _, aux = fused(())
+    assert np.isfinite(float(aux))
+    np.testing.assert_allclose(t.get(), 1.0 - 0.5 * np.arange(10),
+                               rtol=1e-6)
+
+    # logreg: one real data-parallel epoch across the two processes
+    from multiverso_tpu.apps.logreg import (LogisticRegression,
+                                            LogRegConfig, synthetic_blobs)
+    X, y = synthetic_blobs(64, 8, 3, seed=0)
+    app = LogisticRegression(LogRegConfig(
+        input_dim=8, num_classes=3, minibatch_size=32, epochs=2,
+        learning_rate=0.1))
+    loss = app.train(X, y)
+    assert np.isfinite(loss), loss
+
+    # KVTable is host-assigned: must refuse multi-host
+    try:
+        KVTable(100)
+    except NotImplementedError:
+        pass
+    else:
+        raise SystemExit("KVTable did not raise under process_count=2")
+
+    core.barrier()
+    reset_tables()
+    print(f"MULTIHOST_OK rank={pid}")
+
+
+if __name__ == "__main__":
+    main()

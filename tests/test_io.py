@@ -1,0 +1,92 @@
+"""IO stream layer tests: scheme registry with two live schemes
+(file://, mem://) — the reference proves its StreamFactory with
+local + hdfs backends (SURVEY.md §3.7/§6.4)."""
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.io import (StreamFactory, mem_store_clear, open_stream,
+                               register_scheme)
+
+
+@pytest.fixture(autouse=True)
+def _clean_mem():
+    yield
+    mem_store_clear()
+
+
+class TestFileScheme:
+    def test_roundtrip_uri_and_bare_path(self, tmp_path):
+        p = tmp_path / "a" / "blob.bin"
+        with open_stream(f"file://{p}", "wb") as s:
+            s.write(b"payload")
+        with open_stream(str(p), "rb") as s:
+            assert s.read() == b"payload"
+
+    def test_write_creates_parent_dirs(self, tmp_path):
+        p = tmp_path / "deep" / "er" / "x.bin"
+        with open_stream(f"file://{p}", "wb") as s:
+            s.write(b"x")
+        assert p.read_bytes() == b"x"
+
+
+class TestMemScheme:
+    def test_roundtrip(self):
+        with open_stream("mem://ckpt/t0", "wb") as s:
+            s.write(b"hello")
+        with open_stream("mem://ckpt/t0", "rb") as s:
+            assert s.read() == b"hello"
+
+    def test_append(self):
+        with open_stream("mem://log", "wb") as s:
+            s.write(b"ab")
+        with open_stream("mem://log", "ab") as s:
+            s.write(b"cd")
+        with open_stream("mem://log", "rb") as s:
+            assert s.read() == b"abcd"
+
+    def test_missing_raises(self):
+        with pytest.raises(FileNotFoundError):
+            open_stream("mem://nope", "rb")
+
+    def test_incomplete_write_not_published(self):
+        s = open_stream("mem://partial", "wb")
+        s.write(b"half")
+        # not closed yet: nothing published
+        with pytest.raises(FileNotFoundError):
+            open_stream("mem://partial", "rb")
+        s.close()
+        with open_stream("mem://partial", "rb") as r:
+            assert r.read() == b"half"
+
+
+class TestRegistry:
+    def test_unknown_scheme_raises(self):
+        with pytest.raises(ValueError, match="unsupported stream scheme"):
+            open_stream("hdfs://cluster/x", "rb")
+
+    def test_custom_scheme_registers(self):
+        calls = []
+
+        def opener(path, mode):
+            calls.append((path, mode))
+            import io
+            return io.BytesIO(b"custom")
+
+        register_scheme("null", opener)
+        with StreamFactory.get_stream("null://whatever") as s:
+            assert s.read() == b"custom"
+        assert calls == [("whatever", "rb")]
+
+
+class TestCheckpointThroughMem:
+    def test_table_store_load_mem(self, mesh8):
+        from multiverso_tpu.tables import ArrayTable, reset_tables
+        t = ArrayTable(17, "float32", updater="adagrad")
+        t.add(np.arange(17, dtype=np.float32))
+        t.store("mem://ckpt/arr.npz")
+        want = t.get()
+        t2 = ArrayTable(17, "float32", updater="adagrad")
+        t2.load("mem://ckpt/arr.npz")
+        np.testing.assert_allclose(t2.get(), want)
+        reset_tables()

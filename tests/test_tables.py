@@ -185,6 +185,38 @@ class TestSparseMatrixTable:
         t.add_sparse([2], [1], [7.0], sync=True)
         np.testing.assert_allclose(t.get_rows([2])[0], [0, 7, 0])
 
+    def test_sparse_get_matches_dense(self, mesh8):
+        # random sparse counts; CSR sparse-get must reconstruct the dense
+        # rows exactly (it is exact, not top-k-truncated)
+        rng = np.random.default_rng(3)
+        t = SparseMatrixTable(32, 64, "int32", updater="default")
+        n = 200
+        rows = rng.integers(0, 32, n)
+        cols = rng.integers(0, 64, n)
+        vals = rng.integers(-3, 4, n)  # includes zeros and negatives
+        t.add_sparse(rows, cols, vals, sync=True)
+        dense = t.get()
+        req = [5, 0, 31, 5]  # duplicates allowed
+        indptr, ccols, cvals = t.get_rows_sparse(req)
+        assert indptr.shape == (len(req) + 1,)
+        for i, r in enumerate(req):
+            got = np.zeros(64, np.int32)
+            got[ccols[indptr[i]:indptr[i + 1]]] = \
+                cvals[indptr[i]:indptr[i + 1]]
+            np.testing.assert_array_equal(got, dense[r])
+            # strictly nonzero entries only, ascending col order
+            seg = ccols[indptr[i]:indptr[i + 1]]
+            assert np.all(np.diff(seg) > 0)
+            assert np.all(cvals[indptr[i]:indptr[i + 1]] != 0)
+
+    def test_sparse_get_empty_and_full_rows(self, mesh8):
+        t = SparseMatrixTable(4, 8, "float32", updater="default")
+        t.add_sparse([1] * 8, list(range(8)), [1.0] * 8, sync=True)
+        indptr, cols, vals = t.get_rows_sparse([0, 1])
+        assert indptr.tolist() == [0, 0, 8]  # row 0 empty, row 1 full
+        np.testing.assert_array_equal(cols, np.arange(8))
+        np.testing.assert_allclose(vals, 1.0)
+
 
 class TestKVTable:
     def test_missing_keys_default(self, mesh8):
@@ -363,6 +395,19 @@ class TestCheckpoint:
         np.testing.assert_allclose(np.asarray(got)[:8], 2 * np.ones(8))
         np.testing.assert_allclose(t.get(), 2 * np.ones(8))
         assert h1.done() and h2.done()
+
+    def test_load_supersedes_outstanding_handles(self, mesh8, tmp_path):
+        # the generation contract covers load too: restoring a checkpoint
+        # replaces live state, so outstanding add-handles read superseded
+        t = ArrayTable(8, updater="default")
+        t.add(np.ones(8, np.float32), sync=True)
+        uri = str(tmp_path / "gen.npz")
+        t.store(uri)
+        h = t.add_async(np.ones(8, np.float32))
+        assert not h.superseded()
+        t.load(uri)
+        assert h.superseded()
+        np.testing.assert_allclose(t.get(), np.ones(8))
 
     def test_get_handle_is_stable_snapshot(self, mesh8):
         # a get-handle returns the value at issue time even after later
