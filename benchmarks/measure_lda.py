@@ -48,7 +48,7 @@ def measure_cpu() -> dict:
     return json.loads(out)
 
 
-def measure_tpu() -> dict:
+def measure_tpu(sampler: str = "tiled") -> dict:
     import numpy as np
     from multiverso_tpu import core
     from multiverso_tpu.apps.lightlda import LightLDA, LDAConfig
@@ -61,22 +61,44 @@ def measure_tpu() -> dict:
     core.init()
     app = LightLDA(tw, td, V, LDAConfig(num_topics=K_TPU,
                                         batch_tokens=BATCH,
-                                        steps_per_call=1, seed=1))
+                                        steps_per_call=1, seed=1,
+                                        sampler=sampler))
     app.sweep()                                   # compile + first sweep
 
     def sync():
-        return float(np.asarray(app.summary.param)[0])
+        return float(np.asarray(app.summary.raw())[0])
     sync()
     t0 = time.perf_counter()
     app.sweep()
     sync()
     dt = time.perf_counter() - t0
     return {"doc_tokens_per_sec": T / dt, "secs": dt, "topics": K_TPU,
-            "batch_tokens": BATCH, "loglik_after": app.loglik()}
+            "batch_tokens": BATCH, "sampler": sampler,
+            "loglik_after": app.loglik()}
+
+
+def pinned_cpu() -> dict:
+    """The 1-core benchmark host is noisy/shared: keep the BEST recorded
+    cpu_worker measurement (generous to the reference) instead of letting
+    a slow re-run inflate vs_baseline."""
+    fresh = measure_cpu()
+    try:
+        with open(OUT) as f:
+            prev = json.load(f)["cpu_worker"]
+        same_workload = all(
+            prev.get(k) == fresh.get(k)
+            for k in ("tokens", "sweeps", "topics", "vocab", "docs"))
+        if same_workload and \
+                prev["doc_tokens_per_sec"] > fresh["doc_tokens_per_sec"]:
+            prev["note"] = "best recorded measurement (host is noisy)"
+            return prev
+    except (OSError, KeyError, ValueError):
+        pass
+    return fresh
 
 
 if __name__ == "__main__":
-    cpu = measure_cpu()
+    cpu = pinned_cpu()
     tpu = measure_tpu()
     result = {
         "metric": "LightLDA doc-tokens/sec",
@@ -85,7 +107,8 @@ if __name__ == "__main__":
         "vs_baseline": tpu["doc_tokens_per_sec"] / cpu["doc_tokens_per_sec"],
         "workload": {"vocab": V, "docs": D, "tokens": T},
         "notes": "TPU runs K=1024 (more work) vs CPU K=1000; TPU sampler "
-                 "is exact Gibbs vs the baseline's approximate MH. "
+                 "is O(K) collapsed Gibbs (tiled pallas kernel, AD-LDA "
+                 "batch staleness) vs the baseline's approximate MH. "
                  "16-worker cluster scored as 16x cpu_worker.",
     }
     with open(OUT, "w") as f:

@@ -64,6 +64,7 @@ class LDAConfig:
     steps_per_call: int = 16        # scan length
     num_iterations: int = 10        # full Gibbs sweeps
     sampler: str = "gibbs"          # "gibbs" (exact O(K)) | "mh" (O(1))
+    #                               | "tiled" (pallas kernel, K%128==0)
     mh_steps: int = 2               # MH: rounds of (word + doc) proposal
     precision: str = "float32"      # posterior/CDF math dtype; bfloat16
     # is measured equal-speed at large batches (the op mix is not
@@ -93,6 +94,17 @@ def load_docs(path: str) -> Tuple[np.ndarray, np.ndarray, int]:
     return token_words, token_docs, vocab
 
 
+def _predictive_ll(A, W, S, m, alpha, beta, K, vbeta):
+    """Per-token predictive log-likelihood under point estimates:
+    log sum_k theta_dk * phi_wk (the reference's `Eval` math), shared by
+    every sampler's eval path. A/W are the gathered 2-D f32 count rows,
+    S the [K] summary, m the f32 token mask."""
+    theta = (A + alpha) / (A.sum(1, keepdims=True) + K * alpha)
+    phi = (W + beta) / (S + vbeta)
+    ll = jnp.log(jnp.maximum((theta * phi).sum(1), 1e-30))
+    return (ll * m).sum()
+
+
 class LightLDA:
     """The app: count tables + the fused Gibbs-sweep superstep."""
 
@@ -119,10 +131,20 @@ class LightLDA:
         self.alpha = c.resolved_alpha()
         self.beta = c.beta
 
-        # tables (the reference's server-side state)
+        tiled = c.sampler == "tiled"
+        if tiled and self.K % 128:
+            raise ValueError(f"sampler='tiled' needs num_topics % 128 "
+                             f"== 0, got {self.K}")
+        # the pallas kernel needs the Mosaic TPU backend; on a CPU mesh
+        # (tests) it runs in interpreter mode
+        self._interpret = tiled and \
+            self.mesh.devices.flat[0].platform == "cpu"
+
+        # tables (the reference's server-side state); tiled storage puts
+        # one word's topic row in exactly one (8,128) int32 tile
         self.word_topic = SparseMatrixTable(
             self.V, self.K, "int32", updater="default", mesh=self.mesh,
-            name=f"{name}_word_topic")
+            name=f"{name}_word_topic", tiled=tiled)
         self.summary = ArrayTable(self.K, "int32", updater="default",
                                   mesh=self.mesh, name=f"{name}_summary")
         self._scratch_word = self.word_topic.padded_shape[0] - 1
@@ -130,8 +152,10 @@ class LightLDA:
         # worker-local doc-topic counts (+1 scratch doc for padded lanes);
         # placed on the mesh, NOT the default device (platform may differ)
         self._scratch_doc = self.num_docs
-        self._ndk = core.place(
-            np.zeros((self.num_docs + 1, self.K), np.int32), mesh=self.mesh)
+        ndk_shape = (self.num_docs + 1, self.K // 128, 128) if tiled \
+            else (self.num_docs + 1, self.K)
+        self._ndk = core.place(np.zeros(ndk_shape, np.int32),
+                               mesh=self.mesh)
 
         # token stream, padded to a whole number of superstep calls
         B, S = c.batch_tokens, c.steps_per_call
@@ -164,11 +188,23 @@ class LightLDA:
         for call in range(self.calls_per_sweep):
             lo = call * call_tokens
             sl = slice(lo, lo + call_tokens)
-            self._calls.append(tuple(
-                self._place(a[sl].reshape(S, B), spec) for a in
-                (self._tw, self._td,
-                 np.arange(T_pad, dtype=np.int32),
-                 self._mask.astype(np.int32))))
+            if tiled:
+                # z positions are contiguous per scan step: pass scalar
+                # offsets and dynamic-slice z (a [B]-index gather/scatter
+                # of z costs ~7-10ms/step, measured — a slice is free)
+                offs = np.arange(lo, lo + call_tokens, B, dtype=np.int32)
+                self._calls.append((
+                    self._place(self._tw[sl].reshape(S, B), spec),
+                    self._place(self._td[sl].reshape(S, B), spec),
+                    self._place(offs, P()),
+                    self._place(self._mask[sl].reshape(S, B)
+                                .astype(np.int32), spec)))
+            else:
+                self._calls.append(tuple(
+                    self._place(a[sl].reshape(S, B), spec) for a in
+                    (self._tw, self._td,
+                     np.arange(T_pad, dtype=np.int32),
+                     self._mask.astype(np.int32))))
 
         if c.sampler == "mh":
             # doc structure for the MH doc-proposal (z-array trick): the
@@ -192,11 +228,14 @@ class LightLDA:
         z0 = rng.integers(0, self.K, T_pad).astype(np.int32)
         self._z = self._place(z0, P())
         self._init_counts()
-        self._build_superstep()
+        if tiled:
+            self._build_tiled_superstep()
+        else:
+            self._build_superstep()
         if c.sampler == "mh":
             self._build_mh_superstep()
-        elif c.sampler != "gibbs":
-            raise ValueError(f"sampler must be 'gibbs' or 'mh', "
+        elif c.sampler not in ("gibbs", "tiled"):
+            raise ValueError(f"sampler must be 'gibbs', 'mh' or 'tiled', "
                              f"got {c.sampler!r}")
         self._key = core.prng_key(c.seed, mesh=self.mesh)
         self._calls_done = 0
@@ -205,12 +244,18 @@ class LightLDA:
     # -- count init --------------------------------------------------------
 
     def _init_counts(self) -> None:
+        tiled = self.config.sampler == "tiled"
+
         @jax.jit
         def build(z, tw, td, m):
-            nwk = jnp.zeros(self.word_topic.padded_shape, jnp.int32)
-            nwk = nwk.at[tw, z].add(m)
-            ndk = jnp.zeros((self.num_docs + 1, self.K), jnp.int32)
-            ndk = ndk.at[td, z].add(m)
+            nwk = jnp.zeros(self.word_topic.storage_shape, jnp.int32)
+            ndk = jnp.zeros(self._ndk.shape, jnp.int32)
+            if tiled:
+                nwk = nwk.at[tw, z // 128, z % 128].add(m)
+                ndk = ndk.at[td, z // 128, z % 128].add(m)
+            else:
+                nwk = nwk.at[tw, z].add(m)
+                ndk = ndk.at[td, z].add(m)
             nk = jnp.zeros(self.summary.padded_shape, jnp.int32)
             nk = nk.at[z].add(m)
             return nwk, ndk, nk
@@ -294,19 +339,93 @@ class LightLDA:
 
         @jax.jit
         def loglik(nwk, ndk, nk, ws, ds, mask):
-            # per-token predictive LL under point estimates:
-            # log sum_k theta_dk * phi_wk. Operands are the pre-placed
-            # [S, B] superstep inputs (mask int32) — flatten here rather
-            # than re-uploading the corpus from host every eval.
+            # operands are the pre-placed [S, B] superstep inputs (mask
+            # int32) — flatten here rather than re-uploading the corpus
+            # from host every eval
             ws, ds = ws.reshape(-1), ds.reshape(-1)
             m = mask.reshape(-1).astype(jnp.float32)
             A = jnp.take(ndk, ds, axis=0).astype(jnp.float32)
             W = jnp.take(nwk, ws, axis=0).astype(jnp.float32)
             S = nk[:K].astype(jnp.float32)
-            theta = (A + alpha) / (A.sum(1, keepdims=True) + K * alpha)
-            phi = (W + beta) / (S + vbeta)
-            ll = jnp.log(jnp.maximum((theta * phi).sum(1), 1e-30))
-            return (ll * m).sum()
+            return _predictive_ll(A, W, S, m, alpha, beta, K, vbeta)
+
+        self._loglik = loglik
+
+    def _build_tiled_superstep(self) -> None:
+        """The measured-fastest sampler: tile-aligned counts + the fused
+        pallas posterior/sampler (multiverso_tpu.ops.gibbs_sample_tiled).
+
+        Differences from the exact 'gibbs' body (all within the AD-LDA
+        approximation family the reference itself lives in — see module
+        docstring):
+        - own-token removal is in-register on the numerator counts (no
+          upfront decrement scatters); the summary denominator keeps the
+          own count (+1 in a ~T/K-sized denominator),
+        - counts move by NET scatters (-1 old, +1 new), halving scatter
+          traffic,
+        - the summary delta comes out of the kernel (no [B, K] one-hot
+          reductions in HBM).
+        """
+        c = self.config
+        alpha, beta = self.alpha, self.beta
+        vbeta = self.V * beta
+        K = self.K
+        B = c.batch_tokens
+        tiles = K // 128
+        interpret = self._interpret
+        from multiverso_tpu.ops import gibbs_sample_tiled
+
+        def scan_body(carry, inp):
+            nwk3, nk, ndk3, z = carry
+            w, d, off, msk, key = inp
+            zi = lax.dynamic_slice_in_dim(z, off, B)
+            A3 = jnp.take(ndk3, d, axis=0)              # [B, C, 128]
+            W3 = jnp.take(nwk3, w, axis=0)
+            sinv = 1.0 / (nk[:K].astype(jnp.float32).reshape(tiles, 128)
+                          + vbeta)
+            k1, k2 = jax.random.split(key)
+            u1 = jax.random.uniform(k1, (B,))
+            u2 = jax.random.uniform(k2, (B,))
+            znew, nkd = gibbs_sample_tiled(
+                A3, W3, sinv, zi, msk, u1, u2, alpha=alpha, beta=beta,
+                interpret=interpret)
+            one = msk
+            cold, lold = zi // 128, zi % 128
+            cnew, lnew = znew // 128, znew % 128
+            nwk3 = nwk3.at[w, cold, lold].add(-one)
+            nwk3 = nwk3.at[w, cnew, lnew].add(one)
+            ndk3 = ndk3.at[d, cold, lold].add(-one)
+            ndk3 = ndk3.at[d, cnew, lnew].add(one)
+            nk = nk.at[:K].add(nkd.reshape(-1))
+            z = lax.dynamic_update_slice_in_dim(z, znew, off, 0)
+            return (nwk3, nk, ndk3, z), ()
+
+        def body(params, states, locals_, options, ws, ds, offs, msks,
+                 key):
+            nwk3, nk = params
+            ndk3, z = locals_
+            keys = jax.random.split(key, ws.shape[0])
+            (nwk3, nk, ndk3, z), _ = lax.scan(
+                scan_body, (nwk3, nk, ndk3, z),
+                (ws, ds, offs, msks, keys))
+            return (nwk3, nk), states, (ndk3, z), None
+
+        self._fused = make_superstep((self.word_topic, self.summary), body,
+                                     name="lda_tiled")
+
+        @jax.jit
+        def loglik(nwk3, ndk3, nk, ws, ds, mask):
+            # same eval as the flat sampler; only the gather layout
+            # differs (tiled rows reshaped back to 2-D)
+            ws, ds = ws.reshape(-1), ds.reshape(-1)
+            m = mask.reshape(-1).astype(jnp.float32)
+            n = ws.shape[0]
+            A = jnp.take(ndk3, ds, axis=0).reshape(n, K) \
+                .astype(jnp.float32)
+            W = jnp.take(nwk3, ws, axis=0).reshape(n, K) \
+                .astype(jnp.float32)
+            S = nk[:K].astype(jnp.float32)
+            return _predictive_ll(A, W, S, m, alpha, beta, K, vbeta)
 
         self._loglik = loglik
 
@@ -478,7 +597,8 @@ class LightLDA:
 
     def doc_topics(self) -> np.ndarray:
         """[num_docs, K] doc-topic counts (worker-local state)."""
-        return np.asarray(self._ndk[: self.num_docs])
+        return np.asarray(self._ndk[: self.num_docs]).reshape(
+            self.num_docs, self.K)
 
     def word_topics(self) -> np.ndarray:
         """[V, K] word-topic counts from the table."""
@@ -500,7 +620,9 @@ class LightLDA:
                       "t_pad": int(self._z.shape[0]),
                       "calls_done": self._calls_done},
                      {"z": np.asarray(self._z),
-                      "ndk": np.asarray(self._ndk)})
+                      # layout-agnostic 2-D shape (tiled stores ndk 3-D)
+                      "ndk": np.asarray(self._ndk).reshape(
+                          self.num_docs + 1, self.K)})
 
     def load(self, uri_prefix: str) -> None:
         from multiverso_tpu.tables.base import loadz_stream
@@ -527,7 +649,8 @@ class LightLDA:
                 f"length {int(self._z.shape[0])}: batch_tokens/"
                 "steps_per_call must match the checkpointing run to resume")
         self._z = self._place(np.asarray(data["z"]), P())
-        self._ndk = self._place(np.asarray(data["ndk"]), P())
+        self._ndk = self._place(
+            np.asarray(data["ndk"]).reshape(self._ndk.shape), P())
         # resume the RNG sequence where the checkpoint left off; replaying
         # consumed fold_in keys would correlate sweeps across the resume
         self._calls_done = int(manifest.get("calls_done", 0))

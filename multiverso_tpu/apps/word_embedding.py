@@ -284,7 +284,11 @@ class WordEmbedding:
         srcs_buf, tgts_buf = [], []
         losses, call_no = [], 0
         t0 = time.perf_counter()
-        for src, tgt in self._batches():
+        # host pair generation overlaps device compute (the reference's
+        # ParameterLoader/ASyncBuffer pipelining role, SURVEY.md §4.5)
+        from multiverso_tpu.utils.async_buffer import prefetch_iterator
+        for src, tgt in prefetch_iterator(self._batches(),
+                                          depth=2 * c.steps_per_call):
             srcs_buf.append(src)
             tgts_buf.append(tgt)
             if len(srcs_buf) < c.steps_per_call:

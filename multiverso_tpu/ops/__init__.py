@@ -1,0 +1,6 @@
+"""Pallas TPU kernels for hot ops (SURVEY.md §8 hard-part #1: LightLDA's
+sampler throughput is the risk buffer XLA alone doesn't cover)."""
+
+from multiverso_tpu.ops.lda_sampler import gibbs_sample_tiled
+
+__all__ = ["gibbs_sample_tiled"]

@@ -31,7 +31,8 @@ def create_table(option: TableOption):
     if isinstance(option, SparseMatrixTableOption):
         return SparseMatrixTable(option.num_rows, option.num_cols,
                                  option.dtype, init_value=option.init_value,
-                                 updater=option.updater, name=option.name)
+                                 updater=option.updater, name=option.name,
+                                 tiled=option.tiled)
     if isinstance(option, MatrixTableOption):
         return MatrixTable(option.num_rows, option.num_cols, option.dtype,
                            init_value=option.init_value,

@@ -179,6 +179,10 @@ class Table:
         lead = self.logical_shape[0] if self.logical_shape else 1
         padded_lead = self._pad_lead(lead, shards)
         self.padded_shape = (padded_lead,) + self.logical_shape[1:]
+        # physical layout of the param array; subclasses may re-tile it
+        # (storage_shape != padded_shape) while keeping the 2-D logical
+        # contract — checkpoints always serialize the PADDED shape
+        self.storage_shape = self.padded_shape
         self.spec = P(core.MODEL_AXIS, *([None] * (len(shape) - 1)))
         self.sharding = NamedSharding(self.mesh, self.spec)
 
@@ -249,15 +253,15 @@ class Table:
         return self.param
 
     def put_raw(self, padded: jax.Array) -> None:
-        """Replace table storage with a device value of the PADDED shape
+        """Replace table storage with a device value of the STORAGE shape
         (placed to the table's sharding). The supported way for apps to
         install computed initial state (e.g. LDA's count build); advances
         the generation so outstanding add-handles read as superseded.
         Updater state is untouched."""
-        if tuple(padded.shape) != self.padded_shape:
+        if tuple(padded.shape) != self.storage_shape:
             raise ValueError(
                 f"table {self.name!r}: put_raw shape {tuple(padded.shape)} "
-                f"!= padded shape {self.padded_shape}")
+                f"!= storage shape {self.storage_shape}")
         if padded.dtype != self.dtype:
             raise ValueError(
                 f"table {self.name!r}: put_raw dtype {padded.dtype} != "
@@ -339,9 +343,19 @@ class Table:
             "step": self.default_option.step,
         }
 
+    def _export_param(self) -> np.ndarray:
+        """Param as a host array in the PADDED (layout-agnostic) shape —
+        checkpoints interchange across storage layouts."""
+        return np.asarray(self.param).reshape(self.padded_shape)
+
+    def _install_param(self, host_padded: np.ndarray) -> None:
+        """Place a host array of the padded shape into table storage."""
+        self.param = jax.device_put(
+            host_padded.reshape(self.storage_shape), self.sharding)
+
     def store(self, uri: str) -> None:
         """Serialize param + updater state through the stream layer."""
-        payload = {"param": np.asarray(self.param)}
+        payload = {"param": self._export_param()}
         manifest = self._manifest()
         manifest["n_state_leaves"] = pack_state(self.state, payload)
         savez_stream(uri, manifest, payload)
@@ -365,9 +379,8 @@ class Table:
                 arr = np.pad(arr, pad)
             return arr.astype(want_dtype)
 
-        self.param = jax.device_put(
-            repad(data["param"], self.padded_shape, self.dtype),
-            self.sharding)
+        self._install_param(repad(data["param"], self.padded_shape,
+                                  self.dtype))
         self.state = unpack_state(
             data, manifest["n_state_leaves"], self.state,
             lambda leaf, tmpl: jax.device_put(

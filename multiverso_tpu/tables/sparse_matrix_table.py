@@ -12,6 +12,16 @@ scatter — XLA lowers this to a sorted segment scatter on TPU. COO batch
 lengths are bucketed to powers of two; padded lanes scatter zeros into a
 reserved scratch row.
 
+Tiled storage (``tiled=True``, requires ``num_cols % 128 == 0``): the
+physical array is ``[rows, C, 128]`` with ``C = num_cols/128``, so ONE
+LOGICAL ROW IS EXACTLY ONE (8,128) int32 TPU TILE — a random row gather
+reads a 4 KB payload instead of the 32 KB tile-span the 2-D layout
+incurs (8 consecutive rows share each tile). This is the layout the LDA
+Gibbs superstep's gathers/scatters want (benchmarks/experiments/
+lda_tile_probe.py); the PUBLIC API stays 2-D — row/COO/checkpoint
+operations reshape at the jit boundary, and checkpoints serialize the
+layout-agnostic padded 2-D shape either way.
+
 Sparse adds are supported for the stateless updaters (``default`` — the
 LightLDA count case — and ``sgd``). Stateful updaters would need
 per-element state touched only at COO positions; the reference never uses
@@ -30,9 +40,12 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from multiverso_tpu import core
 from multiverso_tpu.tables.base import Handle
 from multiverso_tpu.tables.matrix_table import MatrixTable, _bucket
 from multiverso_tpu.updaters import AddOption
+
+LANES = 128
 
 
 @dataclasses.dataclass
@@ -43,6 +56,7 @@ class SparseMatrixTableOption:
     init_value: Any = 0
     updater: Optional[str] = None
     name: str = "sparse_matrix_table"
+    tiled: bool = False
 
 
 class SparseMatrixTable(MatrixTable):
@@ -50,7 +64,13 @@ class SparseMatrixTable(MatrixTable):
                  dtype: Any = "float32", *, init_value: Any = 0,
                  updater: Optional[str] = None, mesh=None,
                  name: str = "sparse_matrix_table",
-                 default_option: Optional[AddOption] = None) -> None:
+                 default_option: Optional[AddOption] = None,
+                 tiled: bool = False) -> None:
+        if tiled and num_cols % LANES:
+            raise ValueError(f"tiled storage needs num_cols % {LANES} == 0,"
+                             f" got {num_cols}")
+        self.tiled = tiled
+        self.tiles = num_cols // LANES if tiled else 0
         super().__init__(num_rows, num_cols, dtype, init_value=init_value,
                          updater=updater, mesh=mesh, name=name,
                          default_option=default_option)
@@ -58,18 +78,68 @@ class SparseMatrixTable(MatrixTable):
             raise ValueError(
                 f"SparseMatrixTable supports stateless updaters "
                 f"(default, sgd), got {self.updater.name!r}")
+        if tiled:
+            self._retile_storage()
+        self._build_sparse_jits()
+
+    # -- tiled layout ------------------------------------------------------
+
+    def _retile_storage(self) -> None:
+        """Swap the 2-D param for the [rows, C, 128] tile-aligned layout
+        (state is the empty pytree — stateless updaters enforced)."""
+        c = self.tiles
+        self.storage_shape = (self.padded_shape[0], c, LANES)
+        self.spec = P(core.MODEL_AXIS, None, None)
+        self.sharding = NamedSharding(self.mesh, self.spec)
+        host = np.asarray(self.param).reshape(self.storage_shape)
+        self.param = jax.device_put(host, self.sharding)
+
+        replicated = NamedSharding(self.mesh, P(None, None))
+        n_rows, n_cols = self.logical_shape
+
+        @partial(jax.jit, out_shardings=replicated)
+        def snapshot(param):
+            p2 = param.reshape(self.padded_shape)
+            return jnp.copy(p2[:n_rows, :n_cols])
+
+        self._snapshot = snapshot
+
+        @partial(jax.jit, out_shardings=replicated)
+        def gather_rows(param, ids):
+            rows = jnp.take(param, ids, axis=0)      # [n, C, 128]
+            return rows.reshape(ids.shape[0], n_cols)
 
         @partial(jax.jit, donate_argnums=(0,))
-        def coo_scatter_add(param, rows, cols, vals):
-            return param.at[rows, cols].add(vals.astype(param.dtype))
+        def scatter_add(param, ids, deltas):
+            d3 = deltas.reshape(ids.shape[0], c, LANES)
+            return param.at[ids].add(d3.astype(param.dtype))
+
+        self._gather_rows = gather_rows
+        self._scatter_add = scatter_add
+        # _gather_apply_scatter is unreachable: stateless updaters only
+
+    # -- jitted sparse kernels --------------------------------------------
+
+    def _build_sparse_jits(self) -> None:
+        if self.tiled:
+            @partial(jax.jit, donate_argnums=(0,))
+            def coo_scatter_add(param, rows, cols, vals):
+                return param.at[rows, cols // LANES, cols % LANES].add(
+                    vals.astype(param.dtype))
+        else:
+            @partial(jax.jit, donate_argnums=(0,))
+            def coo_scatter_add(param, rows, cols, vals):
+                return param.at[rows, cols].add(vals.astype(param.dtype))
 
         self._coo_scatter_add = coo_scatter_add
 
         replicated = NamedSharding(self.mesh, P(None))
+        n_cols = self.num_cols
 
         @partial(jax.jit, out_shardings=replicated)
         def row_nnz(param, ids):
-            rows = jnp.take(param, ids, axis=0)
+            rows = jnp.take(param, ids, axis=0).reshape(ids.shape[0],
+                                                        n_cols)
             return (rows != 0).sum(axis=1).astype(jnp.int32)
 
         self._row_nnz = row_nnz
@@ -81,10 +151,12 @@ class SparseMatrixTable(MatrixTable):
         fn = self._topk_jits.get(k)
         if fn is None:
             replicated = NamedSharding(self.mesh, P(None, None))
+            n_cols = self.num_cols
 
             @partial(jax.jit, out_shardings=(replicated, replicated))
             def topk(param, ids):
-                rows = jnp.take(param, ids, axis=0)
+                rows = jnp.take(param, ids, axis=0).reshape(ids.shape[0],
+                                                            n_cols)
                 mag = jnp.abs(rows.astype(jnp.float32))
                 _, cols = lax.top_k(mag, k)
                 vals = jnp.take_along_axis(rows, cols, axis=1)
@@ -93,40 +165,38 @@ class SparseMatrixTable(MatrixTable):
             fn = self._topk_jits[k] = topk
         return fn
 
-    def get_rows_sparse(self, row_ids) -> Tuple[np.ndarray, np.ndarray,
-                                                np.ndarray]:
-        """Sparse Get: only the NONZERO entries of the requested rows
-        reach the host (the reference's SparseMatrixWorkerTable Get
-        returns only nonzero/requested entries — SURVEY.md §3.3).
+    # -- whole-table Add (2-D logical contract over tiled storage) --------
 
-        Returns CSR-style ``(indptr [n+1], cols [nnz], vals [nnz])``:
-        row ``i`` of the request holds entries
-        ``cols[indptr[i]:indptr[i+1]]`` (ascending col order).
+    def add(self, delta: Any, option: Optional[AddOption] = None,
+            sync: bool = False) -> Handle:
+        if not self.tiled:
+            return super().add(delta, option=option, sync=sync)
+        if isinstance(delta, jax.Array):
+            # keep device deltas on device (base Table.add parity): pad
+            # the logical region then retile — eager jnp, async dispatch
+            if delta.shape == self.logical_shape:
+                pad = [(0, p - l) for p, l in zip(self.padded_shape,
+                                                  delta.shape)]
+                delta = jnp.pad(delta, pad)
+            if delta.shape != self.padded_shape:
+                raise ValueError(
+                    f"table {self.name!r}: delta shape {delta.shape} != "
+                    f"table shape {self.logical_shape}")
+            delta = delta.reshape(self.storage_shape)
+        else:
+            delta = self._pad(np.asarray(delta)) \
+                .reshape(self.storage_shape)
+        opt = self._resolve_option(option)
+        self.param, self.state = self._apply(self.param, self.state,
+                                             delta, opt)
+        handle = Handle(table=self, generation=self._bump_step())
+        if sync:
+            handle.wait()
+        return handle
 
-        Exact, not top-k-truncated: a device-side nnz reduction sizes the
-        extraction, so the device→host transfer is O(max_nnz·n), not
-        O(num_cols·n) — the TPU analog of the reference's sparse wire
-        format (its point was not shipping the dense row).
-        """
-        ids = np.asarray(row_ids, dtype=np.int32)
-        self._check_ids(ids)
-        padded, _, n = self._pad_ids(ids)
-        nnz = np.asarray(self._row_nnz(self.param, padded))[:n]
-        k = min(_bucket(max(int(nnz.max(initial=0)), 1)), self.num_cols)
-        cols, vals = self._topk_fn(k)(self.param, padded)
-        cols = np.asarray(cols)[:n]
-        vals = np.asarray(vals)[:n]
-        indptr = np.zeros(n + 1, np.int64)
-        np.cumsum(nnz, out=indptr[1:])
-        out_cols = np.empty(indptr[-1], np.int32)
-        out_vals = np.empty(indptr[-1], vals.dtype)
-        for i in range(n):
-            m = vals[i] != 0
-            ci, vi = cols[i][m], vals[i][m]
-            order = np.argsort(ci, kind="stable")
-            out_cols[indptr[i]:indptr[i + 1]] = ci[order]
-            out_vals[indptr[i]:indptr[i + 1]] = vi[order]
-        return indptr, out_cols, out_vals
+    add_async = add
+
+    # -- COO sparse Add ----------------------------------------------------
 
     def add_sparse(self, rows, cols, values,
                    option: Optional[AddOption] = None,
@@ -164,3 +234,40 @@ class SparseMatrixTable(MatrixTable):
         if sync:
             handle.wait()
         return handle
+
+    # -- sparse Get --------------------------------------------------------
+
+    def get_rows_sparse(self, row_ids) -> Tuple[np.ndarray, np.ndarray,
+                                                np.ndarray]:
+        """Sparse Get: only the NONZERO entries of the requested rows
+        reach the host (the reference's SparseMatrixWorkerTable Get
+        returns only nonzero/requested entries — SURVEY.md §3.3).
+
+        Returns CSR-style ``(indptr [n+1], cols [nnz], vals [nnz])``:
+        row ``i`` of the request holds entries
+        ``cols[indptr[i]:indptr[i+1]]`` (ascending col order).
+
+        Exact, not top-k-truncated: a device-side nnz reduction sizes the
+        extraction, so the device→host transfer is O(max_nnz·n), not
+        O(num_cols·n) — the TPU analog of the reference's sparse wire
+        format (its point was not shipping the dense row).
+        """
+        ids = np.asarray(row_ids, dtype=np.int32)
+        self._check_ids(ids)
+        padded, _, n = self._pad_ids(ids)
+        nnz = np.asarray(self._row_nnz(self.param, padded))[:n]
+        k = min(_bucket(max(int(nnz.max(initial=0)), 1)), self.num_cols)
+        cols, vals = self._topk_fn(k)(self.param, padded)
+        cols = np.asarray(cols)[:n]
+        vals = np.asarray(vals)[:n]
+        indptr = np.zeros(n + 1, np.int64)
+        np.cumsum(nnz, out=indptr[1:])
+        out_cols = np.empty(indptr[-1], np.int32)
+        out_vals = np.empty(indptr[-1], vals.dtype)
+        for i in range(n):
+            m = vals[i] != 0
+            ci, vi = cols[i][m], vals[i][m]
+            order = np.argsort(ci, kind="stable")
+            out_cols[indptr[i]:indptr[i + 1]] = ci[order]
+            out_vals[indptr[i]:indptr[i + 1]] = vi[order]
+        return indptr, out_cols, out_vals

@@ -10,6 +10,13 @@ Extensions for the TPU build (SURVEY.md §6.5): a JSONL metric sink so
 per-step throughput metrics (`words/sec/chip`, `doc-tokens/sec`) are
 scriptable, and a context-manager / decorator API instead of
 MONITOR_BEGIN/END macros.
+
+TPU profiler integration (SURVEY.md §6.1: "per-step wall-clock dashboard
++ `jax.profiler.trace` hooks; name-tag compiled regions with
+`jax.named_scope`"): ``profile(name)`` wraps the region in a
+``jax.named_scope`` (host-side begin; tags device ops traced inside it)
+and :func:`trace` captures a TensorBoard-loadable device trace of any
+code block.
 """
 
 from __future__ import annotations
@@ -62,14 +69,28 @@ class Dashboard:
 
     @contextlib.contextmanager
     def profile(self, name: str) -> Iterator[Monitor]:
+        """Time a region AND tag any ops traced inside it: the region
+        runs under ``jax.named_scope(name)``, so a `jax.profiler` device
+        trace shows the dashboard's monitor names on the compiled ops."""
+        import jax
         mon = self.monitor(name)
         start = time.perf_counter()
         try:
-            yield mon
+            with jax.named_scope(name):
+                yield mon
         finally:
             with self._lock:
                 mon.total_s += time.perf_counter() - start
                 mon.count += 1
+
+    @contextlib.contextmanager
+    def trace(self, log_dir: str) -> Iterator[None]:
+        """Capture a device profiler trace (TensorBoard / Perfetto
+        loadable) for the wrapped block — the `jax.profiler.trace` hook
+        the reference's Dashboard has no analog for (SURVEY.md §6.1)."""
+        import jax
+        with jax.profiler.trace(log_dir):
+            yield
 
     def set_jsonl(self, path: str) -> None:
         with self._lock:
@@ -126,6 +147,11 @@ def emit_metric(name: str, value: float, unit: str = "", **extra) -> dict:
 
 def report() -> str:
     return _DASHBOARD.report()
+
+
+def trace(log_dir: str):
+    """Module-level alias for :meth:`Dashboard.trace`."""
+    return _DASHBOARD.trace(log_dir)
 
 
 class Timer:

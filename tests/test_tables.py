@@ -218,6 +218,70 @@ class TestSparseMatrixTable:
         np.testing.assert_allclose(vals, 1.0)
 
 
+class TestTiledSparseMatrixTable:
+    """Tile-aligned storage must be invisible through the 2-D API."""
+
+    def test_requires_lane_multiple(self, mesh8):
+        with pytest.raises(ValueError, match="128"):
+            SparseMatrixTable(8, 100, tiled=True)
+
+    def test_coo_and_get_match_untiled(self, mesh8):
+        rng = np.random.default_rng(5)
+        n = 300
+        rows = rng.integers(0, 20, n)
+        cols = rng.integers(0, 256, n)
+        vals = rng.integers(-5, 6, n).astype(np.int32)
+        t2 = SparseMatrixTable(20, 256, "int32", updater="default",
+                               name="flat")
+        t3 = SparseMatrixTable(20, 256, "int32", updater="default",
+                               name="tiled", tiled=True)
+        assert t3.storage_shape == (t3.padded_shape[0], 2, 128)
+        t2.add_sparse(rows, cols, vals, sync=True)
+        t3.add_sparse(rows, cols, vals, sync=True)
+        np.testing.assert_array_equal(t2.get(), t3.get())
+        req = [3, 0, 19]
+        np.testing.assert_array_equal(t2.get_rows(req), t3.get_rows(req))
+        i2, c2, v2 = t2.get_rows_sparse(req)
+        i3, c3, v3 = t3.get_rows_sparse(req)
+        np.testing.assert_array_equal(i2, i3)
+        np.testing.assert_array_equal(c2, c3)
+        np.testing.assert_array_equal(v2, v3)
+
+    def test_dense_add_and_add_rows(self, mesh8):
+        t = SparseMatrixTable(6, 128, "float32", updater="default",
+                              tiled=True)
+        d = np.arange(6 * 128, dtype=np.float32).reshape(6, 128)
+        t.add(d, sync=True)
+        np.testing.assert_allclose(t.get(), d)
+        t.add_rows([2, 2], np.ones((2, 128), np.float32), sync=True)
+        np.testing.assert_allclose(t.get()[2], d[2] + 2.0)
+
+    def test_checkpoint_interchanges_with_untiled(self, mesh8, tmp_path):
+        # tiled and flat tables share the padded-2-D checkpoint format
+        t3 = SparseMatrixTable(10, 128, "int32", updater="default",
+                               tiled=True, name="a")
+        t3.add_sparse([1, 9], [0, 127], [7, -3], sync=True)
+        uri = str(tmp_path / "tiled.npz")
+        t3.store(uri)
+        t2 = SparseMatrixTable(10, 128, "int32", updater="default",
+                               name="b")
+        t2.load(uri)
+        np.testing.assert_array_equal(t2.get(), t3.get())
+        t3b = SparseMatrixTable(10, 128, "int32", updater="default",
+                                tiled=True, name="c")
+        t3b.load(uri)
+        np.testing.assert_array_equal(t3b.get(), t3.get())
+
+    def test_put_raw_checks_storage_shape(self, mesh8):
+        import jax.numpy as jnp
+        t = SparseMatrixTable(8, 128, "int32", updater="default",
+                              tiled=True)
+        with pytest.raises(ValueError, match="storage shape"):
+            t.put_raw(jnp.zeros(t.padded_shape, jnp.int32))
+        t.put_raw(jnp.ones(t.storage_shape, jnp.int32))
+        np.testing.assert_array_equal(t.get(), 1)
+
+
 class TestKVTable:
     def test_missing_keys_default(self, mesh8):
         t = KVTable(100, updater="default")
