@@ -1,0 +1,27 @@
+# Build/CI entry points (SURVEY.md §2 L9: the reference ships CMake +
+# Travis; this is the TPU build's single-command analog).
+#
+#   make test     - full suite on the 8-virtual-CPU-device mesh
+#   make dryrun   - multi-chip sharding compile/execute check (8 devices)
+#   make bench    - driver benchmark on the default devices (one JSON line)
+#   make native   - C++ data loader + baseline binaries
+#   make ci       - everything CI runs, in order
+
+PY ?= python
+
+.PHONY: test dryrun bench native ci
+
+test:
+	$(PY) -m pytest tests/ -q
+
+dryrun:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+bench:
+	$(PY) bench.py
+
+native:
+	$(MAKE) -C native
+
+ci: native test dryrun

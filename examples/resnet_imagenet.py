@@ -222,16 +222,54 @@ class ResNetTrainer:
         return hits / len(X)
 
 
+class BindingResNetTrainer(ResNetTrainer):
+    """The same trainer driven THROUGH the binding compat surface — the
+    reference multiverso-torch shape (SURVEY.md §3.5 Torch row, §4.4):
+    a local framework step updates local params, then
+    ``ParamManager.sync_all_param`` ships the delta since the last sync
+    through the ArrayTable handler and pulls the merged view back.
+    Workers never overwrite each other; concurrent updates merge
+    additively. (:class:`ResNetTrainer` is the fused TPU-native path —
+    this class demonstrates BASELINE config #5 through the binding.)
+    """
+
+    def __init__(self, arch: str = "tiny", num_classes: int = 10, *,
+                 learning_rate: float = 0.1, momentum: float = 0.9,
+                 sync_every: int = 1, mesh=None, seed: int = 0) -> None:
+        super().__init__(arch, num_classes, learning_rate=learning_rate,
+                         momentum=momentum, mesh=mesh, seed=seed)
+        from multiverso_tpu.bindings.jax_ext import ParamManager
+        self.pm = ParamManager(jax.tree.map(np.asarray, self.params),
+                               name="resnet_pm")
+        self._sync_every = max(sync_every, 1)
+        self._it = 0
+        self._replicated = NamedSharding(self.mesh, P())
+
+    def train_step(self, x: np.ndarray, y: np.ndarray,
+                   lr: float = None) -> jax.Array:
+        loss = super().train_step(x, y, lr)
+        self._it += 1
+        if self._it % self._sync_every == 0:
+            merged = self.pm.sync_all_param(self.params)
+            self.params = jax.device_put(merged, self._replicated)
+        return loss
+
+
 def main(argv=None) -> None:
     configure.define_string("arch", "tiny", "tiny | resnet18 | resnet50", overwrite=True)
     configure.define_int("steps", 50, "training steps", overwrite=True)
     configure.define_int("batch_size", 256, "global batch size", overwrite=True)
     configure.define_float("lr", 0.1, "learning rate", overwrite=True)
     configure.define_int("image_size", 32, "synthetic image size", overwrite=True)
+    configure.define_bool("binding", False,
+                          "train through the ParamManager compat surface",
+                          overwrite=True)
     core.init(argv)
     X, y = synthetic_imagenet(8192, size=configure.get_flag("image_size"))
-    trainer = ResNetTrainer(configure.get_flag("arch"),
-                            learning_rate=configure.get_flag("lr"))
+    cls = BindingResNetTrainer if configure.get_flag("binding") \
+        else ResNetTrainer
+    trainer = cls(configure.get_flag("arch"),
+                  learning_rate=configure.get_flag("lr"))
     losses = trainer.fit(X, y, steps=configure.get_flag("steps"),
                          batch_size=configure.get_flag("batch_size"))
     log.info("resnet %s: loss %.4f -> %.4f, accuracy %.4f",

@@ -319,7 +319,12 @@ class WordEmbedding:
         # recompile for one leftover call's worth of pairs
         self.w_in.wait()
         dt = time.perf_counter() - t0
-        words = self.corpus.num_tokens * c.epochs
+        # count the work actually dispatched: with total_steps (or a
+        # short corpus) the full-corpus token count would overstate
+        # throughput by corpus_batches/steps_run
+        pairs_done = call_no * c.steps_per_call * c.batch_size
+        est_ppt = (c.window + 1) if c.model == "skipgram" else 1.0
+        words = pairs_done / est_ppt
         dashboard.emit_metric("w2v.words_per_sec", words / dt, "words/s")
         self.loss_history = [float(l) for l in losses]
         final = float(np.mean(self.loss_history[-10:])) \

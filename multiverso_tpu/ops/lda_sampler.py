@@ -52,8 +52,11 @@ def _kernel(A_ref, W_ref, sinv_ref, zi_ref, msk_ref, u1_ref, u2_ref,
     def _():
         nkd_ref[:] = jnp.zeros_like(nkd_ref)
 
-    A = A_ref[:]                                   # [TB, C, 128] int32
-    W = W_ref[:]
+    # count rows may arrive int32, int16 (doc counts) or bf16 (stale
+    # word-count mirror): cast to f32 FIRST, subtract after — int counts
+    # here are < 2^24 so the cast is exact
+    A = A_ref[:].astype(jnp.float32)               # [TB, C, 128]
+    W = W_ref[:].astype(jnp.float32)
     zi = zi_ref[:]                                 # [TB, 1] int32
     one = msk_ref[:]                               # [TB, 1] int32
     kc = jax.lax.broadcasted_iota(jnp.int32, (tb, c, LANES), 1)
@@ -61,8 +64,8 @@ def _kernel(A_ref, W_ref, sinv_ref, zi_ref, msk_ref, u1_ref, u2_ref,
     kk = kc * LANES + kl                           # topic id per lane
     self_oh = ((kk == zi[:, :, None]) & (one[:, :, None] > 0))
     soh = self_oh.astype(jnp.int32)
-    Af = (A - soh).astype(jnp.float32)
-    Wf = (W - soh).astype(jnp.float32)
+    Af = A - soh.astype(jnp.float32)
+    Wf = W - soh.astype(jnp.float32)
     # 1/S precomputed outside (kills a [TB,C,128] divide on the VPU)
     probs = jnp.maximum((Af + alpha) * (Wf + beta), 0.0) * sinv_ref[:][None]
     # level 1: pick the 128-lane chunk by inverse CDF of chunk totals

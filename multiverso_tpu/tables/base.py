@@ -306,6 +306,10 @@ class Table:
                         f"table shape {self.logical_shape}")
         else:
             delta = self._pad(np.asarray(delta))
+        if self.storage_shape != self.padded_shape:
+            # re-tiled storage layouts (SparseMatrixTable tiled=True):
+            # same elements, physical tile-aligned shape
+            delta = delta.reshape(self.storage_shape)
         opt = self._resolve_option(option)
         self.param, self.state = self._apply(self.param, self.state,
                                              delta, opt)

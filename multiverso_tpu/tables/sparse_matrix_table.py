@@ -165,36 +165,8 @@ class SparseMatrixTable(MatrixTable):
             fn = self._topk_jits[k] = topk
         return fn
 
-    # -- whole-table Add (2-D logical contract over tiled storage) --------
-
-    def add(self, delta: Any, option: Optional[AddOption] = None,
-            sync: bool = False) -> Handle:
-        if not self.tiled:
-            return super().add(delta, option=option, sync=sync)
-        if isinstance(delta, jax.Array):
-            # keep device deltas on device (base Table.add parity): pad
-            # the logical region then retile — eager jnp, async dispatch
-            if delta.shape == self.logical_shape:
-                pad = [(0, p - l) for p, l in zip(self.padded_shape,
-                                                  delta.shape)]
-                delta = jnp.pad(delta, pad)
-            if delta.shape != self.padded_shape:
-                raise ValueError(
-                    f"table {self.name!r}: delta shape {delta.shape} != "
-                    f"table shape {self.logical_shape}")
-            delta = delta.reshape(self.storage_shape)
-        else:
-            delta = self._pad(np.asarray(delta)) \
-                .reshape(self.storage_shape)
-        opt = self._resolve_option(option)
-        self.param, self.state = self._apply(self.param, self.state,
-                                             delta, opt)
-        handle = Handle(table=self, generation=self._bump_step())
-        if sync:
-            handle.wait()
-        return handle
-
-    add_async = add
+    # (whole-table dense add comes from Table.add — the base class
+    # reshapes normalized deltas to storage_shape for tiled layouts)
 
     # -- COO sparse Add ----------------------------------------------------
 

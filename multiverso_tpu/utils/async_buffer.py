@@ -75,20 +75,26 @@ def prefetch_iterator(it: Iterable[T], depth: int = 2) -> Iterator[T]:
     _END = object()
     cancel = threading.Event()
 
+    def _put_cancellable(item) -> bool:
+        """Offer to the queue until accepted or the consumer cancels;
+        an unconditional blocking put would deadlock the producer thread
+        forever when the consumer stops draining with a full queue."""
+        while not cancel.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def work() -> None:
         try:
             for item in it:
-                while not cancel.is_set():
-                    try:
-                        q.put(item, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-                if cancel.is_set():
+                if not _put_cancellable(item):
                     return
-            q.put(_END)
+            _put_cancellable(_END)
         except BaseException as exc:
-            q.put(exc)
+            _put_cancellable(exc)
 
     thread = threading.Thread(target=work, daemon=True)
     thread.start()

@@ -55,6 +55,20 @@ def test_resnet_tiny_learns(mesh_dp8):
     assert trainer.accuracy(X, y) > 0.5      # 10 classes -> chance 0.1
 
 
+def test_resnet_through_binding_learns(mesh_dp8):
+    # BASELINE config #5 THROUGH the compat surface: local momentum step
+    # + ParamManager delta-sync per minibatch (the multiverso-torch shape)
+    X, y = resnet_imagenet.synthetic_imagenet(2048, size=16, seed=3)
+    trainer = resnet_imagenet.BindingResNetTrainer(
+        "tiny", learning_rate=0.05, sync_every=2, mesh=mesh_dp8, seed=3)
+    losses = trainer.fit(X, y, steps=60, batch_size=256, seed=3)
+    assert np.all(np.isfinite(losses))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
+    assert trainer.accuracy(X, y) > 0.5
+    # the sync path really went through the handler's table
+    assert trainer.pm._table._table.generation >= 60 // 2
+
+
 def test_resnet_archs_build():
     # resnet18/resnet50 params materialize with consistent shapes
     p18 = resnet_imagenet.init_resnet("resnet18")
