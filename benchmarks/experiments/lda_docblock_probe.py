@@ -1,0 +1,298 @@
+"""Doc-blocked LDA kernel probe: move the doc side INTO the kernel.
+
+Current stale-design step budget (B=500k): A gather ~10ms + ndk
+scatters ~14ms + W gather ~8ms + kernel ~13ms. The doc side costs 24ms
+because XLA treats every token independently; but tokens of one doc
+share one ndk row. Pack the (doc-sorted) stream into TB-token blocks
+that contain WHOLE docs only, give each block EXCLUSIVE ownership of a
+[MAXD, C, 128] slice of a re-laid-out ndk, and the kernel can:
+
+- materialize A rows by a one-hot matmul E @ ndk_block (MXU, cheap),
+- apply the block's count moves as E^T @ (oh_new - oh_old) added to the
+  VMEM-resident block (aliased in/out, disjoint windows -> no
+  pipelining hazard),
+
+deleting both the A gather and the ndk scatters from the XLA graph.
+Word counts stay sweep-stale bf16 (gathered by XLA, zipf-random).
+
+Semantics: identical approximation family (batch-stale within the
+block, in-register self-removal); doc rows are exact-live at block
+start because each doc's tokens live in exactly one block per sweep.
+
+Run: python benchmarks/experiments/lda_docblock_probe.py
+"""
+
+import sys, time, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from lda_superstep_variants import (V, D, T, K, ALPHA, BETA, VBETA,
+                                    make_data, init_counts)
+
+C = K // 128
+TB = 512           # tokens per block (1024 overflows VMEM)
+MAXD = 16          # max docs per block (packing enforces)
+B = 512_000        # tokens per superstep dispatch (TB * 500 blocks)
+
+
+def pack_stream(tw, td):
+    """Doc-sorted stream -> blocks of TB tokens, whole docs only,
+    <= MAXD docs per block. Returns (tw_p, td_p, drel_p, mask_p,
+    block_of_doc rows layout) with padding lanes masked."""
+    order = np.argsort(td, kind="stable")
+    tw, td = tw[order], td[order]
+    doc_ids, doc_starts = np.unique(td, return_index=True)
+    doc_ends = np.append(doc_starts[1:], len(td))
+    blocks = []          # list of (doc indices)
+    cur, cur_tokens = [], 0
+    for di, (s, e) in enumerate(zip(doc_starts, doc_ends)):
+        ln = e - s
+        if ln > TB:
+            raise ValueError("doc longer than TB")
+        if cur_tokens + ln > TB or len(cur) >= MAXD:
+            blocks.append(cur)
+            cur, cur_tokens = [], 0
+        cur.append(di)
+        cur_tokens += ln
+    if cur:
+        blocks.append(cur)
+    nb = len(blocks)
+    tw_p = np.zeros((nb, TB), np.int32)
+    drel_p = np.full((nb, TB), MAXD - 1, np.int32)  # pad -> last row
+    mask_p = np.zeros((nb, TB), np.int32)
+    zslot = np.full((nb, TB), -1, np.int64)  # original index per lane
+    for b, docs in enumerate(blocks):
+        off = 0
+        for r, di in enumerate(docs):
+            s, e = doc_starts[di], doc_ends[di]
+            ln = e - s
+            tw_p[b, off:off + ln] = tw[s:e]
+            drel_p[b, off:off + ln] = r
+            mask_p[b, off:off + ln] = 1
+            zslot[b, off:off + ln] = np.arange(s, e)
+            off += ln
+    # doc -> (block, row) for building the blocked ndk
+    row_of_doc = np.zeros(len(doc_ids), np.int64)
+    blk_of_doc = np.zeros(len(doc_ids), np.int64)
+    for b, docs in enumerate(blocks):
+        for r, di in enumerate(docs):
+            blk_of_doc[di] = b
+            row_of_doc[di] = r
+    fill = np.asarray([len(dcs) for dcs in blocks])
+    print(f"packed: {nb} blocks, fill tokens="
+          f"{mask_p.sum()/nb/TB:.2%}, docs/block mean={fill.mean():.1f} "
+          f"max={fill.max()}")
+    return (tw_p, drel_p, mask_p, zslot, blk_of_doc, row_of_doc, td[order
+            ], order)
+
+
+def kernel(ndk_ref, W_ref, sinv_ref, zi_ref, drel_ref, msk_ref, u1_ref,
+           u2_ref, ndk_out_ref, znew_ref, nkd_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        nkd_ref[:] = jnp.zeros_like(nkd_ref)
+
+    ndk = ndk_ref[0].reshape(MAXD, K).astype(jnp.float32)   # [MAXD, K]
+    W = W_ref[:].astype(jnp.float32)                        # [TB, C, 128]
+    zi = zi_ref[:]                                          # [TB, 1]
+    drel = drel_ref[:]                                      # [TB, 1]
+    one = msk_ref[:]                                        # [TB, 1]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (TB, MAXD), 1)
+    E = (rows == drel).astype(jnp.float32)                  # [TB, MAXD]
+    A = jnp.dot(E, ndk, preferred_element_type=jnp.float32)  # [TB, K]
+    A3 = A.reshape(TB, C, 128)
+    kc = jax.lax.broadcasted_iota(jnp.int32, (TB, C, 128), 1)
+    kl = jax.lax.broadcasted_iota(jnp.int32, (TB, C, 128), 2)
+    kk = kc * 128 + kl
+    self_oh = ((kk == zi[:, :, None]) & (one[:, :, None] > 0))
+    sohf = self_oh.astype(jnp.float32)
+    Af = A3 - sohf
+    Wf = W - sohf
+    probs = jnp.maximum((Af + ALPHA) * (Wf + BETA), 0.0) * sinv_ref[:][None]
+    cs = probs.sum(-1)
+    ci = jax.lax.broadcasted_iota(jnp.int32, (C, C), 0)
+    cj = jax.lax.broadcasted_iota(jnp.int32, (C, C), 1)
+    tric = (ci <= cj).astype(jnp.float32)
+    ccdf = jnp.dot(cs, tric, preferred_element_type=jnp.float32)
+    t1 = u1_ref[:] * ccdf[:, -1:]
+    selc = jnp.minimum((ccdf < t1).sum(1), C - 1).astype(jnp.int32)
+    csel = (kc[:, :, 0] == selc[:, None])
+    sub = (probs * csel[:, :, None]).sum(1)
+    li = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0)
+    lj = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 1)
+    tril = (li <= lj).astype(jnp.float32)
+    scdf = jnp.dot(sub, tril, preferred_element_type=jnp.float32)
+    t2 = u2_ref[:] * scdf[:, -1:]
+    lane = jnp.minimum((scdf < t2).sum(1), 127).astype(jnp.int32)
+    zn = selc * 128 + lane
+    znew = jnp.where(one[:, 0] > 0, zn, zi[:, 0])
+    znew_ref[:] = znew[:, None]
+    new_oh = ((kk == znew[:, None, None]) & (one[:, :, None] > 0))
+    ohdiff = (new_oh.astype(jnp.float32) - sohf)            # [TB, C, 128]
+    nkd_ref[:] += ohdiff.sum(0).astype(jnp.int32)
+    od2 = ohdiff.reshape(TB, K)
+    delta = jnp.dot(E.T, od2, preferred_element_type=jnp.float32)
+    ndk_out_ref[0] = (ndk + delta).astype(jnp.int16).reshape(
+        MAXD, C, 128)
+
+
+def make_step(nb_step):
+    grid_spec = pl.GridSpec(
+        grid=(nb_step,),
+        in_specs=[
+            pl.BlockSpec((1, MAXD, C, 128), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TB, C, 128), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, 128), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TB, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TB, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TB, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TB, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TB, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, MAXD, C, 128), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TB, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, 128), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+    )
+
+    def call(ndk_blk, W3, sinv, zi, drel, msk, u1, u2):
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct(ndk_blk.shape, jnp.int16),
+                jax.ShapeDtypeStruct((nb_step * TB, 1), jnp.int32),
+                jax.ShapeDtypeStruct((C, 128), jnp.int32),
+            ],
+            input_output_aliases={0: 0},
+        )(ndk_blk, W3, sinv, zi, drel, msk, u1, u2)
+
+    return call
+
+
+def main(sweeps=2):
+    tw0, td0, z0 = make_data()
+    (tw_p, drel_p, mask_p, zslot, blk_of_doc, row_of_doc, td_sorted,
+     order) = pack_stream(tw0, td0)
+    nb = tw_p.shape[0]
+    nb_step = B // TB
+    n_calls = -(-nb // nb_step)
+    nb_pad = n_calls * nb_step
+    # pad whole blocks (masked)
+    def padb(a, fill=0):
+        out = np.full((nb_pad,) + a.shape[1:], fill, a.dtype)
+        out[:nb] = a
+        return out
+    tw_p, drel_p, mask_p = padb(tw_p), padb(drel_p, MAXD - 1), padb(mask_p)
+
+    # z in packed order
+    z_p = np.zeros((nb_pad, TB), np.int32)
+    z_flat = z0[order]
+    pos = 0
+    for b in range(nb):
+        m = mask_p[b].astype(bool)
+        n_tok = m.sum()
+        z_p[b, m] = z_flat[pos:pos + n_tok]
+        pos += n_tok
+
+    # blocked ndk
+    ndk_blk = np.zeros((nb_pad, MAXD, C, 128), np.int16)
+    nwk0, _, nk0 = init_counts(tw0, td0, z0)
+    # build from packed stream directly
+    for b in range(nb):
+        m = mask_p[b].astype(bool)
+        np.add.at(ndk_blk[b].reshape(MAXD, K),
+                  (drel_p[b][m], z_p[b][m]), 1)
+    nwk = jnp.asarray(nwk0.reshape(V + 1, C, 128))
+    nk = jnp.asarray(nk0)
+
+    ndk_d = jnp.asarray(ndk_blk)
+    z_d = jnp.asarray(z_p)
+    tw_d = jnp.asarray(tw_p)
+    drel_d = jnp.asarray(drel_p)
+    msk_d = jnp.asarray(mask_p)
+    key = jax.random.PRNGKey(0)
+
+    pcall = make_step(nb_step)
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    def step(ndk_all, nk, z_all, wstale, call_no, key):
+        sl = lambda a: lax.dynamic_slice_in_dim(a, call_no * nb_step,
+                                                nb_step)
+        ndk_c = sl(ndk_all)
+        zi = sl(z_all).reshape(nb_step * TB, 1)
+        w = sl(tw_d).reshape(-1)
+        W3 = jnp.take(wstale, w, axis=0)
+        drel = sl(drel_d).reshape(-1, 1)
+        msk = sl(msk_d).reshape(-1, 1)
+        sinv = 1.0 / (nk.astype(jnp.float32).reshape(C, 128) + VBETA)
+        k1, k2 = jax.random.split(key)
+        u1 = jax.random.uniform(k1, (nb_step * TB, 1))
+        u2 = jax.random.uniform(k2, (nb_step * TB, 1))
+        ndk_c, znew, nkd = pcall(ndk_c, W3, sinv, zi, drel, msk, u1, u2)
+        ndk_all = lax.dynamic_update_slice_in_dim(
+            ndk_all, ndk_c, call_no * nb_step, 0)
+        z_all = lax.dynamic_update_slice_in_dim(
+            z_all, znew.reshape(nb_step, TB), call_no * nb_step, 0)
+        nk = nk + nkd.reshape(-1)
+        return ndk_all, nk, z_all
+
+    @jax.jit
+    def rebuild(z_all):
+        nwk = jnp.zeros((V + 1, C, 128), jnp.int32)
+        tw = tw_d.reshape(-1)
+        z = z_all.reshape(-1)
+        m = msk_d.reshape(-1)
+        return nwk.at[tw, z // 128, z % 128].add(m)
+
+    @jax.jit
+    def to_stale(nwk):
+        return nwk.astype(jnp.bfloat16)
+
+    def sweep(ndk_d, nk, z_d, nwk, base):
+        wstale = to_stale(nwk)
+        for i in range(n_calls):
+            k = jax.random.fold_in(key, base + i)
+            ndk_d, nk, z_d = step(ndk_d, nk, z_d, wstale, i, k)
+        nwk = rebuild(z_d)
+        return ndk_d, nk, z_d, nwk
+
+    ndk_d, nk, z_d, nwk = sweep(ndk_d, nk, z_d, nwk, 0)
+    tot = int(np.asarray(nk).sum())
+    print(f"warm: nk_total={tot} (expect {T})")
+    t0 = time.perf_counter()
+    for s in range(sweeps):
+        ndk_d, nk, z_d, nwk = sweep(ndk_d, nk, z_d, nwk, (s + 1) * n_calls)
+    tot = int(np.asarray(nk).sum())
+    dt = time.perf_counter() - t0
+    nk2 = np.asarray(nwk)[:V].reshape(V, K).sum(0)
+    ok = bool(np.array_equal(nk2, np.asarray(nk)))
+    eff = T * sweeps / dt
+    print(f"docblock  {eff/1e6:8.2f}M tok/s  ({dt:.3f}s/{sweeps} sweeps) "
+          f" nk_total={tot} master_ok={ok}")
+
+
+if __name__ == "__main__":
+    main()

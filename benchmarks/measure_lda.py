@@ -59,10 +59,13 @@ def measure_tpu(sampler: str = "tiled") -> dict:
     tw = rng.choice(V, T, p=p).astype(np.int32)
     td = np.sort(rng.integers(0, D, T)).astype(np.int32)
     core.init()
-    app = LightLDA(tw, td, V, LDAConfig(num_topics=K_TPU,
-                                        batch_tokens=BATCH,
-                                        steps_per_call=1, seed=1,
-                                        sampler=sampler))
+    tiled = sampler == "tiled"
+    app = LightLDA(tw, td, V, LDAConfig(
+        num_topics=K_TPU,
+        # doc-blocked batches must be a block_tokens multiple
+        batch_tokens=512_000 if tiled else BATCH,
+        steps_per_call=1, seed=1, sampler=sampler,
+        stale_words=tiled, doc_blocked=tiled))
     app.sweep()                                   # compile + first sweep
 
     def sync():
@@ -72,8 +75,14 @@ def measure_tpu(sampler: str = "tiled") -> dict:
     app.sweep()
     sync()
     dt = time.perf_counter() - t0
+    cfg = app.config
     return {"doc_tokens_per_sec": T / dt, "secs": dt, "topics": K_TPU,
-            "batch_tokens": BATCH, "sampler": sampler,
+            # record the MEASURED configuration, not the defaults
+            "batch_tokens": cfg.batch_tokens, "sampler": cfg.sampler,
+            "stale_words": cfg.stale_words,
+            "doc_blocked": cfg.doc_blocked,
+            "block_tokens": cfg.block_tokens,
+            "block_docs": cfg.block_docs,
             "loglik_after": app.loglik()}
 
 

@@ -65,6 +65,18 @@ class LDAConfig:
     num_iterations: int = 10        # full Gibbs sweeps
     sampler: str = "gibbs"          # "gibbs" (exact O(K)) | "mh" (O(1))
     #                               | "tiled" (pallas kernel, K%128==0)
+    stale_words: bool = False       # tiled only: word counts gathered
+    # from a bf16 mirror refreshed per sweep (the reference's own model:
+    # word-topic rows fetched per slice, updates pushed at block end);
+    # deletes the per-step word-count scatters, int32 master rebuilt
+    # from z each sweep. Doc counts go int16 (doc len < 32k enforced).
+    doc_blocked: bool = False       # tiled only (implies stale_words):
+    # doc-sorted stream packed into whole-doc kernel blocks that own an
+    # exclusive slice of the blocked doc-topic counts — the doc side
+    # (A-row gather + doc-count scatters) moves INTO the pallas kernel
+    # (VMEM matmuls), the fastest sampler (see benchmarks/README.md)
+    block_tokens: int = 512         # doc_blocked: tokens per kernel block
+    block_docs: int = 16            # doc_blocked: max docs per block
     mh_steps: int = 2               # MH: rounds of (word + doc) proposal
     precision: str = "float32"      # posterior/CDF math dtype; bfloat16
     # is measured equal-speed at large batches (the op mix is not
@@ -135,6 +147,10 @@ class LightLDA:
         if tiled and self.K % 128:
             raise ValueError(f"sampler='tiled' needs num_topics % 128 "
                              f"== 0, got {self.K}")
+        if (c.stale_words or c.doc_blocked) and not tiled:
+            raise ValueError(
+                f"stale_words/doc_blocked are sampler='tiled' modes; "
+                f"got sampler={c.sampler!r}")
         # the pallas kernel needs the Mosaic TPU backend; on a CPU mesh
         # (tests) it runs in interpreter mode
         self._interpret = tiled and \
@@ -152,9 +168,32 @@ class LightLDA:
         # worker-local doc-topic counts (+1 scratch doc for padded lanes);
         # placed on the mesh, NOT the default device (platform may differ)
         self._scratch_doc = self.num_docs
+        self._docblock = tiled and c.doc_blocked
+        # doc_blocked construction IS the stale-words model (no per-step
+        # word scatters; master rebuilt from z per sweep)
+        self._stale = tiled and (c.stale_words or c.doc_blocked)
+        ndk_dtype = np.int32
+        if self._stale:
+            max_len = int(np.bincount(token_docs).max()) \
+                if len(token_docs) else 0
+            if max_len >= 32767:
+                raise ValueError(
+                    f"stale_words stores doc counts int16; a document "
+                    f"has {max_len} tokens (>= 32767)")
+            ndk_dtype = np.int16
+        if self._docblock:
+            # blocked layout replaces the dense [D+1, K] doc counts and
+            # the permuted-stream staging entirely
+            self._setup_docblock(token_words, token_docs, ndk_dtype)
+            self._build_docblock_superstep()
+            self._key = core.prng_key(c.seed, mesh=self.mesh)
+            self._calls_done = 0
+            self.ll_history = []
+            return
+
         ndk_shape = (self.num_docs + 1, self.K // 128, 128) if tiled \
             else (self.num_docs + 1, self.K)
-        self._ndk = core.place(np.zeros(ndk_shape, np.int32),
+        self._ndk = core.place(np.zeros(ndk_shape, ndk_dtype),
                                mesh=self.mesh)
 
         # token stream, padded to a whole number of superstep calls
@@ -241,31 +280,240 @@ class LightLDA:
         self._calls_done = 0
         self.ll_history: list = []
 
+    # -- doc-blocked stream / state ---------------------------------------
+
+    def _setup_docblock(self, token_words, token_docs, ndk_dtype) -> None:
+        """Pack the doc-sorted stream into whole-doc kernel blocks and
+        build the blocked doc-topic counts (see LDAConfig.doc_blocked)."""
+        c = self.config
+        TB, MAXD = c.block_tokens, c.block_docs
+        B, S = c.batch_tokens, c.steps_per_call
+        if TB % 8 or B % TB:
+            raise ValueError(f"block_tokens {TB} must be a multiple of 8 "
+                             f"dividing batch_tokens {B}")
+        order = np.argsort(token_docs, kind="stable")
+        tw, td = token_words[order], token_docs[order]
+        doc_ids, doc_starts = np.unique(td, return_index=True) \
+            if len(td) else (np.zeros(0, np.int64), np.zeros(0, np.int64))
+        doc_ends = np.append(doc_starts[1:], len(td))
+        lens = doc_ends - doc_starts
+        if len(lens) and lens.max() > TB:
+            raise ValueError(f"a document has {lens.max()} tokens > "
+                             f"block_tokens {TB}")
+        blocks, cur, cur_tok = [], [], 0
+        for di in range(len(doc_ids)):
+            ln = int(lens[di])
+            if cur_tok + ln > TB or len(cur) >= MAXD:
+                blocks.append(cur)
+                cur, cur_tok = [], 0
+            cur.append(di)
+            cur_tok += ln
+        if cur:
+            blocks.append(cur)
+        if not blocks:
+            blocks = [[]]
+        nbs = B // TB                       # blocks per scan step
+        per_call = S * nbs
+        n_calls = -(-len(blocks) // per_call)
+        nb_pad = n_calls * per_call
+        self.calls_per_sweep = n_calls
+        self._nb_pad, self._tb, self._maxd = nb_pad, TB, MAXD
+
+        tw_p = np.full((nb_pad, TB), self._scratch_word, np.int32)
+        drel_p = np.full((nb_pad, TB), MAXD - 1, np.int32)
+        mask_p = np.zeros((nb_pad, TB), np.int32)
+        # -1 = document with zero tokens (never packed into any block);
+        # doc_topics()/store() must yield zero rows for those, not some
+        # other document's counts
+        self._blk_of_doc = np.full(self.num_docs, -1, np.int64)
+        self._row_of_doc = np.full(self.num_docs, -1, np.int64)
+        for b, docs in enumerate(blocks):
+            off = 0
+            for r, di in enumerate(docs):
+                s, e = int(doc_starts[di]), int(doc_ends[di])
+                ln = e - s
+                tw_p[b, off:off + ln] = tw[s:e]
+                drel_p[b, off:off + ln] = r
+                mask_p[b, off:off + ln] = 1
+                self._blk_of_doc[doc_ids[di]] = b
+                self._row_of_doc[doc_ids[di]] = r
+                off += ln
+        fill = mask_p.sum() / max(nb_pad * TB, 1)
+        log.info("lda doc_blocked: %d blocks (%d/call, %.0f%% fill)",
+                 nb_pad, per_call, 100 * fill)
+
+        # per-call staging: [S, B] lanes + per-step block offsets
+        spec = P(None, core.DATA_AXIS)
+        rows_flat = (np.arange(nb_pad)[:, None] * MAXD
+                     + drel_p).astype(np.int32)       # loglik gather rows
+        self._calls = []
+        for call in range(n_calls):
+            lo = call * per_call
+            sl = slice(lo, lo + per_call)
+            shp = (S, B)
+            self._calls.append((
+                self._place(tw_p[sl].reshape(shp), spec),
+                self._place(drel_p[sl].reshape(shp), spec),
+                self._place(rows_flat[sl].reshape(shp), spec),
+                self._place(mask_p[sl].reshape(shp).astype(np.int32),
+                            spec),
+                self._place(np.arange(lo, lo + per_call, nbs,
+                                      dtype=np.int32), P())))
+
+        # full flat stream for the per-sweep word-count rebuild
+        self._tw_flat = self._place(tw_p.reshape(-1), P())
+        self._mask_flat = self._place(mask_p.reshape(-1), P())
+
+        # random init z + counts (blocked ndk built by flat-row scatter)
+        rng = np.random.default_rng(c.seed)
+        z0 = rng.integers(0, self.K, (nb_pad, TB)).astype(np.int32)
+        self._z = self._place(z0, P())
+        drel_dev = self._place(drel_p, P())
+        tiles = self.K // 128
+
+        @jax.jit
+        def build(z, tw_flat, m_flat, drel):
+            zf = z.reshape(-1)
+            nwk = jnp.zeros(self.word_topic.storage_shape, jnp.int32)
+            nwk = nwk.at[tw_flat, zf // 128, zf % 128].add(m_flat)
+            rows = (jnp.arange(nb_pad)[:, None] * MAXD + drel).reshape(-1)
+            ndk = jnp.zeros((nb_pad * MAXD, tiles, 128), ndk_dtype)
+            ndk = ndk.at[rows, zf // 128, zf % 128].add(
+                m_flat.astype(ndk_dtype))
+            nk = jnp.zeros(self.summary.padded_shape, jnp.int32)
+            nk = nk.at[zf].add(m_flat)
+            return nwk, ndk.reshape(nb_pad, MAXD, tiles, 128), nk
+
+        nwk, ndk, nk = build(self._z, self._tw_flat, self._mask_flat,
+                             drel_dev)
+        self.word_topic.put_raw(nwk)
+        self._ndk = ndk
+        self.summary.put_raw(nk)
+
+    def _build_stale_helpers(self) -> None:
+        """Per-sweep word-count helpers shared by the stale modes: the
+        bf16 gather mirror and the int32 master rebuild from z (z may be
+        the flat stream or the blocked packing — flattened either way)."""
+
+        @jax.jit
+        def to_stale(nwk3):
+            return nwk3.astype(jnp.bfloat16)
+
+        @jax.jit
+        def rebuild(z, tw, m):
+            zf = z.reshape(-1)
+            nwk3 = jnp.zeros(self.word_topic.storage_shape, jnp.int32)
+            return nwk3.at[tw, zf // 128, zf % 128].add(m)
+
+        self._to_stale = to_stale
+        self._rebuild = rebuild
+
+    def _build_blocked_loglik(self) -> None:
+        """Eval over tile-aligned doc counts, shared by tiled and
+        doc-blocked layouts: ``rows`` index the flattened [*, C, 128]
+        doc-count storage (plain doc ids for the dense layout, packed
+        block rows for doc_blocked)."""
+        alpha, beta = self.alpha, self.beta
+        K = self.K
+        vbeta = self.V * beta
+        tiles = K // 128
+
+        @jax.jit
+        def loglik(nwk3, ndk, nk, ws, rows, mask):
+            ws, rows = ws.reshape(-1), rows.reshape(-1)
+            m = mask.reshape(-1).astype(jnp.float32)
+            n = ws.shape[0]
+            ndk_flat = ndk.reshape(-1, tiles, 128)
+            A = jnp.take(ndk_flat, rows, axis=0).reshape(n, K) \
+                .astype(jnp.float32)
+            W = jnp.take(nwk3, ws, axis=0).reshape(n, K) \
+                .astype(jnp.float32)
+            S = nk[:K].astype(jnp.float32)
+            return _predictive_ll(A, W, S, m, alpha, beta, K, vbeta)
+
+        self._loglik = loglik
+
+    def _build_docblock_superstep(self) -> None:
+        c = self.config
+        alpha, beta = self.alpha, self.beta
+        vbeta = self.V * beta
+        K = self.K
+        B = c.batch_tokens
+        TB = self._tb
+        nbs = B // TB
+        tiles = K // 128
+        interpret = self._interpret
+        from multiverso_tpu.ops import gibbs_sample_docblock
+
+        def scan_body(wstale, carry, inp):
+            nk, ndk, z = carry
+            w, drel, _rows, msk, off, key = inp
+            ndk_c = lax.dynamic_slice_in_dim(ndk, off, nbs)
+            zi = lax.dynamic_slice_in_dim(z, off, nbs).reshape(B)
+            W3 = jnp.take(wstale, w.reshape(B), axis=0)
+            sinv = 1.0 / (nk[:K].astype(jnp.float32).reshape(tiles, 128)
+                          + vbeta)
+            k1, k2 = jax.random.split(key)
+            u1 = jax.random.uniform(k1, (B,))
+            u2 = jax.random.uniform(k2, (B,))
+            ndk_c, znew, nkd = gibbs_sample_docblock(
+                ndk_c, W3, sinv, zi, drel.reshape(B), msk.reshape(B),
+                u1, u2, alpha=alpha, beta=beta, tb=TB,
+                interpret=interpret)
+            ndk = lax.dynamic_update_slice_in_dim(ndk, ndk_c, off, 0)
+            z = lax.dynamic_update_slice_in_dim(
+                z, znew.reshape(nbs, TB), off, 0)
+            nk = nk.at[:K].add(nkd.reshape(-1))
+            return (nk, ndk, z), ()
+
+        def body(params, states, locals_, options, wstale, ws, drels,
+                 rows, msks, offs, key):
+            (nk,) = params
+            ndk, z = locals_
+            keys = jax.random.split(key, ws.shape[0])
+            (nk, ndk, z), _ = lax.scan(
+                lambda cy, inp: scan_body(wstale, cy, inp),
+                (nk, ndk, z), (ws, drels, rows, msks, offs, keys))
+            return (nk,), states, (ndk, z), None
+
+        self._fused = make_superstep((self.summary,), body,
+                                     name="lda_docblock")
+
+        self._build_stale_helpers()
+        self._build_blocked_loglik()
+
     # -- count init --------------------------------------------------------
 
     def _init_counts(self) -> None:
         tiled = self.config.sampler == "tiled"
+        ndk_dtype = self._ndk.dtype
 
         @jax.jit
         def build(z, tw, td, m):
             nwk = jnp.zeros(self.word_topic.storage_shape, jnp.int32)
-            ndk = jnp.zeros(self._ndk.shape, jnp.int32)
+            ndk = jnp.zeros(self._ndk.shape, ndk_dtype)
             if tiled:
                 nwk = nwk.at[tw, z // 128, z % 128].add(m)
-                ndk = ndk.at[td, z // 128, z % 128].add(m)
+                ndk = ndk.at[td, z // 128, z % 128].add(
+                    m.astype(ndk_dtype))
             else:
                 nwk = nwk.at[tw, z].add(m)
-                ndk = ndk.at[td, z].add(m)
+                ndk = ndk.at[td, z].add(m.astype(ndk_dtype))
             nk = jnp.zeros(self.summary.padded_shape, jnp.int32)
             nk = nk.at[z].add(m)
             return nwk, ndk, nk
 
-        nwk, ndk, nk = build(self._z, self._place(self._tw, P()),
-                             self._place(self._td, P()),
-                             self._place(self._mask.astype(np.int32), P()))
+        tw_dev = self._place(self._tw, P())
+        m_dev = self._place(self._mask.astype(np.int32), P())
+        nwk, ndk, nk = build(self._z, tw_dev,
+                             self._place(self._td, P()), m_dev)
         self.word_topic.put_raw(nwk)
         self._ndk = ndk
         self.summary.put_raw(nk)
+        if self._stale:
+            # the per-sweep master rebuild scatters over the full stream
+            self._tw_dev = tw_dev
+            self._mask_dev = m_dev
 
     # -- the Gibbs superstep ----------------------------------------------
 
@@ -373,14 +621,14 @@ class LightLDA:
         B = c.batch_tokens
         tiles = K // 128
         interpret = self._interpret
+        stale = self._stale
         from multiverso_tpu.ops import gibbs_sample_tiled
 
-        def scan_body(carry, inp):
-            nwk3, nk, ndk3, z = carry
-            w, d, off, msk, key = inp
+        def sample_and_update(nk, ndk3, z, W3, w, d, off, msk, key):
+            """Shared step core: sample the slice, move doc/summary
+            counts. Returns (nk, ndk3, z, zi, znew)."""
             zi = lax.dynamic_slice_in_dim(z, off, B)
             A3 = jnp.take(ndk3, d, axis=0)              # [B, C, 128]
-            W3 = jnp.take(nwk3, w, axis=0)
             sinv = 1.0 / (nk[:K].astype(jnp.float32).reshape(tiles, 128)
                           + vbeta)
             k1, k2 = jax.random.split(key)
@@ -389,45 +637,66 @@ class LightLDA:
             znew, nkd = gibbs_sample_tiled(
                 A3, W3, sinv, zi, msk, u1, u2, alpha=alpha, beta=beta,
                 interpret=interpret)
-            one = msk
+            one = msk.astype(ndk3.dtype)
             cold, lold = zi // 128, zi % 128
             cnew, lnew = znew // 128, znew % 128
-            nwk3 = nwk3.at[w, cold, lold].add(-one)
-            nwk3 = nwk3.at[w, cnew, lnew].add(one)
             ndk3 = ndk3.at[d, cold, lold].add(-one)
             ndk3 = ndk3.at[d, cnew, lnew].add(one)
             nk = nk.at[:K].add(nkd.reshape(-1))
             z = lax.dynamic_update_slice_in_dim(z, znew, off, 0)
-            return (nwk3, nk, ndk3, z), ()
+            return nk, ndk3, z, zi, znew
 
-        def body(params, states, locals_, options, ws, ds, offs, msks,
-                 key):
-            nwk3, nk = params
-            ndk3, z = locals_
-            keys = jax.random.split(key, ws.shape[0])
-            (nwk3, nk, ndk3, z), _ = lax.scan(
-                scan_body, (nwk3, nk, ndk3, z),
-                (ws, ds, offs, msks, keys))
-            return (nwk3, nk), states, (ndk3, z), None
+        if stale:
+            # word rows from the per-sweep bf16 mirror; no per-step
+            # word-count scatters (master rebuilt from z at sweep end)
+            def scan_body(wstale, carry, inp):
+                nk, ndk3, z = carry
+                w, d, off, msk, key = inp
+                W3 = jnp.take(wstale, w, axis=0)
+                nk, ndk3, z, _, _ = sample_and_update(
+                    nk, ndk3, z, W3, w, d, off, msk, key)
+                return (nk, ndk3, z), ()
 
-        self._fused = make_superstep((self.word_topic, self.summary), body,
-                                     name="lda_tiled")
+            def body(params, states, locals_, options, wstale, ws, ds,
+                     offs, msks, key):
+                (nk,) = params
+                ndk3, z = locals_
+                keys = jax.random.split(key, ws.shape[0])
+                (nk, ndk3, z), _ = lax.scan(
+                    lambda cy, inp: scan_body(wstale, cy, inp),
+                    (nk, ndk3, z), (ws, ds, offs, msks, keys))
+                return (nk,), states, (ndk3, z), None
 
-        @jax.jit
-        def loglik(nwk3, ndk3, nk, ws, ds, mask):
-            # same eval as the flat sampler; only the gather layout
-            # differs (tiled rows reshaped back to 2-D)
-            ws, ds = ws.reshape(-1), ds.reshape(-1)
-            m = mask.reshape(-1).astype(jnp.float32)
-            n = ws.shape[0]
-            A = jnp.take(ndk3, ds, axis=0).reshape(n, K) \
-                .astype(jnp.float32)
-            W = jnp.take(nwk3, ws, axis=0).reshape(n, K) \
-                .astype(jnp.float32)
-            S = nk[:K].astype(jnp.float32)
-            return _predictive_ll(A, W, S, m, alpha, beta, K, vbeta)
+            self._fused = make_superstep((self.summary,), body,
+                                         name="lda_tiled_stale")
 
-        self._loglik = loglik
+            self._build_stale_helpers()
+        else:
+            def scan_body(carry, inp):
+                nwk3, nk, ndk3, z = carry
+                w, d, off, msk, key = inp
+                W3 = jnp.take(nwk3, w, axis=0)
+                nk, ndk3, z, zi, znew = sample_and_update(
+                    nk, ndk3, z, W3, w, d, off, msk, key)
+                one = msk
+                nwk3 = nwk3.at[w, zi // 128, zi % 128].add(-one)
+                nwk3 = nwk3.at[w, znew // 128, znew % 128].add(one)
+                return (nwk3, nk, ndk3, z), ()
+
+            def body(params, states, locals_, options, ws, ds, offs,
+                     msks, key):
+                nwk3, nk = params
+                ndk3, z = locals_
+                keys = jax.random.split(key, ws.shape[0])
+                (nwk3, nk, ndk3, z), _ = lax.scan(
+                    scan_body, (nwk3, nk, ndk3, z),
+                    (ws, ds, offs, msks, keys))
+                return (nwk3, nk), states, (ndk3, z), None
+
+            self._fused = make_superstep(
+                (self.word_topic, self.summary), body, name="lda_tiled")
+
+        self._build_blocked_loglik()
 
     def _build_mh_superstep(self) -> None:
         """The O(1)-per-token sampler, LightLDA's own sparsity insight
@@ -553,16 +822,32 @@ class LightLDA:
             # pre-sweep snapshot for the stale proposal density (the live
             # param buffer is donated by the first superstep call)
             nwk_stale = self.word_topic.raw() + 0
-        for ws, ds, idxs, msks in self._calls:
+        if self._stale:
+            wstale = self._to_stale(self.word_topic.raw())
+        for call in self._calls:
             key = jax.random.fold_in(self._key, self._calls_done)
             self._calls_done += 1
             if mh:
+                ws, ds, idxs, msks = call
                 (self._ndk, self._z), _ = self._fused_mh(
                     (self._ndk, self._z), wcdf, nwk_stale,
                     ws, ds, idxs, msks, key)
+            elif self._stale:
+                (self._ndk, self._z), _ = self._fused(
+                    (self._ndk, self._z), wstale, *call, key)
             else:
                 (self._ndk, self._z), _ = self._fused(
-                    (self._ndk, self._z), ws, ds, idxs, msks, key)
+                    (self._ndk, self._z), *call, key)
+        if self._stale:
+            # fold the sweep's moves into the int32 master (the
+            # reference's block-end Add of accumulated deltas)
+            if self._docblock:
+                nwk = self._rebuild(self._z, self._tw_flat,
+                                    self._mask_flat)
+            else:
+                nwk = self._rebuild(self._z, self._tw_dev,
+                                    self._mask_dev)
+            self.word_topic.put_raw(nwk)
 
     def train(self, num_iterations: Optional[int] = None) -> float:
         """Run Gibbs sweeps; returns the final per-token log-likelihood."""
@@ -589,14 +874,28 @@ class LightLDA:
         `Eval` role). Evaluates over the pre-placed device-resident call
         slices — the token stream is static, so no host re-upload."""
         total = 0.0
-        for ws, ds, _idxs, msks in self._calls:
+        for call in self._calls:
+            if self._docblock:
+                ws, _drels, rows, msks, _offs = call
+                args = (ws, rows, msks)
+            else:
+                ws, ds, _idxs, msks = call
+                args = (ws, ds, msks)
             total += float(self._loglik(
                 self.word_topic.raw(), self._ndk, self.summary.raw(),
-                ws, ds, msks))
+                *args))
         return total / max(self.num_tokens, 1)
 
     def doc_topics(self) -> np.ndarray:
         """[num_docs, K] doc-topic counts (worker-local state)."""
+        if self._docblock:
+            blocked = np.asarray(self._ndk)
+            out = np.zeros((self.num_docs, self.K), np.int32)
+            valid = self._blk_of_doc >= 0
+            out[valid] = blocked[self._blk_of_doc[valid],
+                                 self._row_of_doc[valid]].reshape(
+                int(valid.sum()), self.K)
+            return out
         return np.asarray(self._ndk[: self.num_docs]).reshape(
             self.num_docs, self.K)
 
@@ -613,16 +912,32 @@ class LightLDA:
         from multiverso_tpu.tables.base import savez_stream
         self.word_topic.store(f"{uri_prefix}.word_topic.npz")
         self.summary.store(f"{uri_prefix}.summary.npz")
-        savez_stream(f"{uri_prefix}.state.npz",
-                     {"magic": "multiverso_tpu.lda_state.v1",
-                      "num_tokens": self.num_tokens,
-                      "perm_seed": self.config.seed,
-                      "t_pad": int(self._z.shape[0]),
-                      "calls_done": self._calls_done},
-                     {"z": np.asarray(self._z),
-                      # layout-agnostic 2-D shape (tiled stores ndk 3-D)
-                      "ndk": np.asarray(self._ndk).reshape(
-                          self.num_docs + 1, self.K)})
+        if self._docblock:
+            # z is indexed in the packed block layout; ndk exports as the
+            # dense [D, K] logical counts
+            dense = np.zeros((self.num_docs + 1, self.K),
+                             np.dtype(self._ndk.dtype))
+            dense[:self.num_docs] = self.doc_topics()
+            z = np.asarray(self._z).reshape(-1)
+            layout = "docblock"
+        else:
+            dense = np.asarray(self._ndk).reshape(self.num_docs + 1,
+                                                  self.K)
+            z = np.asarray(self._z)
+            layout = "stream"
+        manifest = {"magic": "multiverso_tpu.lda_state.v1",
+                    "num_tokens": self.num_tokens,
+                    "perm_seed": self.config.seed,
+                    "t_pad": int(z.shape[0]),
+                    "layout": layout,
+                    "calls_done": self._calls_done}
+        if self._docblock:
+            # z indexing depends on the exact packing: equal padded
+            # lengths with different block geometry must not load
+            manifest["block_tokens"] = self.config.block_tokens
+            manifest["block_docs"] = self.config.block_docs
+        savez_stream(f"{uri_prefix}.state.npz", manifest,
+                     {"z": z, "ndk": dense})
 
     def load(self, uri_prefix: str) -> None:
         from multiverso_tpu.tables.base import loadz_stream
@@ -640,17 +955,47 @@ class LightLDA:
                 f"{manifest['perm_seed']}, app has seed "
                 f"{self.config.seed}: z is indexed in the seed-derived "
                 "stream permutation, so the seeds must match to resume")
-        # T_pad depends on batch_tokens * steps_per_call: a geometry
-        # mismatch would yield a wrong-length z whose out-of-range scatters
-        # silently corrupt counts (JAX clamps/drops OOB indices)
-        if len(data["z"]) != int(self._z.shape[0]):
+        my_layout = "docblock" if self._docblock else "stream"
+        ck_layout = manifest.get("layout", "stream")
+        if ck_layout != my_layout:
+            raise ValueError(
+                f"checkpoint z layout {ck_layout!r} != app layout "
+                f"{my_layout!r}: z indexing is layout-specific")
+        if self._docblock:
+            want = (self.config.block_tokens, self.config.block_docs)
+            got = (manifest.get("block_tokens"),
+                   manifest.get("block_docs"))
+            if got != want:
+                raise ValueError(
+                    f"checkpoint block geometry {got} != app {want}: "
+                    "z packing must match to resume")
+        # T_pad depends on batch_tokens * steps_per_call (and the block
+        # packing for doc_blocked): a geometry mismatch would yield a
+        # wrong-length z whose out-of-range scatters silently corrupt
+        # counts (JAX clamps/drops OOB indices)
+        if len(data["z"]) != int(np.prod(self._z.shape)):
             raise ValueError(
                 f"checkpoint z length {len(data['z'])} != app stream "
-                f"length {int(self._z.shape[0])}: batch_tokens/"
-                "steps_per_call must match the checkpointing run to resume")
-        self._z = self._place(np.asarray(data["z"]), P())
-        self._ndk = self._place(
-            np.asarray(data["ndk"]).reshape(self._ndk.shape), P())
+                f"length {int(np.prod(self._z.shape))}: batch/block "
+                "geometry must match the checkpointing run to resume")
+        self._z = self._place(
+            np.asarray(data["z"]).reshape(self._z.shape), P())
+        dense = np.asarray(data["ndk"])
+        if self._docblock:
+            blocked = np.zeros(self._ndk.shape,
+                               np.dtype(self._ndk.dtype)).reshape(
+                self._nb_pad * self._maxd, -1)
+            valid = self._blk_of_doc >= 0
+            rows = (self._blk_of_doc[valid] * self._maxd
+                    + self._row_of_doc[valid])
+            blocked[rows] = dense[:self.num_docs][valid].reshape(
+                int(valid.sum()), -1)
+            self._ndk = self._place(
+                blocked.reshape(self._ndk.shape), P())
+        else:
+            self._ndk = self._place(
+                dense.reshape(self._ndk.shape).astype(self._ndk.dtype),
+                P())
         # resume the RNG sequence where the checkpoint left off; replaying
         # consumed fold_in keys would correlate sweeps across the resume
         self._calls_done = int(manifest.get("calls_done", 0))

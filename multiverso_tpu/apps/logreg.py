@@ -31,6 +31,7 @@ from typing import Iterator, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from multiverso_tpu import core
@@ -45,6 +46,7 @@ class LogRegConfig:
     input_dim: int
     num_classes: int
     minibatch_size: int = 256
+    steps_per_call: int = 8         # minibatches per fused dispatch
     epochs: int = 1
     learning_rate: float = 0.1
     updater: str = "sgd"
@@ -221,6 +223,26 @@ class LogisticRegression:
         # donation/sharding/step-counting handled by the table layer
         self._fused = make_superstep((table,), body, name="logreg_step")
 
+        def body_scan(params, states, locals_, options, xs, ys):
+            # the scan-superstep treatment the other apps get: S
+            # minibatches per dispatch (one host round-trip, not S)
+            (param,), (state,), (opt,) = params, states, options
+
+            def sb(carry, inp):
+                param, state = carry
+                x, y = inp
+                loss, grad = jax.value_and_grad(self._loss)(param, x, y)
+                param, state = table.updater.apply(param, state, grad,
+                                                   opt)
+                return (param, state), loss
+
+            (param, state), losses = lax.scan(sb, (param, state),
+                                              (xs, ys))
+            return (param,), (state,), locals_, losses
+
+        self._fused_scan = make_superstep((table,), body_scan,
+                                          name="logreg_superstep")
+
         @jax.jit
         def predict(param, x):
             w, b = self._unflatten(param)
@@ -249,6 +271,20 @@ class LogisticRegression:
         ys = jax.device_put(y.astype(np.int32), self._data_sharding)
         return xs, ys
 
+    def _shard_scan(self, xs: np.ndarray, ys: np.ndarray):
+        """Place a stacked [S, B, ...] group, batch dim sharded over
+        "data" (full minibatches only — B is already a size multiple)."""
+        d = self.mesh.shape[core.DATA_AXIS]
+        if xs.shape[1] % d:
+            reps = np.arange(-xs.shape[1] % d) % xs.shape[1]
+            xs = np.concatenate([xs, xs[:, reps]], axis=1)
+            ys = np.concatenate([ys, ys[:, reps]], axis=1)
+        xd = jax.device_put(xs.astype(np.float32), NamedSharding(
+            self.mesh, P(None, core.DATA_AXIS, None)))
+        yd = jax.device_put(ys.astype(np.int32), NamedSharding(
+            self.mesh, P(None, core.DATA_AXIS)))
+        return xd, yd
+
     # -- training ----------------------------------------------------------
 
     def train_epoch(self, X: np.ndarray, y: np.ndarray,
@@ -260,8 +296,22 @@ class LogisticRegression:
             np.random.default_rng(shuffle_seed).shuffle(order)
         losses = []
         t0 = time.perf_counter()
-        for start in range(0, n, c.minibatch_size):
-            idx = order[start:start + c.minibatch_size]
+        # full minibatches group into scanned supersteps (S per dispatch);
+        # the trailing partial group falls back to single-step dispatches
+        starts = list(range(0, n, c.minibatch_size))
+        full = [s for s in starts if s + c.minibatch_size <= n]
+        tail = [s for s in starts if s + c.minibatch_size > n]
+        S = max(c.steps_per_call, 1)
+        for g in range(0, len(full) - len(full) % S, S):
+            grp = full[g:g + S]
+            xs = np.stack([X[order[s:s + c.minibatch_size]] for s in grp])
+            ys = np.stack([y[order[s:s + c.minibatch_size]] for s in grp])
+            xd, yd = self._shard_scan(xs, ys)
+            with dashboard.profile("logreg.superstep"):
+                _, lg = self._fused_scan((), xd, yd)
+            losses.extend(lg)
+        for s in full[len(full) - len(full) % S:] + tail:
+            idx = order[s:s + c.minibatch_size]
             xs, ys = self._shard_batch(X[idx], y[idx])
             with dashboard.profile("logreg.step"):
                 _, loss = self._fused((), xs, ys)

@@ -41,6 +41,42 @@ from jax.experimental.pallas import tpu as pltpu
 LANES = 128
 
 
+def _lane_iotas(tb: int, c: int):
+    kc = jax.lax.broadcasted_iota(jnp.int32, (tb, c, LANES), 1)
+    kl = jax.lax.broadcasted_iota(jnp.int32, (tb, c, LANES), 2)
+    return kc, kc * LANES + kl
+
+
+def _posterior(A, W, sinv, soh_f, alpha: float, beta: float):
+    """Collapsed posterior over the [C, 128] topic tile with in-register
+    own-token removal. A/W already f32 (int counts < 2^24: exact).
+    1/S is precomputed outside (kills a [TB,C,128] divide on the VPU)."""
+    return jnp.maximum((A - soh_f + alpha) * (W - soh_f + beta),
+                       0.0) * sinv[None]
+
+
+def _two_level_draw(probs, kc, u1, u2, tb: int, c: int):
+    """Two-level inverse-CDF draw: chunk totals then within-chunk lanes.
+    cumsum has no Pallas TPU lowering -- triangular matmuls (tiny on the
+    MXU) instead. Returns z [TB] int32."""
+    cs = probs.sum(-1)                             # [TB, C]
+    ci = jax.lax.broadcasted_iota(jnp.int32, (c, c), 0)
+    cj = jax.lax.broadcasted_iota(jnp.int32, (c, c), 1)
+    tric = (ci <= cj).astype(jnp.float32)          # [C, C]
+    ccdf = jnp.dot(cs, tric, preferred_element_type=jnp.float32)
+    t1 = u1 * ccdf[:, -1:]
+    sel_c = jnp.minimum((ccdf < t1).sum(1), c - 1).astype(jnp.int32)
+    csel = (kc[:, :, 0] == sel_c[:, None])         # [TB, C]
+    sub = (probs * csel[:, :, None]).sum(1)        # [TB, 128]
+    li = jax.lax.broadcasted_iota(jnp.int32, (LANES, LANES), 0)
+    lj = jax.lax.broadcasted_iota(jnp.int32, (LANES, LANES), 1)
+    tril = (li <= lj).astype(jnp.float32)
+    scdf = jnp.dot(sub, tril, preferred_element_type=jnp.float32)
+    t2 = u2 * scdf[:, -1:]
+    lane = jnp.minimum((scdf < t2).sum(1), LANES - 1).astype(jnp.int32)
+    return sel_c * LANES + lane
+
+
 def _kernel(A_ref, W_ref, sinv_ref, zi_ref, msk_ref, u1_ref, u2_ref,
             znew_ref, nkd_ref, *, alpha: float, beta: float, tb: int,
             c: int):
@@ -53,39 +89,18 @@ def _kernel(A_ref, W_ref, sinv_ref, zi_ref, msk_ref, u1_ref, u2_ref,
         nkd_ref[:] = jnp.zeros_like(nkd_ref)
 
     # count rows may arrive int32, int16 (doc counts) or bf16 (stale
-    # word-count mirror): cast to f32 FIRST, subtract after — int counts
+    # word-count mirror): cast to f32 FIRST, subtract after -- int counts
     # here are < 2^24 so the cast is exact
     A = A_ref[:].astype(jnp.float32)               # [TB, C, 128]
     W = W_ref[:].astype(jnp.float32)
     zi = zi_ref[:]                                 # [TB, 1] int32
     one = msk_ref[:]                               # [TB, 1] int32
-    kc = jax.lax.broadcasted_iota(jnp.int32, (tb, c, LANES), 1)
-    kl = jax.lax.broadcasted_iota(jnp.int32, (tb, c, LANES), 2)
-    kk = kc * LANES + kl                           # topic id per lane
+    kc, kk = _lane_iotas(tb, c)
     self_oh = ((kk == zi[:, :, None]) & (one[:, :, None] > 0))
     soh = self_oh.astype(jnp.int32)
-    Af = A - soh.astype(jnp.float32)
-    Wf = W - soh.astype(jnp.float32)
-    # 1/S precomputed outside (kills a [TB,C,128] divide on the VPU)
-    probs = jnp.maximum((Af + alpha) * (Wf + beta), 0.0) * sinv_ref[:][None]
-    # level 1: pick the 128-lane chunk by inverse CDF of chunk totals
-    cs = probs.sum(-1)                             # [TB, C]
-    ci = jax.lax.broadcasted_iota(jnp.int32, (c, c), 0)
-    cj = jax.lax.broadcasted_iota(jnp.int32, (c, c), 1)
-    tric = (ci <= cj).astype(jnp.float32)
-    ccdf = jnp.dot(cs, tric, preferred_element_type=jnp.float32)
-    t1 = u1_ref[:] * ccdf[:, -1:]
-    sel_c = jnp.minimum((ccdf < t1).sum(1), c - 1).astype(jnp.int32)
-    # level 2: pick the lane within the chosen chunk
-    csel = (kc[:, :, 0] == sel_c[:, None])         # [TB, C]
-    sub = (probs * csel[:, :, None]).sum(1)        # [TB, 128]
-    li = jax.lax.broadcasted_iota(jnp.int32, (LANES, LANES), 0)
-    lj = jax.lax.broadcasted_iota(jnp.int32, (LANES, LANES), 1)
-    tril = (li <= lj).astype(jnp.float32)
-    scdf = jnp.dot(sub, tril, preferred_element_type=jnp.float32)
-    t2 = u2_ref[:] * scdf[:, -1:]
-    lane = jnp.minimum((scdf < t2).sum(1), LANES - 1).astype(jnp.int32)
-    zn = sel_c * LANES + lane
+    probs = _posterior(A, W, sinv_ref[:], soh.astype(jnp.float32),
+                       alpha, beta)
+    zn = _two_level_draw(probs, kc, u1_ref[:], u2_ref[:], tb, c)
     znew = jnp.where(one[:, 0] > 0, zn, zi[:, 0])
     znew_ref[:] = znew[:, None]
     new_oh = ((kk == znew[:, None, None]) & (one[:, :, None] > 0))
@@ -165,3 +180,109 @@ def gibbs_sample_tiled(A3: jax.Array, W3: jax.Array, sinv: jax.Array,
         interpret=interpret,
     )(A3, W3, sinv, zi[:, None], msk[:, None], u1[:, None], u2[:, None])
     return znew2[:, 0], nkd
+
+
+# -- doc-blocked variant ---------------------------------------------------
+
+def _docblock_kernel(ndk_ref, W_ref, sinv_ref, zi_ref, drel_ref, msk_ref,
+                     u1_ref, u2_ref, ndk_out_ref, znew_ref, nkd_ref, *,
+                     alpha: float, beta: float, tb: int, c: int,
+                     maxd: int):
+    """One grid block = TB tokens of WHOLE documents owning an exclusive
+    [MAXD, C, 128] slice of the blocked doc-topic counts: A rows
+    materialize by a one-hot matmul against the VMEM-resident block and
+    the block's count moves apply in VMEM (E^T @ one-hot diff), so the
+    doc side never touches XLA gather/scatter at all."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        nkd_ref[:] = jnp.zeros_like(nkd_ref)
+
+    k = c * LANES
+    ndk = ndk_ref[0].reshape(maxd, k).astype(jnp.float32)
+    W = W_ref[:].astype(jnp.float32)               # [TB, C, 128]
+    zi = zi_ref[:]                                 # [TB, 1]
+    drel = drel_ref[:]                             # [TB, 1]
+    one = msk_ref[:]                               # [TB, 1]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (tb, maxd), 1)
+    E = (rows == drel).astype(jnp.float32)         # [TB, MAXD]
+    A = jnp.dot(E, ndk, preferred_element_type=jnp.float32)
+    A3 = A.reshape(tb, c, LANES)
+    kc, kk = _lane_iotas(tb, c)
+    self_oh = ((kk == zi[:, :, None]) & (one[:, :, None] > 0))
+    sohf = self_oh.astype(jnp.float32)
+    probs = _posterior(A3, W, sinv_ref[:], sohf, alpha, beta)
+    zn = _two_level_draw(probs, kc, u1_ref[:], u2_ref[:], tb, c)
+    znew = jnp.where(one[:, 0] > 0, zn, zi[:, 0])
+    znew_ref[:] = znew[:, None]
+    new_oh = ((kk == znew[:, None, None]) & (one[:, :, None] > 0))
+    ohdiff = new_oh.astype(jnp.float32) - sohf     # [TB, C, 128]
+    nkd_ref[:] += ohdiff.sum(0).astype(jnp.int32)
+    delta = jnp.dot(E.T, ohdiff.reshape(tb, k),
+                    preferred_element_type=jnp.float32)
+    ndk_out_ref[0] = (ndk + delta).astype(ndk_out_ref.dtype).reshape(
+        maxd, c, LANES)
+
+
+@functools.partial(jax.jit, static_argnames=("alpha", "beta", "tb",
+                                             "interpret"))
+def gibbs_sample_docblock(ndk_blk: jax.Array, W3: jax.Array,
+                          sinv: jax.Array, zi: jax.Array,
+                          drel: jax.Array, msk: jax.Array, u1: jax.Array,
+                          u2: jax.Array, *, alpha: float, beta: float,
+                          tb: int, interpret: bool = False):
+    """Doc-blocked fused sampler + doc-count update.
+
+    Args:
+      ndk_blk: [NB, MAXD, C, 128] int16/int32 — blocked doc-topic counts;
+        block b EXCLUSIVELY owns its MAXD rows (whole docs per block).
+      W3:   [NB*TB, C, 128] — gathered (stale) word-count rows.
+      sinv: [C, 128] f32 — 1 / (summary + V*beta).
+      zi, drel, msk, u1, u2: [NB*TB] — current topics, doc row within
+        block, token mask, uniforms.
+      tb: tokens per block (static; NB*TB must equal len(zi)).
+
+    Returns (ndk_blk', znew [NB*TB], nk_delta [C, 128]); ndk_blk is
+    donated/aliased in place.
+    """
+    nb, maxd, c, lanes = ndk_blk.shape
+    if lanes != LANES:
+        raise ValueError(f"last dim must be {LANES}, got {lanes}")
+    b = zi.shape[0]
+    if b != nb * tb:
+        raise ValueError(f"token count {b} != blocks {nb} * tb {tb}")
+    kern = functools.partial(_docblock_kernel, alpha=float(alpha),
+                             beta=float(beta), tb=tb, c=c, maxd=maxd)
+    tok_spec = pl.BlockSpec((tb, 1), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    grid_spec = pl.GridSpec(
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((1, maxd, c, LANES), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, c, LANES), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((c, LANES), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            tok_spec, tok_spec, tok_spec, tok_spec, tok_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, maxd, c, LANES), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            tok_spec,
+            pl.BlockSpec((c, LANES), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+    )
+    ndk_out, znew2, nkd = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(ndk_blk.shape, ndk_blk.dtype),
+                   jax.ShapeDtypeStruct((b, 1), jnp.int32),
+                   jax.ShapeDtypeStruct((c, LANES), jnp.int32)],
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )(ndk_blk, W3, sinv, zi[:, None], drel[:, None], msk[:, None],
+      u1[:, None], u2[:, None])
+    return ndk_out, znew2[:, 0], nkd

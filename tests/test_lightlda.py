@@ -152,6 +152,100 @@ def test_tiled_sampler_invariants_and_quality(mesh_dp8, docs):
     assert np.all(np.isfinite(app.ll_history))
 
 
+def test_tiled_stale_words_invariants_and_quality(mesh_dp8, docs):
+    """stale_words mode (per-sweep bf16 word mirror + int16 doc counts +
+    master rebuild from z) must preserve the count invariants at sweep
+    boundaries and still converge — this is the reference's own staleness
+    model (word rows fetched per slice, updates pushed at block end)."""
+    tw, td, V = docs
+    app = LightLDA(tw, td, V,
+                   LDAConfig(num_topics=128, batch_tokens=512,
+                             steps_per_call=4, seed=1, sampler="tiled",
+                             stale_words=True),
+                   mesh=mesh_dp8, name="lda_stale")
+    app.train(num_iterations=8)
+    nwk = app.word_topics()
+    nk = np.asarray(app.summary.get())
+    ndk = app.doc_topics()
+    assert nwk.sum() == app.num_tokens
+    assert np.array_equal(nk[: app.K], nwk.sum(0))
+    assert np.array_equal(ndk.sum(1),
+                          np.bincount(td, minlength=app.num_docs))
+    assert (nwk >= 0).all() and (ndk >= 0).all() and (nk >= 0).all()
+    assert app.ll_history[-1] > app.ll_history[0] + 0.1
+    # absolute quality: near the exact-Gibbs level on this corpus
+    assert app.ll_history[-1] > -4.9, app.ll_history
+
+
+def test_docblock_sampler_invariants_and_quality(mesh_dp8, docs):
+    """doc_blocked: whole-doc kernel blocks own exclusive doc-count
+    slices; all invariants must hold at sweep boundaries and mixing must
+    stay near the exact-Gibbs level."""
+    tw, td, V = docs
+    app = LightLDA(tw, td, V,
+                   LDAConfig(num_topics=128, batch_tokens=1024,
+                             steps_per_call=2, seed=1, sampler="tiled",
+                             doc_blocked=True, block_tokens=256,
+                             block_docs=8),
+                   mesh=mesh_dp8, name="lda_db")
+    app.train(num_iterations=8)
+    nwk = app.word_topics()
+    nk = np.asarray(app.summary.get())
+    ndk = app.doc_topics()
+    assert nwk.sum() == app.num_tokens
+    assert np.array_equal(nk[: app.K], nwk.sum(0))
+    assert np.array_equal(ndk.sum(1),
+                          np.bincount(td, minlength=app.num_docs))
+    assert (nwk >= 0).all() and (ndk >= 0).all() and (nk >= 0).all()
+    assert app.ll_history[-1] > app.ll_history[0] + 0.1
+    assert app.ll_history[-1] > -4.9, app.ll_history
+
+
+def test_docblock_checkpoint_roundtrip(mesh_dp8, docs, tmp_path):
+    tw, td, V = docs
+    cfg = LDAConfig(num_topics=128, batch_tokens=1024, steps_per_call=2,
+                    seed=3, sampler="tiled", doc_blocked=True,
+                    block_tokens=256, block_docs=8)
+    app = LightLDA(tw, td, V, cfg, mesh=mesh_dp8, name="lda_dbc1")
+    app.train(num_iterations=2)
+    prefix = str(tmp_path / "db_ckpt")
+    app.store(prefix)
+    app2 = LightLDA(tw, td, V, cfg, mesh=mesh_dp8, name="lda_dbc2")
+    app2.load(prefix)
+    np.testing.assert_array_equal(app2.word_topics(), app.word_topics())
+    np.testing.assert_array_equal(app2.doc_topics(), app.doc_topics())
+    app2.train(num_iterations=1)
+    assert app2.word_topics().sum() == app2.num_tokens
+    # layout mismatch rejected: a stream-layout app can't load this z
+    app3 = LightLDA(tw, td, V,
+                    LDAConfig(num_topics=128, batch_tokens=512,
+                              steps_per_call=4, seed=3, sampler="tiled"),
+                    mesh=mesh_dp8, name="lda_dbc3")
+    with pytest.raises(ValueError, match="layout"):
+        app3.load(prefix)
+
+
+def test_docblock_rejects_oversized_docs(mesh_dp8):
+    tw = np.zeros(600, np.int32)
+    td = np.zeros(600, np.int32)  # one 600-token doc > block_tokens
+    with pytest.raises(ValueError, match="block_tokens"):
+        LightLDA(tw, td, 1,
+                 LDAConfig(num_topics=128, batch_tokens=1024,
+                           sampler="tiled", doc_blocked=True,
+                           block_tokens=256),
+                 mesh=mesh_dp8, name="lda_dbbig")
+
+
+def test_stale_words_rejects_giant_docs(mesh_dp8):
+    tw = np.zeros(40000, np.int32)
+    td = np.zeros(40000, np.int32)  # one 40k-token document
+    with pytest.raises(ValueError, match="32767"):
+        LightLDA(tw, td, 1,
+                 LDAConfig(num_topics=128, sampler="tiled",
+                           stale_words=True),
+                 mesh=mesh_dp8, name="lda_giant")
+
+
 def test_tiled_requires_lane_aligned_topics(mesh_dp8, docs):
     tw, td, V = docs
     with pytest.raises(ValueError, match="128"):

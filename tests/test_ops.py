@@ -103,6 +103,47 @@ class TestGibbsSampleTiled:
         tv = 0.5 * np.abs(counts - p).sum()
         assert tv < 0.12, tv
 
+    def test_docblock_matches_oracle_and_updates_counts(self, mesh8):
+        from multiverso_tpu.ops import gibbs_sample_docblock
+        rng = np.random.default_rng(5)
+        NB, MAXD, TB = 3, 4, 16
+        ndk_blk = rng.integers(0, 6, (NB, MAXD, C, L)).astype(np.int16)
+        b = NB * TB
+        W = rng.integers(0, 60, (b, C, L)).astype(np.int32)
+        nk = rng.integers(500, 5000, (C, L)).astype(np.int32)
+        sinv = (1.0 / (nk + 50 * BETA)).astype(np.float32)
+        zi = rng.integers(0, K, b).astype(np.int32)
+        drel = rng.integers(0, MAXD, b).astype(np.int32)
+        msk = np.ones(b, np.int32)
+        msk[-2:] = 0
+        u1 = rng.random(b).astype(np.float32)
+        u2 = rng.random(b).astype(np.float32)
+        ndk_out, znew, nkd = gibbs_sample_docblock(
+            ndk_blk, W, sinv, zi, drel, msk, u1, u2,
+            alpha=ALPHA, beta=BETA, tb=TB, interpret=True)
+        ndk_out, znew, nkd = map(np.asarray, (ndk_out, znew, nkd))
+        # per-token draw equals the flat-kernel oracle on gathered A rows
+        blk = np.repeat(np.arange(NB), TB)
+        A = ndk_blk[blk, drel].astype(np.int32)
+        want = oracle(A, W, sinv, zi, msk, u1, u2)
+        agree = float(np.mean(znew == want))
+        assert agree >= 0.98, agree
+        np.testing.assert_array_equal(znew[-2:], zi[-2:])
+        # blocked counts moved exactly (-1 old, +1 new per real token)
+        want_ndk = ndk_blk.astype(np.int64).copy()
+        for t in range(b):
+            if msk[t]:
+                want_ndk[blk[t], drel[t]].reshape(-1)[zi[t]] -= 1
+                want_ndk[blk[t], drel[t]].reshape(-1)[znew[t]] += 1
+        np.testing.assert_array_equal(ndk_out.astype(np.int64), want_ndk)
+        # summary delta consistent and conserving
+        want_nkd = np.zeros(K, np.int64)
+        for t in range(b):
+            if msk[t]:
+                want_nkd[znew[t]] += 1
+                want_nkd[zi[t]] -= 1
+        np.testing.assert_array_equal(nkd.reshape(-1), want_nkd)
+
     def test_bad_lane_dim_raises(self, mesh8):
         with pytest.raises(ValueError, match="last dim"):
             gibbs_sample_tiled(
