@@ -151,6 +151,13 @@ class LightLDA:
             raise ValueError(
                 f"stale_words/doc_blocked are sampler='tiled' modes; "
                 f"got sampler={c.sampler!r}")
+        if tiled and self.mesh.shape[core.MODEL_AXIS] > 1:
+            # the pallas samplers scale over the DATA axis (shard_map
+            # per-chip grids + psum); model-axis K/V sharding needs
+            # XLA-inserted gather collectives — use sampler='gibbs'
+            raise ValueError(
+                "sampler='tiled' is data-parallel only (model axis must "
+                "be 1); use sampler='gibbs' for model-parallel sharding")
         # the pallas kernel needs the Mosaic TPU backend; on a CPU mesh
         # (tests) it runs in interpreter mode
         self._interpret = tiled and \
@@ -345,8 +352,10 @@ class LightLDA:
         # per-call staging: [S, B] lanes + per-step block offsets
         spec = P(None, core.DATA_AXIS)
         rows_flat = (np.arange(nb_pad)[:, None] * MAXD
-                     + drel_p).astype(np.int32)       # loglik gather rows
+                     + drel_p).astype(np.int32)
         self._calls = []
+        self._loglik_rows = []   # eval-only gather rows (not a fused
+        #                          operand: the sweep never needs them)
         for call in range(n_calls):
             lo = call * per_call
             sl = slice(lo, lo + per_call)
@@ -354,11 +363,12 @@ class LightLDA:
             self._calls.append((
                 self._place(tw_p[sl].reshape(shp), spec),
                 self._place(drel_p[sl].reshape(shp), spec),
-                self._place(rows_flat[sl].reshape(shp), spec),
                 self._place(mask_p[sl].reshape(shp).astype(np.int32),
                             spec),
                 self._place(np.arange(lo, lo + per_call, nbs,
                                       dtype=np.int32), P())))
+            self._loglik_rows.append(
+                self._place(rows_flat[sl].reshape(shp), spec))
 
         # full flat stream for the per-sweep word-count rebuild
         self._tw_flat = self._place(tw_p.reshape(-1), P())
@@ -389,6 +399,50 @@ class LightLDA:
         self.word_topic.put_raw(nwk)
         self._ndk = ndk
         self.summary.put_raw(nk)
+
+    def _wrap_kernel_dp(self, fn):
+        """Multi-chip dispatch for the pallas sampler: a Mosaic custom
+        call cannot be auto-partitioned by XLA, so under data
+        parallelism each chip runs the kernel on its own token shard via
+        ``shard_map`` and the topic-summary delta is psum'd over ICI
+        (the tiled samplers are DP-only; model-parallel K/V sharding
+        stays with the XLA 'gibbs' sampler)."""
+        if self.mesh.shape[core.DATA_AXIS] == 1:
+            return fn
+        from jax import shard_map
+        d = core.DATA_AXIS
+        Pb = P(d)
+        Pb3 = P(d, None, None)
+
+        def local(A3, W3, sinv, zi, msk, u1, u2):
+            znew, nkd = fn(A3, W3, sinv, zi, msk, u1, u2)
+            return znew, lax.psum(nkd, d)
+
+        return shard_map(
+            local, mesh=self.mesh,
+            in_specs=(Pb3, Pb3, P(None, None), Pb, Pb, Pb, Pb),
+            out_specs=(Pb, P(None, None)), check_vma=False)
+
+    def _wrap_docblock_dp(self, fn):
+        """Doc-blocked analog of :meth:`_wrap_kernel_dp`: kernel blocks
+        shard over the data axis (each chip exclusively owns its blocks'
+        doc counts — the block layout IS the DP partition)."""
+        if self.mesh.shape[core.DATA_AXIS] == 1:
+            return fn
+        from jax import shard_map
+        d = core.DATA_AXIS
+        Pb = P(d)
+
+        def local(ndk_c, W3, sinv, zi, drel, msk, u1, u2):
+            ndk_c, znew, nkd = fn(ndk_c, W3, sinv, zi, drel, msk, u1, u2)
+            return ndk_c, znew, lax.psum(nkd, d)
+
+        return shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P(d, None, None, None), P(d, None, None),
+                      P(None, None), Pb, Pb, Pb, Pb, Pb),
+            out_specs=(P(d, None, None, None), Pb, P(None, None)),
+            check_vma=False)
 
     def _build_stale_helpers(self) -> None:
         """Per-sweep word-count helpers shared by the stale modes: the
@@ -441,13 +495,23 @@ class LightLDA:
         B = c.batch_tokens
         TB = self._tb
         nbs = B // TB
+        dp = self.mesh.shape[core.DATA_AXIS]
+        if nbs % dp:
+            raise ValueError(
+                f"doc_blocked: blocks per step {nbs} not divisible by "
+                f"data-axis size {dp}")
         tiles = K // 128
         interpret = self._interpret
         from multiverso_tpu.ops import gibbs_sample_docblock
+        sampler_call = self._wrap_docblock_dp(
+            lambda ndk_c, W3, sinv, zi, drel, msk, u1, u2:
+            gibbs_sample_docblock(ndk_c, W3, sinv, zi, drel, msk, u1,
+                                  u2, alpha=alpha, beta=beta, tb=TB,
+                                  interpret=interpret))
 
         def scan_body(wstale, carry, inp):
             nk, ndk, z = carry
-            w, drel, _rows, msk, off, key = inp
+            w, drel, msk, off, key = inp
             ndk_c = lax.dynamic_slice_in_dim(ndk, off, nbs)
             zi = lax.dynamic_slice_in_dim(z, off, nbs).reshape(B)
             W3 = jnp.take(wstale, w.reshape(B), axis=0)
@@ -456,10 +520,9 @@ class LightLDA:
             k1, k2 = jax.random.split(key)
             u1 = jax.random.uniform(k1, (B,))
             u2 = jax.random.uniform(k2, (B,))
-            ndk_c, znew, nkd = gibbs_sample_docblock(
+            ndk_c, znew, nkd = sampler_call(
                 ndk_c, W3, sinv, zi, drel.reshape(B), msk.reshape(B),
-                u1, u2, alpha=alpha, beta=beta, tb=TB,
-                interpret=interpret)
+                u1, u2)
             ndk = lax.dynamic_update_slice_in_dim(ndk, ndk_c, off, 0)
             z = lax.dynamic_update_slice_in_dim(
                 z, znew.reshape(nbs, TB), off, 0)
@@ -467,13 +530,13 @@ class LightLDA:
             return (nk, ndk, z), ()
 
         def body(params, states, locals_, options, wstale, ws, drels,
-                 rows, msks, offs, key):
+                 msks, offs, key):
             (nk,) = params
             ndk, z = locals_
             keys = jax.random.split(key, ws.shape[0])
             (nk, ndk, z), _ = lax.scan(
                 lambda cy, inp: scan_body(wstale, cy, inp),
-                (nk, ndk, z), (ws, drels, rows, msks, offs, keys))
+                (nk, ndk, z), (ws, drels, msks, offs, keys))
             return (nk,), states, (ndk, z), None
 
         self._fused = make_superstep((self.summary,), body,
@@ -623,6 +686,10 @@ class LightLDA:
         interpret = self._interpret
         stale = self._stale
         from multiverso_tpu.ops import gibbs_sample_tiled
+        sampler_call = self._wrap_kernel_dp(
+            lambda A3, W3, sinv, zi, msk, u1, u2: gibbs_sample_tiled(
+                A3, W3, sinv, zi, msk, u1, u2, alpha=alpha, beta=beta,
+                interpret=interpret))
 
         def sample_and_update(nk, ndk3, z, W3, w, d, off, msk, key):
             """Shared step core: sample the slice, move doc/summary
@@ -634,9 +701,7 @@ class LightLDA:
             k1, k2 = jax.random.split(key)
             u1 = jax.random.uniform(k1, (B,))
             u2 = jax.random.uniform(k2, (B,))
-            znew, nkd = gibbs_sample_tiled(
-                A3, W3, sinv, zi, msk, u1, u2, alpha=alpha, beta=beta,
-                interpret=interpret)
+            znew, nkd = sampler_call(A3, W3, sinv, zi, msk, u1, u2)
             one = msk.astype(ndk3.dtype)
             cold, lold = zi // 128, zi % 128
             cnew, lnew = znew // 128, znew % 128
@@ -874,10 +939,10 @@ class LightLDA:
         `Eval` role). Evaluates over the pre-placed device-resident call
         slices — the token stream is static, so no host re-upload."""
         total = 0.0
-        for call in self._calls:
+        for i, call in enumerate(self._calls):
             if self._docblock:
-                ws, _drels, rows, msks, _offs = call
-                args = (ws, rows, msks)
+                ws, _drels, msks, _offs = call
+                args = (ws, self._loglik_rows[i], msks)
             else:
                 ws, ds, _idxs, msks = call
                 args = (ws, ds, msks)

@@ -183,7 +183,7 @@ def test_docblock_sampler_invariants_and_quality(mesh_dp8, docs):
     stay near the exact-Gibbs level."""
     tw, td, V = docs
     app = LightLDA(tw, td, V,
-                   LDAConfig(num_topics=128, batch_tokens=1024,
+                   LDAConfig(num_topics=128, batch_tokens=2048,
                              steps_per_call=2, seed=1, sampler="tiled",
                              doc_blocked=True, block_tokens=256,
                              block_docs=8),
@@ -203,7 +203,7 @@ def test_docblock_sampler_invariants_and_quality(mesh_dp8, docs):
 
 def test_docblock_checkpoint_roundtrip(mesh_dp8, docs, tmp_path):
     tw, td, V = docs
-    cfg = LDAConfig(num_topics=128, batch_tokens=1024, steps_per_call=2,
+    cfg = LDAConfig(num_topics=128, batch_tokens=2048, steps_per_call=2,
                     seed=3, sampler="tiled", doc_blocked=True,
                     block_tokens=256, block_docs=8)
     app = LightLDA(tw, td, V, cfg, mesh=mesh_dp8, name="lda_dbc1")
@@ -230,7 +230,7 @@ def test_docblock_rejects_oversized_docs(mesh_dp8):
     td = np.zeros(600, np.int32)  # one 600-token doc > block_tokens
     with pytest.raises(ValueError, match="block_tokens"):
         LightLDA(tw, td, 1,
-                 LDAConfig(num_topics=128, batch_tokens=1024,
+                 LDAConfig(num_topics=128, batch_tokens=2048,
                            sampler="tiled", doc_blocked=True,
                            block_tokens=256),
                  mesh=mesh_dp8, name="lda_dbbig")
