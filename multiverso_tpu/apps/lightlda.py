@@ -63,6 +63,7 @@ class LDAConfig:
     batch_tokens: int = 4096        # tokens per scan step
     steps_per_call: int = 16        # scan length
     num_iterations: int = 10        # full Gibbs sweeps
+    eval_every: int = 1             # likelihood eval cadence (sweeps)
     sampler: str = "gibbs"          # "gibbs" (exact O(K)) | "mh" (O(1))
     #                               | "tiled" (pallas kernel, K%128==0)
     stale_words: bool = False       # tiled only: word counts gathered
@@ -915,12 +916,19 @@ class LightLDA:
             self.word_topic.put_raw(nwk)
 
     def train(self, num_iterations: Optional[int] = None) -> float:
-        """Run Gibbs sweeps; returns the final per-token log-likelihood."""
+        """Run Gibbs sweeps; returns the final per-token log-likelihood.
+        Eval runs every ``eval_every`` sweeps (and always on the last):
+        the predictive-likelihood pass re-gathers count rows for the
+        whole corpus, a sweep-sized cost the reference's Eval role also
+        pays only periodically."""
         iters = num_iterations if num_iterations is not None \
             else self.config.num_iterations
+        every = max(self.config.eval_every, 1)
         t0 = time.perf_counter()
         for it in range(iters):
             self.sweep()
+            if (it + 1) % every and it != iters - 1:
+                continue
             ll = self.loglik()
             self.ll_history.append(ll)
             log.info("lightlda iter %d: loglik/token=%.4f", it, ll)

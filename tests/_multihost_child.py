@@ -70,6 +70,31 @@ def main() -> None:
     else:
         raise SystemExit("KVTable did not raise under process_count=2")
 
+    # the flagship doc-blocked LDA sampler across BOTH processes: a
+    # shard_map'd pallas kernel (interpret mode on CPU) with per-chip
+    # block ownership and psum'd summary deltas over the 2-host mesh
+    from jax.sharding import Mesh
+    from multiverso_tpu.apps.lightlda import LDAConfig, LightLDA
+    core.shutdown()
+    core.set_mesh(Mesh(np.array(jax.devices()).reshape(4, 1),
+                       ("data", "model")))
+    rng = np.random.default_rng(0)
+    tb = 64
+    n_tok = tb * 4 * 2
+    td_l = np.sort(rng.integers(0, 32, n_tok)).astype(np.int32)
+    tw_l = rng.integers(0, 16, n_tok).astype(np.int32)
+    lda = LightLDA(tw_l, td_l, 16,
+                   LDAConfig(num_topics=128, batch_tokens=tb * 4,
+                             steps_per_call=2, seed=0, sampler="tiled",
+                             doc_blocked=True, block_tokens=tb,
+                             block_docs=16),
+                   name="mh_lda_db")
+    lda.sweep()
+    ll = lda.loglik()
+    assert np.isfinite(ll), ll
+    nwk = lda.word_topics()
+    assert nwk.sum() == lda.num_tokens, (nwk.sum(), lda.num_tokens)
+
     core.barrier()
     reset_tables()
     print(f"MULTIHOST_OK rank={pid}")

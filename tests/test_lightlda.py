@@ -272,6 +272,18 @@ def test_tiled_checkpoint_roundtrip(mesh_dp8, docs, tmp_path):
     assert nwk.sum() == app2.num_tokens
 
 
+def test_eval_every_cadence(mesh_dp8, docs):
+    tw, td, V = docs
+    app = LightLDA(tw, td, V,
+                   LDAConfig(num_topics=8, batch_tokens=512,
+                             steps_per_call=4, seed=5, eval_every=3),
+                   mesh=mesh_dp8, name="lda_cadence")
+    app.train(num_iterations=7)
+    # evals at sweeps 3, 6 and the final 7th
+    assert len(app.ll_history) == 3
+    assert np.all(np.isfinite(app.ll_history))
+
+
 def test_mh_interleaved_docs_rejected(mesh_dp8):
     tw = np.array([0, 1, 2, 3], np.int32)
     td = np.array([0, 1, 0, 1], np.int32)   # not doc-contiguous
