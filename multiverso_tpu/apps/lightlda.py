@@ -979,6 +979,36 @@ class LightLDA:
     def top_words(self, topic: int, k: int = 10) -> np.ndarray:
         return np.argsort(-self.word_topics()[:, topic])[:k]
 
+    def dump_model(self, uri: str, rows_per_fetch: int = 4096) -> None:
+        """Write the word-topic model in the reference's sparse text
+        format — one line per word, ``word_id topic:count ...`` with only
+        the NONZERO entries (the lightlda model dump shape). Fetches go
+        through :meth:`SparseMatrixTable.get_rows_sparse`, so only the
+        nonzero entries ever cross device→host (a converged topic model
+        is ~99% zeros per row)."""
+        from multiverso_tpu.io import open_stream
+        import contextlib
+        # every process runs the (collective) fetches; only rank 0
+        # writes — concurrent 'wb' on a shared filesystem would corrupt
+        write = jax.process_index() == 0
+        stream = open_stream(uri, "wb") if write \
+            else contextlib.nullcontext()
+        with stream:
+            for lo in range(0, self.V, rows_per_fetch):
+                ids = np.arange(lo, min(lo + rows_per_fetch, self.V))
+                indptr, cols, vals = \
+                    self.word_topic.get_rows_sparse(ids)
+                if not write:
+                    continue
+                lines = []
+                for i, w in enumerate(ids):
+                    ent = " ".join(
+                        f"{k}:{v}" for k, v in
+                        zip(cols[indptr[i]:indptr[i + 1]],
+                            vals[indptr[i]:indptr[i + 1]]))
+                    lines.append(f"{w} {ent}".rstrip())
+                stream.write(("\n".join(lines) + "\n").encode())
+
     def store(self, uri_prefix: str) -> None:
         """Checkpoint tables AND sampler state (z, doc-topic counts):
         the three must stay consistent or resumed sweeps corrupt counts."""
@@ -1083,8 +1113,16 @@ def main(argv=None) -> None:
                            overwrite=True)
     configure.define_float("beta", 0.01, "word-topic prior", overwrite=True)
     configure.define_int("num_iterations", 10, "Gibbs sweeps", overwrite=True)
+    configure.define_int("eval_every", 1,
+                         "likelihood eval cadence in sweeps", overwrite=True)
     configure.define_int("batch_tokens", 4096, "tokens per scan step", overwrite=True)
     configure.define_string("output_file", "", "model checkpoint prefix", overwrite=True)
+    configure.define_string("dump_file", "",
+                            "sparse text model dump (word k:count ...)",
+                            overwrite=True)
+    configure.define_string("sampler", "gibbs",
+                            "gibbs | mh | tiled (K%128==0; TPU kernel)",
+                            overwrite=True)
     core.init(argv)
     path = configure.get_flag("input_file")
     if not path:
@@ -1097,12 +1135,17 @@ def main(argv=None) -> None:
         beta=configure.get_flag("beta"),
         batch_tokens=configure.get_flag("batch_tokens"),
         num_iterations=configure.get_flag("num_iterations"),
+        eval_every=configure.get_flag("eval_every"),
+        sampler=configure.get_flag("sampler"),
     )
     app = LightLDA(tw, td, vocab, cfg)
     app.train()
     out = configure.get_flag("output_file")
     if out:
         app.store(out)
+    dump = configure.get_flag("dump_file")
+    if dump:
+        app.dump_model(dump)
     core.barrier()
 
 

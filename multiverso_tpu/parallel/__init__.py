@@ -1,0 +1,14 @@
+"""Sequence/context parallelism primitives (beyond-parity extension).
+
+The reference predates transformers — SURVEY.md §6.7 records
+sequence/context parallelism as ABSENT there and out of scope for
+parity. This package is the framework's forward-looking long-context
+layer, built the idiomatic TPU way that §6.7 names: ``shard_map`` over
+the mesh + ``ppermute`` ring / ``all_to_all`` resharding, so attention
+over sequences longer than one chip's memory rides ICI.
+"""
+
+from multiverso_tpu.parallel.ring_attention import (ring_attention,
+                                                    ulysses_attention)
+
+__all__ = ["ring_attention", "ulysses_attention"]

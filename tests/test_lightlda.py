@@ -272,6 +272,32 @@ def test_tiled_checkpoint_roundtrip(mesh_dp8, docs, tmp_path):
     assert nwk.sum() == app2.num_tokens
 
 
+def test_dump_model_sparse_format(mesh_dp8, docs, tmp_path):
+    """The reference-style sparse model dump must reconstruct the dense
+    word-topic counts exactly (it rides the sparse Get: only nonzero
+    entries leave the device)."""
+    tw, td, V = docs
+    app = LightLDA(tw, td, V,
+                   LDAConfig(num_topics=8, batch_tokens=512,
+                             steps_per_call=4, seed=6),
+                   mesh=mesh_dp8, name="lda_dump")
+    app.train(num_iterations=2)
+    uri = str(tmp_path / "model.txt")
+    app.dump_model(uri, rows_per_fetch=64)
+    dense = app.word_topics()
+    got = np.zeros_like(dense)
+    with open(uri) as f:
+        lines = f.read().splitlines()
+    assert len(lines) == V
+    for ln in lines:
+        parts = ln.split()
+        w = int(parts[0])
+        for tok in parts[1:]:
+            k, v = tok.split(":")
+            got[w, int(k)] = int(v)
+    np.testing.assert_array_equal(got, dense)
+
+
 def test_eval_every_cadence(mesh_dp8, docs):
     tw, td, V = docs
     app = LightLDA(tw, td, V,
