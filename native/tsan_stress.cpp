@@ -1,0 +1,103 @@
+// ThreadSanitizer stress harness for the native data pipeline
+// (SURVEY.md §6.2: the reference ships no sanitizer config; the TPU
+// build keeps a TSan job for the HOST-side input pipeline, the one
+// place real threads exist — the prefetch thread and the trainer thread
+// both drive this library concurrently).
+//
+// Build + run:  make -C native tsan
+//
+// The harness mirrors the framework's actual concurrency shape: one
+// corpus shared by several reader threads generating skip-gram/CBOW
+// batches while another thread queries vocab metadata, plus concurrent
+// corpus build/free on separate handles (registry lock contention).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+uint64_t mv_corpus_build(const char* path, int32_t min_count);
+int32_t mv_corpus_vocab_size(uint64_t handle);
+int64_t mv_corpus_num_tokens(uint64_t handle);
+int32_t mv_corpus_counts(uint64_t handle, int64_t* out, int32_t cap);
+int64_t mv_corpus_ids(uint64_t handle, int32_t* out, int64_t cap);
+const char* mv_corpus_word(uint64_t handle, int32_t id);
+void mv_corpus_free(uint64_t handle);
+int64_t mv_skipgram_pairs(const int32_t* ids, int64_t n, int32_t window,
+                          const float* keep_prob, uint64_t seed,
+                          int32_t* src, int32_t* tgt, int64_t cap);
+int64_t mv_cbow_examples(const int32_t* ids, int64_t n, int32_t window,
+                         const float* keep_prob, uint64_t seed,
+                         int32_t* ctx, int32_t* tgt, int64_t cap);
+int32_t mv_data_abi_version();
+}
+
+static std::string write_corpus(const char* path, int tokens) {
+  FILE* f = fopen(path, "w");
+  if (!f) { perror("fopen"); exit(1); }
+  srand(7);
+  for (int i = 0; i < tokens; i++)
+    fprintf(f, "w%d ", rand() % 199);
+  fclose(f);
+  return path;
+}
+
+int main() {
+  if (mv_data_abi_version() <= 0) return 1;
+  const char* path = "/tmp/tsan_corpus.txt";
+  write_corpus(path, 20000);
+  uint64_t h = mv_corpus_build(path, 1);
+  if (!h) { fprintf(stderr, "corpus build failed\n"); return 1; }
+  int64_t n = mv_corpus_num_tokens(h);
+  std::vector<int32_t> ids(n);
+  mv_corpus_ids(h, ids.data(), n);
+
+  std::atomic<long> pairs{0};
+  std::vector<std::thread> threads;
+  // reader threads: the prefetch-thread role
+  for (int t = 0; t < 4; t++) {
+    threads.emplace_back([&, t] {
+      std::vector<int32_t> src(1 << 16), tgt(1 << 16);
+      std::vector<int32_t> ctx((int64_t)(1 << 13) * 10);
+      for (int it = 0; it < 50; it++) {
+        pairs += mv_skipgram_pairs(ids.data(), n, 5, nullptr,
+                                   1000 * t + it, src.data(), tgt.data(),
+                                   1 << 16);
+        pairs += mv_cbow_examples(ids.data(), n, 5, nullptr,
+                                  2000 * t + it, ctx.data(), tgt.data(),
+                                  1 << 13);
+      }
+    });
+  }
+  // metadata thread: the trainer-thread role (vocab lookups mid-train)
+  threads.emplace_back([&] {
+    std::vector<int64_t> counts(mv_corpus_vocab_size(h));
+    for (int it = 0; it < 200; it++) {
+      mv_corpus_counts(h, counts.data(), (int32_t)counts.size());
+      volatile const char* w = mv_corpus_word(h, it % counts.size());
+      (void)w;
+    }
+  });
+  // registry churn: independent corpora built/freed concurrently
+  for (int t = 0; t < 2; t++) {
+    threads.emplace_back([&, t] {
+      char p[64];
+      snprintf(p, sizeof p, "/tmp/tsan_corpus_%d.txt", t);
+      write_corpus(p, 2000);
+      for (int it = 0; it < 20; it++) {
+        uint64_t hh = mv_corpus_build(p, 1);
+        mv_corpus_vocab_size(hh);
+        mv_corpus_free(hh);
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  mv_corpus_free(h);
+  printf("tsan_stress OK (%ld pairs)\n", (long)pairs.load());
+  return 0;
+}
