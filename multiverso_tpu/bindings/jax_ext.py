@@ -82,14 +82,33 @@ def reset_shared_vars() -> None:
 
 class ParamManager:
     """Register a pytree of params into one table; ``sync_all_param()``
-    per iteration/epoch (reference ``LasagneParamManager``)."""
+    per iteration/epoch (reference ``LasagneParamManager``).
 
-    def __init__(self, params: Any, name: str = "param_manager") -> None:
+    ``compress="1bit"`` runs each synced delta through the 1-bit
+    quantization filter with local error feedback (the reference's
+    optional delta compression before send, SURVEY.md §3.7): the table
+    receives the DEQUANTIZED delta — what would arrive on the far side
+    of a DCN-crossing transfer at 1/32 the float wire bytes — and the
+    quantization error carries into the next sync.
+    """
+
+    def __init__(self, params: Any, name: str = "param_manager",
+                 compress: Optional[str] = None,
+                 compress_block: int = 512) -> None:
         leaves, self._treedef = jax.tree.flatten(params)
         self._shapes = [np.shape(l) for l in leaves]
         self._sizes = [int(np.size(l)) for l in leaves]
         self._total = sum(self._sizes)
         self._table = ArrayTableHandler(self._total, name=name)
+        if compress is None:
+            self._quant = None
+        elif compress == "1bit":
+            from multiverso_tpu.utils.quantization import OneBitQuantizer
+            self._quant = OneBitQuantizer(block=compress_block)
+            self._residual = np.zeros(self._total, np.float32)
+        else:
+            raise ValueError(f"compress must be None or '1bit', "
+                             f"got {compress!r}")
         flat = np.concatenate(
             [np.asarray(l, dtype=np.float32).ravel() for l in leaves]) \
             if leaves else np.zeros(0, np.float32)
@@ -113,7 +132,17 @@ class ParamManager:
     def sync_all_param(self, params: Any) -> Any:
         """Delta-sync the whole tree; returns the merged tree."""
         flat = self._flatten(params)
-        self._table.add(flat - self._last_synced, sync=True)
+        delta = flat - self._last_synced
+        if self._quant is not None:
+            from multiverso_tpu import core
+            mesh = self._table._table.mesh
+            put = lambda a: core.place(a, mesh=mesh)
+            sign, pos, neg, res = self._quant.quantize(
+                put(delta), put(self._residual))
+            self._residual = np.asarray(res)
+            delta = np.asarray(self._quant.dequantize(
+                sign, pos, neg, (self._total,)))
+        self._table.add(delta, sync=True)
         merged = self._table.get()
         self._last_synced = merged.copy()
         return self._unflatten(merged)

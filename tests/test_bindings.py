@@ -3,6 +3,7 @@
 (SURVEY.md §5: array get/add round-trip with float tolerance, matrix
 whole/row get-add, mv_shared sync semantics)."""
 
+import jax
 import numpy as np
 import pytest
 
@@ -107,3 +108,45 @@ class TestParamManager:
         pm = jax_ext.ParamManager({"w": np.zeros(2)})
         with pytest.raises(ValueError, match="structure"):
             pm.sync_all_param({"w": np.zeros(2), "extra": np.zeros(1)})
+
+
+class TestCompressedSync:
+    def test_error_feedback_bounds_accumulated_error(self):
+        # the 1-bit-SGD error-feedback guarantee: pushing the same FRESH
+        # delta g for T syncs accumulates ~T*g — the quantization error
+        # stays O(1) (carried in the residual), it does not grow with T
+        rng = np.random.default_rng(0)
+        g = rng.normal(0, 1, 1024).astype(np.float32)
+        pm = jax_ext.ParamManager({"w": np.zeros(1024, np.float32)},
+                                  name="pm_1bit", compress="1bit",
+                                  compress_block=128)
+        cur = pm.sync_all_param({"w": np.zeros(1024, np.float32)})
+        rels = {}
+        for t in range(1, 31):
+            cur = pm.sync_all_param({"w": cur["w"] + g})
+            got = np.asarray(cur["w"])
+            rels[t] = np.abs(got - t * g).mean() / (t * np.abs(g).mean())
+        # absolute error stays O(1) -> relative error shrinks ~1/T
+        assert rels[30] < 0.1, rels[30]
+        assert rels[30] < rels[5] / 2, (rels[5], rels[30])
+        # and the residual really is carrying error (compression active)
+        assert np.abs(pm._residual).sum() > 0
+
+    def test_compressed_mlp_still_learns(self):
+        from examples import mlp_cifar
+        X, y = mlp_cifar.synthetic_cifar(3000, seed=4)
+        pm = jax_ext.ParamManager(
+            jax.tree.map(np.asarray, mlp_cifar.init_mlp((64,), seed=4)),
+            name="pm_mlp_1bit", compress="1bit")
+        params, loss = mlp_cifar.train(
+            X, y, hidden=(64,), epochs=4, batch_size=256, lr=0.05,
+            sync_every=4, seed=4, manager=pm)
+        acc = mlp_cifar.accuracy(params, X, y)
+        assert np.isfinite(loss)
+        # 10 classes -> chance 0.1; 1-bit sync converges slower than the
+        # float path but must clearly learn
+        assert acc > 0.45, acc
+
+    def test_unknown_compressor_rejected(self):
+        with pytest.raises(ValueError, match="compress"):
+            jax_ext.ParamManager({"w": np.zeros(4)}, compress="2bit")
