@@ -1,0 +1,109 @@
+"""Randomized differential test of the table contract: a random op
+sequence (dense add / row add / COO add / snapshot / checkpoint
+round-trip) must leave the table exactly equal to a numpy mirror
+applying the same updater math — across updaters and both storage
+layouts (flat and tile-aligned). The targeted tests pin individual
+behaviors; this hunts interaction drift between them (SURVEY.md §5:
+'table round-trip property tests, Get∘Add ≡ updater math')."""
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.tables import SparseMatrixTable
+from multiverso_tpu.tables import base as table_base
+from multiverso_tpu.updaters import AddOption
+
+ROWS, COLS_FLAT, COLS_TILED = 24, 48, 128
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    table_base.reset_tables()
+
+
+class NumpyMirror:
+    """The contract in numpy: plain add or sgd."""
+
+    def __init__(self, rows, cols, updater, lr):
+        self.m = np.zeros((rows, cols), np.float64)
+        self.updater = updater
+        self.lr = lr
+
+    def dense_add(self, delta):
+        d = delta.astype(np.float64)
+        self.m = self.m + d if self.updater == "default" \
+            else self.m - self.lr * d
+
+    def row_add(self, ids, deltas):
+        d = deltas.astype(np.float64)
+        if self.updater == "sgd":
+            d = -self.lr * d
+        np.add.at(self.m, ids, d)
+
+    def coo_add(self, r, c, v):
+        v = v.astype(np.float64)
+        if self.updater == "sgd":
+            v = -self.lr * v
+        np.add.at(self.m, (r, c), v)
+
+
+@pytest.mark.parametrize("tiled", [False, True])
+@pytest.mark.parametrize("updater", ["default", "sgd"])
+def test_random_op_sequences_match_numpy(mesh8, tmp_path, tiled, updater):
+    cols = COLS_TILED if tiled else COLS_FLAT
+    rng = np.random.default_rng(1234 + tiled * 7 + (updater == "sgd"))
+    lr = 0.25
+    t = SparseMatrixTable(ROWS, cols, "float32", updater=updater,
+                          tiled=tiled,
+                          name=f"fuzz_{tiled}_{updater}",
+                          default_option=AddOption(learning_rate=lr))
+    mirror = NumpyMirror(ROWS, cols, updater, lr)
+    expect_gen = 0
+
+    for step in range(40):
+        op = rng.integers(0, 5)
+        if op == 0:                          # dense whole-table add
+            d = rng.normal(0, 1, (ROWS, cols)).astype(np.float32)
+            t.add(d, sync=bool(rng.integers(0, 2)))
+            mirror.dense_add(d)
+            expect_gen += 1
+        elif op == 1:                        # row-subset add (dup rows ok)
+            n = int(rng.integers(1, 9))
+            ids = rng.integers(0, ROWS, n)
+            d = rng.normal(0, 1, (n, cols)).astype(np.float32)
+            t.add_rows(ids, d)
+            mirror.row_add(ids, d)
+            expect_gen += 1
+        elif op == 2:                        # COO sparse add (dups ok)
+            n = int(rng.integers(1, 33))
+            r = rng.integers(0, ROWS, n)
+            c = rng.integers(0, cols, n)
+            v = rng.normal(0, 1, n).astype(np.float32)
+            t.add_sparse(r, c, v)
+            mirror.coo_add(r, c, v)
+            expect_gen += 1
+        elif op == 3:                        # reads must not perturb
+            ids = rng.integers(0, ROWS, int(rng.integers(1, 5)))
+            got = t.get_rows(ids)
+            np.testing.assert_allclose(got, mirror.m[ids], rtol=2e-4,
+                                       atol=2e-4)
+            indptr, cc, vv = t.get_rows_sparse(ids)
+            for i, rid in enumerate(ids):
+                dense = np.zeros(cols, np.float32)
+                dense[cc[indptr[i]:indptr[i + 1]]] = \
+                    vv[indptr[i]:indptr[i + 1]]
+                np.testing.assert_allclose(dense, mirror.m[rid],
+                                           rtol=2e-4, atol=2e-4)
+        else:                                # checkpoint round-trip
+            uri = str(tmp_path / f"fuzz_{step}.npz")
+            t.store(uri)
+            t.load(uri)
+            expect_gen += 1  # load bumps (handles read superseded)
+
+        if step % 10 == 9:
+            np.testing.assert_allclose(t.get(), mirror.m, rtol=2e-4,
+                                       atol=2e-4)
+            assert t.generation == expect_gen
+
+    np.testing.assert_allclose(t.get(), mirror.m, rtol=2e-4, atol=2e-4)
