@@ -115,8 +115,12 @@ def pinned_cpu() -> dict:
 
 
 if __name__ == "__main__":
+    # reproduce any ladder step (benchmarks/README.md):
+    #   python benchmarks/measure_lda.py [gibbs|mh|tiled]
+    # 'tiled' runs the production config (doc_blocked + stale_words)
+    sampler_arg = sys.argv[1] if len(sys.argv) > 1 else "tiled"
     cpu = pinned_cpu()
-    tpu = measure_tpu()
+    tpu = measure_tpu(sampler_arg)
     result = {
         "metric": "LightLDA doc-tokens/sec",
         "cpu_worker": cpu,
