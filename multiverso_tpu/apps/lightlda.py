@@ -25,11 +25,35 @@ approximation the reference already makes across workers (its workers
 sample against a stale model fetched per slice); here the staleness
 window is one minibatch instead of one model-slice fetch.
 
+Four sampler configurations, a measured performance ladder (one v5e
+chip, benchmarks/README.md has the engineering log; every rung is
+invariant- and convergence-tested):
+
+1. ``sampler="gibbs"`` — exact vectorized collapsed Gibbs in plain XLA
+   (4.7M doc-tokens/s). Supports model-axis sharding of the tables.
+2. ``sampler="mh"`` — the reference's O(1) alias/z-array MH,
+   vectorized. Measured SLOWER than dense Gibbs on TPU (scalar gathers
+   lose to row gathers); kept as the algorithm-parity mode.
+3. ``sampler="tiled"`` — the pallas kernel (ops.gibbs_sample_tiled):
+   posterior + two-level inverse-CDF draw fused in VMEM over
+   tile-aligned counts (7.5M). ``stale_words=True`` adds the
+   reference's own slice-level staleness — word rows gathered from a
+   bf16 per-sweep mirror, int16 doc counts, int32 master rebuilt from
+   z each sweep (12.6M).
+4. ``doc_blocked=True`` — the production mode (19.6M, ~10x the CPU
+   baseline): whole-document kernel blocks own exclusive slices of a
+   blocked doc-count array, so the doc side (A-row gather + doc-count
+   scatters) happens in VMEM via MXU one-hot matmuls, never touching
+   XLA gather/scatter. Data-parallel across chips via shard_map
+   (per-chip blocks + psum'd summary deltas).
+
 Counts live in:
 - ``SparseMatrixTable [V, K] int32`` — word-topic counts (row-sharded
-  over the mesh model axis like the reference's server shards),
+  over the mesh model axis like the reference's server shards; the
+  tiled samplers store it tile-aligned and are DP-only),
 - ``ArrayTable [K] int32`` — topic summary,
-- a worker-local dense ``[D, K]`` doc-topic array (the reference keeps
+- a worker-local doc-topic array (dense ``[D, K]``, or int16 blocked
+  ``[NB, MAXD, C, 128]`` in doc_blocked mode — the reference keeps
   doc-topic counts worker-local too),
 - ``z [T] int32`` — per-token assignments, device-resident.
 """
