@@ -23,8 +23,12 @@ of fresh loss scalars, the only reliable sync on this platform.
 An END-TO-END measurement (real corpus -> host pair generation ->
 train dispatch, the reference's whole-pipeline number) always runs too
 and is reported as `e2e_words_per_sec`/`e2e_vs_baseline` in the final
-JSON line; on this 1-core host it is host-generation-bound, which the
-baseline host (same core) equally is.
+JSON line. Caveat for reading it: this environment reaches the TPU
+through a network tunnel where every host->device placement and
+device->host fetch pays ~100ms RPC latency (trace-measured; device
+busy-time is ~20% of the e2e wall clock). The e2e number is therefore a
+floor — on a directly-attached TPU host the same pipeline approaches
+the engine number, whose pre-staged operands amortize the tunnel away.
 """
 
 import json

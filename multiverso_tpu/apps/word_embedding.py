@@ -326,7 +326,11 @@ class WordEmbedding:
         est_ppt = (c.window + 1) if c.model == "skipgram" else 1.0
         words = pairs_done / est_ppt
         dashboard.emit_metric("w2v.words_per_sec", words / dt, "words/s")
-        self.loss_history = [float(l) for l in losses]
+        # ONE device->host transfer for the whole loss list: per-scalar
+        # fetches cost ~100ms each over a tunneled TPU (trace-measured)
+        self.loss_history = [float(l) for l in
+                             np.asarray(jnp.stack(losses))] \
+            if losses else []
         final = float(np.mean(self.loss_history[-10:])) \
             if losses else float("nan")
         log.info("w2v train done: %d calls, loss=%.4f, %.0f words/s",
