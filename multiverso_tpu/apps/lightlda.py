@@ -327,7 +327,8 @@ class LightLDA:
         tw, td = token_words[order], token_docs[order]
         doc_ids, doc_starts = np.unique(td, return_index=True) \
             if len(td) else (np.zeros(0, np.int64), np.zeros(0, np.int64))
-        doc_ends = np.append(doc_starts[1:], len(td))
+        doc_ends = np.append(doc_starts[1:], len(td)) if len(td) \
+            else doc_starts
         lens = doc_ends - doc_starts
         if len(lens) and lens.max() > TB:
             raise ValueError(f"a document has {lens.max()} tokens > "

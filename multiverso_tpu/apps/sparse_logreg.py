@@ -138,6 +138,8 @@ class SparseLogisticRegression:
                    uniq: np.ndarray, upad: int) -> np.ndarray:
         """Map each (sample, feature) lane to its row in the fetched
         unique-weight block; zero-value pad lanes -> sentinel row upad."""
+        if len(uniq) == 0:      # all-zero minibatch: every lane is padding
+            return np.full(keys.shape, upad, np.int32)
         pos = np.searchsorted(uniq, keys.ravel()).astype(np.int32)
         pos = np.minimum(pos, len(uniq) - 1)
         hit = uniq[pos] == keys.ravel()
@@ -187,7 +189,8 @@ class SparseLogisticRegression:
         loss, dw = step(put(w_ext.astype(np.float32)), put(pos),
                         put(vals), put(y.astype(np.int32)))
         dw = np.asarray(dw)[:len(uniq)]                  # drop pad+sentinel
-        self.table.add(uniq, dw)
+        if len(uniq):           # all-zero minibatch has nothing to update
+            self.table.add(uniq, dw)
         return float(loss)
 
     def train(self, rows, y: np.ndarray) -> float:

@@ -378,3 +378,15 @@ def test_top_words_shape(mesh_dp8, docs):
     top = app.top_words(0, k=5)
     assert top.shape == (5,)
     assert (top < V).all()
+
+
+def test_docblock_zero_token_corpus(mesh_dp8):
+    # regression: doc_ends broadcast ValueError on an empty stream
+    tw = np.zeros(0, np.int32)
+    td = np.zeros(0, np.int32)
+    lda = LightLDA(tw, td, 4,
+                   LDAConfig(num_topics=128, batch_tokens=2048,
+                             sampler="tiled", doc_blocked=True,
+                             block_tokens=256),
+                   mesh=mesh_dp8, name="lda_empty")
+    lda.sweep()

@@ -89,3 +89,14 @@ def test_regularization_shrinks_weights(mesh8):
         w, _ = app.table.get(keys)
         accs[nm] = float(np.abs(w).mean())
     assert accs["reg"] < accs["noreg"]
+
+
+def test_all_zero_minibatch(mesh8):
+    # regression: a minibatch whose rows all have zero-valued features
+    # (use_bias=False) made _positions index an empty unique-key array
+    app = SparseLogisticRegression(SparseLRConfig(
+        num_classes=2, max_features=4, capacity=1 << 12, use_bias=False))
+    loss = app.train_batch([[(1, 0.0), (2, 0.0)], []],
+                           np.array([0, 1], np.int32))
+    assert np.isfinite(loss)
+    assert len(app.table) == 0  # nothing was inserted
