@@ -47,10 +47,18 @@ invariant- and convergence-tested):
    XLA gather/scatter. Data-parallel across chips via shard_map
    (per-chip blocks + psum'd summary deltas).
 
+Every sampler runs on dp x mp meshes. For the tiled family the word
+table (and the stale modes' bf16 mirror) stays row-sharded over the
+model axis — the reference's Meta vocab-slicing role: per-step word-row
+gathers are partial-gather + psum over the model axis (exact — each row
+lives in one shard) and the per-sweep master rebuild scatters each
+chip's data shard into its vocab slice, psum'd over the data axis, so
+no chip ever materialises the full [V, K].
+
 Counts live in:
 - ``SparseMatrixTable [V, K] int32`` — word-topic counts (row-sharded
   over the mesh model axis like the reference's server shards; the
-  tiled samplers store it tile-aligned and are DP-only),
+  tiled samplers store it tile-aligned),
 - ``ArrayTable [K] int32`` — topic summary,
 - a worker-local doc-topic array (dense ``[D, K]``, or int16 blocked
   ``[NB, MAXD, C, 128]`` in doc_blocked mode — the reference keeps
@@ -176,13 +184,13 @@ class LightLDA:
             raise ValueError(
                 f"stale_words/doc_blocked are sampler='tiled' modes; "
                 f"got sampler={c.sampler!r}")
-        if tiled and self.mesh.shape[core.MODEL_AXIS] > 1:
-            # the pallas samplers scale over the DATA axis (shard_map
-            # per-chip grids + psum); model-axis K/V sharding needs
-            # XLA-inserted gather collectives — use sampler='gibbs'
-            raise ValueError(
-                "sampler='tiled' is data-parallel only (model axis must "
-                "be 1); use sampler='gibbs' for model-parallel sharding")
+        # tiled samplers support dp x mp meshes: the word-topic table and
+        # its bf16 mirror stay row-sharded over the model axis (each chip
+        # holds a [V/mp] vocab slice — the reference's Meta vocab-slicing
+        # role); per-step word-row gathers are partial-gather + psum over
+        # the model axis (exact: each row lives in exactly one shard) and
+        # the per-sweep master rebuild scatters each chip's data shard
+        # into its vocab slice, psum'd over the data axis.
         # the pallas kernel needs the Mosaic TPU backend; on a CPU mesh
         # (tests) it runs in interpreter mode
         self._interpret = tiled and \
@@ -426,14 +434,44 @@ class LightLDA:
         self._ndk = ndk
         self.summary.put_raw(nk)
 
+    def _build_word_gather(self):
+        """``take(mirror, w)`` with the word table row-sharded over the
+        model axis: each chip gathers the rows its vocab slice owns and
+        the partials psum over ICI — exact (a row lives in exactly one
+        shard), no chip ever materialises the full [V, K]. This is the
+        TPU shape of the reference's Meta vocab-slicing: a worker fetches
+        word rows per slice instead of holding the whole model.
+        Works for any [*, C, 128] storage dtype (bf16 mirror, int32
+        master for eval). mp == 1 degenerates to a plain gather."""
+        mp = self.mesh.shape[core.MODEL_AXIS]
+        if mp == 1:
+            return lambda mirror, w: jnp.take(mirror, w, axis=0)
+        from jax import shard_map
+        d, m = core.DATA_AXIS, core.MODEL_AXIS
+        vshard = self.word_topic.storage_shape[0] // mp
+
+        def local(ws_local, w):
+            lo = lax.axis_index(m) * vshard
+            idx = w - lo
+            ok = (idx >= 0) & (idx < vshard)
+            rows = jnp.take(ws_local, jnp.clip(idx, 0, vshard - 1),
+                            axis=0)
+            rows = jnp.where(ok[:, None, None], rows,
+                             jnp.zeros((), rows.dtype))
+            return lax.psum(rows, m)
+
+        return shard_map(local, mesh=self.mesh,
+                         in_specs=(P(m, None, None), P(d)),
+                         out_specs=P(d, None, None), check_vma=False)
+
     def _wrap_kernel_dp(self, fn):
         """Multi-chip dispatch for the pallas sampler: a Mosaic custom
-        call cannot be auto-partitioned by XLA, so under data
-        parallelism each chip runs the kernel on its own token shard via
-        ``shard_map`` and the topic-summary delta is psum'd over ICI
-        (the tiled samplers are DP-only; model-parallel K/V sharding
-        stays with the XLA 'gibbs' sampler)."""
-        if self.mesh.shape[core.DATA_AXIS] == 1:
+        call cannot be auto-partitioned by XLA, so on any multi-device
+        mesh each chip runs the kernel on its own token shard via
+        ``shard_map`` (token shards over the data axis, operands
+        replicated over the model axis) and the topic-summary delta is
+        psum'd over ICI."""
+        if self.mesh.devices.size == 1:
             return fn
         from jax import shard_map
         d = core.DATA_AXIS
@@ -453,7 +491,7 @@ class LightLDA:
         """Doc-blocked analog of :meth:`_wrap_kernel_dp`: kernel blocks
         shard over the data axis (each chip exclusively owns its blocks'
         doc counts — the block layout IS the DP partition)."""
-        if self.mesh.shape[core.DATA_AXIS] == 1:
+        if self.mesh.devices.size == 1:
             return fn
         from jax import shard_map
         d = core.DATA_AXIS
@@ -473,30 +511,67 @@ class LightLDA:
     def _build_stale_helpers(self) -> None:
         """Per-sweep word-count helpers shared by the stale modes: the
         bf16 gather mirror and the int32 master rebuild from z (z may be
-        the flat stream or the blocked packing — flattened either way)."""
+        the flat stream or the blocked packing — flattened either way).
+        Both keep the word table sharded over the model axis: the mirror
+        is an elementwise cast (sharding-preserving) and the rebuild
+        scatters each chip's DATA shard of the stream into its own vocab
+        slice, psum'd over the data axis — no chip ever holds [V, K]."""
+        mp = self.mesh.shape[core.MODEL_AXIS]
 
         @jax.jit
         def to_stale(nwk3):
             return nwk3.astype(jnp.bfloat16)
 
-        @jax.jit
-        def rebuild(z, tw, m):
-            zf = z.reshape(-1)
-            nwk3 = jnp.zeros(self.word_topic.storage_shape, jnp.int32)
-            return nwk3.at[tw, zf // 128, zf % 128].add(m)
+        if mp == 1:
+            @jax.jit
+            def rebuild(z, tw, m):
+                zf = z.reshape(-1)
+                nwk3 = jnp.zeros(self.word_topic.storage_shape, jnp.int32)
+                return nwk3.at[tw, zf // 128, zf % 128].add(m)
+        else:
+            from jax import shard_map
+            d, maxis = core.DATA_AXIS, core.MODEL_AXIS
+            vshard = self.word_topic.storage_shape[0] // mp
+            tail = self.word_topic.storage_shape[1:]
+
+            def local(zf, tw, m):
+                lo = lax.axis_index(maxis) * vshard
+                idx = tw - lo
+                ok = (idx >= 0) & (idx < vshard)
+                add = jnp.where(ok, m, 0)
+                nwk3 = jnp.zeros((vshard,) + tail, jnp.int32)
+                nwk3 = nwk3.at[jnp.clip(idx, 0, vshard - 1),
+                               zf // 128, zf % 128].add(add)
+                return lax.psum(nwk3, d)
+
+            sharded = shard_map(local, mesh=self.mesh,
+                                in_specs=(P(d), P(d), P(d)),
+                                out_specs=P(maxis, None, None),
+                                check_vma=False)
+
+            @jax.jit
+            def rebuild(z, tw, m):
+                return sharded(z.reshape(-1), tw, m)
 
         self._to_stale = to_stale
         self._rebuild = rebuild
+        self._gather_w = self._build_word_gather()
 
     def _build_blocked_loglik(self) -> None:
         """Eval over tile-aligned doc counts, shared by tiled and
         doc-blocked layouts: ``rows`` index the flattened [*, C, 128]
         doc-count storage (plain doc ids for the dense layout, packed
-        block rows for doc_blocked)."""
+        block rows for doc_blocked). Word rows come through the sharded
+        gather, so eval never materialises the full [V, K] on one chip
+        under model parallelism."""
         alpha, beta = self.alpha, self.beta
         K = self.K
         vbeta = self.V * beta
         tiles = K // 128
+        # reuse the training gather when a stale mode built one — eval
+        # and training must gather identically
+        gather_w = getattr(self, "_gather_w", None) or \
+            self._build_word_gather()
 
         @jax.jit
         def loglik(nwk3, ndk, nk, ws, rows, mask):
@@ -506,8 +581,7 @@ class LightLDA:
             ndk_flat = ndk.reshape(-1, tiles, 128)
             A = jnp.take(ndk_flat, rows, axis=0).reshape(n, K) \
                 .astype(jnp.float32)
-            W = jnp.take(nwk3, ws, axis=0).reshape(n, K) \
-                .astype(jnp.float32)
+            W = gather_w(nwk3, ws).reshape(n, K).astype(jnp.float32)
             S = nk[:K].astype(jnp.float32)
             return _predictive_ll(A, W, S, m, alpha, beta, K, vbeta)
 
@@ -534,13 +608,15 @@ class LightLDA:
             gibbs_sample_docblock(ndk_c, W3, sinv, zi, drel, msk, u1,
                                   u2, alpha=alpha, beta=beta, tb=TB,
                                   interpret=interpret))
+        self._build_stale_helpers()
+        gather_w = self._gather_w
 
         def scan_body(wstale, carry, inp):
             nk, ndk, z = carry
             w, drel, msk, off, key = inp
             ndk_c = lax.dynamic_slice_in_dim(ndk, off, nbs)
             zi = lax.dynamic_slice_in_dim(z, off, nbs).reshape(B)
-            W3 = jnp.take(wstale, w.reshape(B), axis=0)
+            W3 = gather_w(wstale, w.reshape(B))
             sinv = 1.0 / (nk[:K].astype(jnp.float32).reshape(tiles, 128)
                           + vbeta)
             k1, k2 = jax.random.split(key)
@@ -568,7 +644,6 @@ class LightLDA:
         self._fused = make_superstep((self.summary,), body,
                                      name="lda_docblock")
 
-        self._build_stale_helpers()
         self._build_blocked_loglik()
 
     # -- count init --------------------------------------------------------
@@ -738,12 +813,16 @@ class LightLDA:
             return nk, ndk3, z, zi, znew
 
         if stale:
-            # word rows from the per-sweep bf16 mirror; no per-step
+            # word rows from the per-sweep bf16 mirror (sharded gather —
+            # the mirror stays a vocab slice per chip); no per-step
             # word-count scatters (master rebuilt from z at sweep end)
+            self._build_stale_helpers()
+            gather_w = self._gather_w
+
             def scan_body(wstale, carry, inp):
                 nk, ndk3, z = carry
                 w, d, off, msk, key = inp
-                W3 = jnp.take(wstale, w, axis=0)
+                W3 = gather_w(wstale, w)
                 nk, ndk3, z, _, _ = sample_and_update(
                     nk, ndk3, z, W3, w, d, off, msk, key)
                 return (nk, ndk3, z), ()
@@ -760,8 +839,6 @@ class LightLDA:
 
             self._fused = make_superstep((self.summary,), body,
                                          name="lda_tiled_stale")
-
-            self._build_stale_helpers()
         else:
             def scan_body(carry, inp):
                 nwk3, nk, ndk3, z = carry

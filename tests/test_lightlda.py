@@ -390,3 +390,74 @@ def test_docblock_zero_token_corpus(mesh_dp8):
                              block_tokens=256),
                    mesh=mesh_dp8, name="lda_empty")
     lda.sweep()
+
+
+def _run_docblock(mesh, docs, name, batch_tokens=2048):
+    tw, td, V = docs
+    app = LightLDA(tw, td, V,
+                   LDAConfig(num_topics=128, batch_tokens=batch_tokens,
+                             steps_per_call=2, seed=1, sampler="tiled",
+                             doc_blocked=True, block_tokens=256,
+                             block_docs=8),
+                   mesh=mesh, name=name)
+    app.train(num_iterations=3)
+    return app
+
+
+def test_docblock_model_parallel_matches_dp(devices, docs):
+    """The model-axis sharding (vocab-sliced word table, sharded gather +
+    psum) must be EXACTLY the dp-only computation: every partial-gather
+    row lives in one shard and the rebuild psum is integer, so z and all
+    counts are bit-identical between a pure-DP mesh and a dp x mp mesh."""
+    from multiverso_tpu import core
+    mesh_dp = core.init(devices=devices, data_parallel=8, model_parallel=1)
+    ref = _run_docblock(mesh_dp, docs, "lda_mp_ref")
+    ref_w, ref_d = ref.word_topics(), ref.doc_topics()
+    ref_nk = np.asarray(ref.summary.get())
+    ref_ll = ref.ll_history[-1]
+    table_base.reset_tables()
+    core.shutdown()
+
+    mesh_mp = core.init(devices=devices, data_parallel=4, model_parallel=2)
+    app = _run_docblock(mesh_mp, docs, "lda_mp_test")
+    np.testing.assert_array_equal(app.word_topics(), ref_w)
+    np.testing.assert_array_equal(app.doc_topics(), ref_d)
+    np.testing.assert_array_equal(np.asarray(app.summary.get()), ref_nk)
+    np.testing.assert_allclose(app.ll_history[-1], ref_ll, rtol=1e-5)
+    table_base.reset_tables()
+    core.shutdown()
+
+
+def test_tiled_stale_model_parallel(mesh8, docs):
+    """sampler='tiled' + stale_words on a 4x2 mesh: invariants hold and
+    mixing reaches the exact-Gibbs band (the word table and bf16 mirror
+    are vocab-sliced over the model axis)."""
+    tw, td, V = docs
+    app = LightLDA(tw, td, V,
+                   LDAConfig(num_topics=128, batch_tokens=512,
+                             steps_per_call=4, seed=1, sampler="tiled",
+                             stale_words=True),
+                   mesh=mesh8, name="lda_mp_stale")
+    app.train(num_iterations=8)
+    nwk = app.word_topics()
+    nk = np.asarray(app.summary.get())
+    assert nwk.sum() == app.num_tokens
+    assert np.array_equal(nk[: app.K], nwk.sum(0))
+    assert app.ll_history[-1] > app.ll_history[0] + 0.1
+    assert app.ll_history[-1] > -4.9, app.ll_history
+
+
+def test_tiled_exact_model_parallel(mesh8, docs):
+    """Plain tiled (exact per-step word scatters) on a 4x2 mesh rides
+    GSPMD for the sharded-table gathers/scatters."""
+    tw, td, V = docs
+    app = LightLDA(tw, td, V,
+                   LDAConfig(num_topics=128, batch_tokens=512,
+                             steps_per_call=4, seed=1, sampler="tiled"),
+                   mesh=mesh8, name="lda_mp_exact")
+    app.train(num_iterations=4)
+    nwk = app.word_topics()
+    nk = np.asarray(app.summary.get())
+    assert nwk.sum() == app.num_tokens
+    assert np.array_equal(nk[: app.K], nwk.sum(0))
+    assert app.ll_history[-1] > app.ll_history[0] + 0.1
