@@ -1,0 +1,78 @@
+"""Out-of-core LDA proof: 100M-token corpus on one chip, HBM independent
+of corpus size (VERDICT r2 item 2). Run: python lda_stream_100m.py [T]"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax                                            # noqa: E402
+from multiverso_tpu import core                       # noqa: E402
+from multiverso_tpu.apps.lightlda import LightLDA, LDAConfig  # noqa: E402
+
+T = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000_000
+V, K = 50_000, 1024
+D = T // 100                                          # ~100 tokens/doc
+rng = np.random.default_rng(0)
+p = 1.0 / np.arange(1, V + 1) ** 1.1
+p /= p.sum()
+t0 = time.perf_counter()
+tw = rng.choice(V, T, p=p).astype(np.int32)
+td = np.sort(rng.integers(0, D, T)).astype(np.int32)
+print(f"gen: {time.perf_counter()-t0:.0f}s", flush=True)
+
+core.init()
+dev = jax.devices()[0]
+
+
+def hbm_mb():
+    """Device-resident MB. memory_stats() when the PJRT plugin exposes
+    it; otherwise sum the live committed device arrays — the measurable
+    that substantiates 'HBM use independent of corpus size'."""
+    try:
+        stats = dev.memory_stats()
+        if stats and "bytes_in_use" in stats:
+            return round(stats["bytes_in_use"] / 2**20, 1)
+    except Exception:
+        pass
+    return round(sum(a.nbytes for a in jax.live_arrays()) / 2**20, 1)
+
+
+t0 = time.perf_counter()
+app = LightLDA(tw, td, V, LDAConfig(
+    num_topics=K, batch_tokens=2_097_152, steps_per_call=4, seed=1,
+    sampler="tiled", stale_words=True, doc_blocked=True,
+    stream_blocks=True))
+print(f"setup+init: {time.perf_counter()-t0:.0f}s  "
+      f"calls/sweep={app.calls_per_sweep}  fill={app.packing_fill:.2f}  "
+      f"hbm={hbm_mb():.0f}MB", flush=True)
+
+results = {"tokens": T, "vocab": V, "topics": K, "docs": D,
+           "fill": app.packing_fill, "hbm_mb_after_init": hbm_mb(),
+           "sweeps": []}
+
+
+def sync():
+    return float(np.asarray(app.summary.raw())[0])
+
+
+for it in range(3):
+    t0 = time.perf_counter()
+    app.sweep()
+    sync()
+    dt = time.perf_counter() - t0
+    print(f"sweep {it}: {T/dt:,.0f} tok/s ({dt:.1f}s) hbm={hbm_mb():.0f}MB",
+          flush=True)
+    results["sweeps"].append({"secs": dt, "tok_per_sec": T / dt,
+                              "hbm_mb": hbm_mb()})
+ll = app.loglik()
+print(f"loglik/token: {ll:.4f}", flush=True)
+results["loglik"] = ll
+out = os.path.join(os.path.dirname(__file__),
+                   f"lda_stream_{T // 1_000_000}m.json")
+with open(out, "w") as f:
+    json.dump(results, f, indent=2)
+    f.write("\n")

@@ -110,6 +110,18 @@ class LDAConfig:
     # (VMEM matmuls), the fastest sampler (see benchmarks/README.md)
     block_tokens: int = 512         # doc_blocked: tokens per kernel block
     block_docs: int = 16            # doc_blocked: max docs per block
+    stream_blocks: bool = False     # doc_blocked only: OUT-OF-CORE mode —
+    # the packed token stream, z assignments, and doc counts stay
+    # HOST-resident (the reference streams doc blocks from disk; SURVEY
+    # §3.6 DataBlock role). Each superstep call stages one [S, B] slice
+    # of (words, doc-rows, z) to device through a double-buffered
+    # prefetch (utils.async_buffer), the blocked doc counts are REBUILT
+    # on device from z (they are a pure function of it — cheaper than
+    # round-tripping 64B/token of counts), and z comes back per call.
+    # The word master updates incrementally from (z_in, z_out) instead
+    # of a sweep-end full-stream rebuild (integer-identical). Device HBM
+    # use is INDEPENDENT of corpus size: word table + mirror + summary
+    # + two in-flight call buffers.
     mh_steps: int = 2               # MH: rounds of (word + doc) proposal
     precision: str = "float32"      # posterior/CDF math dtype; bfloat16
     # is measured equal-speed at large batches (the op mix is not
@@ -184,6 +196,8 @@ class LightLDA:
             raise ValueError(
                 f"stale_words/doc_blocked are sampler='tiled' modes; "
                 f"got sampler={c.sampler!r}")
+        if c.stream_blocks and not c.doc_blocked:
+            raise ValueError("stream_blocks requires doc_blocked=True")
         # tiled samplers support dp x mp meshes: the word-topic table and
         # its bf16 mirror stay row-sharded over the model axis (each chip
         # holds a [V/mp] vocab slice — the reference's Meta vocab-slicing
@@ -225,7 +239,11 @@ class LightLDA:
             # blocked layout replaces the dense [D+1, K] doc counts and
             # the permuted-stream staging entirely
             self._setup_docblock(token_words, token_docs, ndk_dtype)
-            self._build_docblock_superstep()
+            if c.stream_blocks:
+                self._build_docblock_stream_superstep()
+                self._init_streamed_counts()
+            else:
+                self._build_docblock_superstep()
             self._key = core.prng_key(c.seed, mesh=self.mesh)
             self._calls_done = 0
             self.ll_history = []
@@ -380,8 +398,31 @@ class LightLDA:
                 self._row_of_doc[doc_ids[di]] = r
                 off += ln
         fill = mask_p.sum() / max(nb_pad * TB, 1)
+        self.packing_fill = float(fill)
         log.info("lda doc_blocked: %d blocks (%d/call, %.0f%% fill)",
                  nb_pad, per_call, 100 * fill)
+        self._per_call = per_call
+
+        # random init z (shared by both residency modes so the streamed
+        # and in-memory runs are bit-identical for the same seed)
+        rng = np.random.default_rng(c.seed)
+        z0 = rng.integers(0, self.K, (nb_pad, TB)).astype(np.int32)
+
+        if c.stream_blocks:
+            # OUT-OF-CORE: stream/z/doc-counts stay host-resident (the
+            # reference's disk-streamed DataBlocks); mask is derived on
+            # device (tw == scratch_word <=> padded lane)
+            self._tw_host = tw_p
+            self._drel_host = drel_p
+            self._z_host = z0
+            self._ndk = None
+            # inverse packing map for doc_topics(): (block, row) -> doc
+            self._doc_of_row = np.full((nb_pad, MAXD), -1, np.int64)
+            valid = self._blk_of_doc >= 0
+            self._doc_of_row[self._blk_of_doc[valid],
+                             self._row_of_doc[valid]] = \
+                np.nonzero(valid)[0]
+            return
 
         # per-call staging: [S, B] lanes + per-step block offsets
         spec = P(None, core.DATA_AXIS)
@@ -408,9 +449,6 @@ class LightLDA:
         self._tw_flat = self._place(tw_p.reshape(-1), P())
         self._mask_flat = self._place(mask_p.reshape(-1), P())
 
-        # random init z + counts (blocked ndk built by flat-row scatter)
-        rng = np.random.default_rng(c.seed)
-        z0 = rng.integers(0, self.K, (nb_pad, TB)).astype(np.int32)
         self._z = self._place(z0, P())
         drel_dev = self._place(drel_p, P())
         tiles = self.K // 128
@@ -508,6 +546,33 @@ class LightLDA:
             out_specs=(P(d, None, None, None), Pb, P(None, None)),
             check_vma=False)
 
+    def _build_vocab_slice_scatter(self):
+        """shard_map'd count scatter for a model-sharded word table:
+        each chip scatters its DATA shard's in-range tokens into its
+        vocab slice, psum over the data axis. Shared by the per-sweep
+        rebuild and the streamed master accumulator (one copy of the
+        slice math). Returns f(z_flat, tw, msk) -> [V/mp, C, 128]."""
+        from jax import shard_map
+        d, maxis = core.DATA_AXIS, core.MODEL_AXIS
+        mp = self.mesh.shape[maxis]
+        vshard = self.word_topic.storage_shape[0] // mp
+        tail = self.word_topic.storage_shape[1:]
+
+        def local(zf, tw, m):
+            lo = lax.axis_index(maxis) * vshard
+            idx = tw - lo
+            ok = (idx >= 0) & (idx < vshard)
+            add = jnp.where(ok, m, 0)
+            nwk3 = jnp.zeros((vshard,) + tail, jnp.int32)
+            nwk3 = nwk3.at[jnp.clip(idx, 0, vshard - 1),
+                           zf // 128, zf % 128].add(add)
+            return lax.psum(nwk3, d)
+
+        return shard_map(local, mesh=self.mesh,
+                         in_specs=(P(d), P(d), P(d)),
+                         out_specs=P(maxis, None, None),
+                         check_vma=False)
+
     def _build_stale_helpers(self) -> None:
         """Per-sweep word-count helpers shared by the stale modes: the
         bf16 gather mirror and the int32 master rebuild from z (z may be
@@ -529,25 +594,7 @@ class LightLDA:
                 nwk3 = jnp.zeros(self.word_topic.storage_shape, jnp.int32)
                 return nwk3.at[tw, zf // 128, zf % 128].add(m)
         else:
-            from jax import shard_map
-            d, maxis = core.DATA_AXIS, core.MODEL_AXIS
-            vshard = self.word_topic.storage_shape[0] // mp
-            tail = self.word_topic.storage_shape[1:]
-
-            def local(zf, tw, m):
-                lo = lax.axis_index(maxis) * vshard
-                idx = tw - lo
-                ok = (idx >= 0) & (idx < vshard)
-                add = jnp.where(ok, m, 0)
-                nwk3 = jnp.zeros((vshard,) + tail, jnp.int32)
-                nwk3 = nwk3.at[jnp.clip(idx, 0, vshard - 1),
-                               zf // 128, zf % 128].add(add)
-                return lax.psum(nwk3, d)
-
-            sharded = shard_map(local, mesh=self.mesh,
-                                in_specs=(P(d), P(d), P(d)),
-                                out_specs=P(maxis, None, None),
-                                check_vma=False)
+            sharded = self._build_vocab_slice_scatter()
 
             @jax.jit
             def rebuild(z, tw, m):
@@ -557,6 +604,47 @@ class LightLDA:
         self._rebuild = rebuild
         self._gather_w = self._build_word_gather()
 
+    def _eval_chunk(self, n: int) -> int:
+        """Largest chunk of ~64k tokens that divides ``n`` and keeps the
+        data-axis sharding valid: eval gathers materialise [chunk, K]
+        f32 intermediates, which must stay bounded no matter how large a
+        call is (an unchunked 8M-token call at K=1024 wants 34 GB)."""
+        dp = self.mesh.shape[core.DATA_AXIS]
+        c = n
+        while c > (1 << 16) and c % 2 == 0 and (c // 2) % dp == 0:
+            c //= 2
+        return c
+
+    def _chunked_ll(self, gather_w):
+        """Chunked predictive-likelihood core shared by the in-memory
+        and streamed evals (ONE copy of the chunk/gather math): scans
+        [chunk, K] gathers so eval intermediates stay bounded no matter
+        the call size (see :meth:`_eval_chunk`)."""
+        alpha, beta = self.alpha, self.beta
+        K = self.K
+        vbeta = self.V * beta
+        chunk = self._eval_chunk
+
+        def run(nwk3, ndk_flat, Ssum, ws, rows, m):
+            c = chunk(ws.shape[0])
+
+            def step(tot, xs):
+                wsc, rc, mc = xs
+                A = jnp.take(ndk_flat, rc, axis=0).reshape(c, K) \
+                    .astype(jnp.float32)
+                W = gather_w(nwk3, wsc).reshape(c, K) \
+                    .astype(jnp.float32)
+                return tot + _predictive_ll(A, W, Ssum, mc, alpha,
+                                            beta, K, vbeta), None
+
+            tot, _ = lax.scan(
+                step, jnp.zeros((), jnp.float32),
+                (ws.reshape(-1, c), rows.reshape(-1, c),
+                 m.reshape(-1, c)))
+            return tot
+
+        return run
+
     def _build_blocked_loglik(self) -> None:
         """Eval over tile-aligned doc counts, shared by tiled and
         doc-blocked layouts: ``rows`` index the flattened [*, C, 128]
@@ -564,30 +652,28 @@ class LightLDA:
         block rows for doc_blocked). Word rows come through the sharded
         gather, so eval never materialises the full [V, K] on one chip
         under model parallelism."""
-        alpha, beta = self.alpha, self.beta
         K = self.K
-        vbeta = self.V * beta
         tiles = K // 128
         # reuse the training gather when a stale mode built one — eval
         # and training must gather identically
         gather_w = getattr(self, "_gather_w", None) or \
             self._build_word_gather()
+        run = self._chunked_ll(gather_w)
 
         @jax.jit
         def loglik(nwk3, ndk, nk, ws, rows, mask):
-            ws, rows = ws.reshape(-1), rows.reshape(-1)
-            m = mask.reshape(-1).astype(jnp.float32)
-            n = ws.shape[0]
-            ndk_flat = ndk.reshape(-1, tiles, 128)
-            A = jnp.take(ndk_flat, rows, axis=0).reshape(n, K) \
-                .astype(jnp.float32)
-            W = gather_w(nwk3, ws).reshape(n, K).astype(jnp.float32)
-            S = nk[:K].astype(jnp.float32)
-            return _predictive_ll(A, W, S, m, alpha, beta, K, vbeta)
+            return run(nwk3, ndk.reshape(-1, tiles, 128),
+                       nk[:K].astype(jnp.float32), ws.reshape(-1),
+                       rows.reshape(-1),
+                       mask.reshape(-1).astype(jnp.float32))
 
         self._loglik = loglik
 
-    def _build_docblock_superstep(self) -> None:
+    def _build_docblock_kernel(self) -> None:
+        """The IN-MEMORY doc-blocked superstep's kernel dispatch + scan
+        body (the streamed mode builds its own scan body around the
+        count-building kernel variant — same draw math, verified
+        bit-identical by tests/test_lightlda.py)."""
         c = self.config
         alpha, beta = self.alpha, self.beta
         vbeta = self.V * beta
@@ -631,6 +717,12 @@ class LightLDA:
             nk = nk.at[:K].add(nkd.reshape(-1))
             return (nk, ndk, z), ()
 
+        self._db_scan_body = scan_body
+
+    def _build_docblock_superstep(self) -> None:
+        self._build_docblock_kernel()
+        scan_body = self._db_scan_body
+
         def body(params, states, locals_, options, wstale, ws, drels,
                  msks, offs, key):
             (nk,) = params
@@ -645,6 +737,219 @@ class LightLDA:
                                      name="lda_docblock")
 
         self._build_blocked_loglik()
+
+    # -- out-of-core (streamed) doc-blocked mode ---------------------------
+
+    def _build_master_accumulate(self):
+        """(acc, z, w, mask) -> acc with ``counts(z)`` of the call's
+        tokens added. ``acc`` is a donated carry: the single-device path
+        scatters IN PLACE (no full-table temporary per call — measured
+        ~0.2s/sweep of HBM traffic at V=50k, K=1024). Under model
+        parallelism each chip scatters its data shard's in-range tokens
+        into a vocab-slice delta, psum'd over the data axis (the
+        per-sweep-rebuild pattern)."""
+        mp = self.mesh.shape[core.MODEL_AXIS]
+        if mp == 1:
+            def accumulate(acc, z, tw, msk):
+                return acc.at[tw, z // 128, z % 128].add(msk)
+            return accumulate
+        delta = self._build_vocab_slice_scatter()
+
+        def accumulate(acc, z, tw, msk):
+            return acc + delta(z, tw, msk)
+
+        return accumulate
+
+    def _wrap_docblock_build_dp(self, fn):
+        """shard_map dispatch for the count-building kernel (no blocked
+        count array: z is the only sampler state)."""
+        if self.mesh.devices.size == 1:
+            return fn
+        from jax import shard_map
+        d = core.DATA_AXIS
+        Pb = P(d)
+
+        def local(W3, sinv, zi, drel, msk, u1, u2):
+            znew, nkd = fn(W3, sinv, zi, drel, msk, u1, u2)
+            return znew, lax.psum(nkd, d)
+
+        return shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P(d, None, None), P(None, None), Pb, Pb, Pb, Pb,
+                      Pb),
+            out_specs=(Pb, P(None, None)), check_vma=False)
+
+    def _build_docblock_stream_superstep(self) -> None:
+        c = self.config
+        alpha, beta = self.alpha, self.beta
+        vbeta = self.V * beta
+        K = self.K
+        S, B, TB = c.steps_per_call, c.batch_tokens, self._tb
+        nbs, MAXD = B // TB, self._maxd
+        dp = self.mesh.shape[core.DATA_AXIS]
+        if nbs % dp:
+            raise ValueError(
+                f"doc_blocked: blocks per step {nbs} not divisible by "
+                f"data-axis size {dp}")
+        tiles = K // 128
+        scratch = self._scratch_word
+        interpret = self._interpret
+        from multiverso_tpu.ops import gibbs_sample_docblock_build
+        sampler_call = self._wrap_docblock_build_dp(
+            lambda W3, sinv, zi, drel, msk, u1, u2:
+            gibbs_sample_docblock_build(
+                W3, sinv, zi, drel, msk, u1, u2, alpha=alpha, beta=beta,
+                tb=TB, maxd=MAXD, interpret=interpret))
+        self._build_stale_helpers()
+        gather_w = self._gather_w
+        accumulate = self._build_master_accumulate()
+        self._stage_sharding = NamedSharding(
+            self.mesh, P(None, None, core.DATA_AXIS))
+
+        def unpack(stacked):
+            tw, drel, z_in = stacked[0], stacked[1], stacked[2]
+            msk = (tw != scratch).astype(jnp.int32)
+            j = jnp.arange(S * B, dtype=jnp.int32)
+            rows = (j // TB) * MAXD + drel.reshape(-1)
+            return tw, drel, z_in, msk, rows
+
+        def scan_body(wstale, carry, inp):
+            nk, z = carry
+            w, drel, msk, off, key = inp
+            zi = lax.dynamic_slice_in_dim(z, off, nbs).reshape(B)
+            W3 = gather_w(wstale, w.reshape(B))
+            sinv = 1.0 / (nk[:K].astype(jnp.float32).reshape(tiles, 128)
+                          + vbeta)
+            k1, k2 = jax.random.split(key)
+            u1 = jax.random.uniform(k1, (B,))
+            u2 = jax.random.uniform(k2, (B,))
+            znew, nkd = sampler_call(W3, sinv, zi, drel.reshape(B),
+                                     msk.reshape(B), u1, u2)
+            z = lax.dynamic_update_slice_in_dim(
+                z, znew.reshape(nbs, TB), off, 0)
+            nk = nk.at[:K].add(nkd.reshape(-1))
+            return (nk, z), ()
+
+        def body(params, states, locals_, options, wstale, stacked, key):
+            (nk,) = params
+            (acc,) = locals_   # fresh word-count accumulator: over one
+            # sweep the per-call +/- master deltas TELESCOPE to
+            # counts(z_end) (the subtracted counts(z_start) equal the
+            # old master exactly), so one add-only scatter pass per call
+            # into a fresh accumulator — swapped in at sweep end —
+            # halves the scatter traffic of an incremental +/- update
+            tw, drel, z_in, msk, _rows = unpack(stacked)
+            z = z_in.reshape(S * nbs, TB)
+            offs = jnp.arange(S, dtype=jnp.int32) * nbs
+            keys = jax.random.split(key, S)
+            (nk, z), _ = lax.scan(
+                lambda cy, inp: scan_body(wstale, cy, inp),
+                (nk, z), (tw, drel, msk, offs, keys))
+            z_out = z.reshape(S, B)
+            acc = accumulate(acc, z_out.reshape(-1), tw.reshape(-1),
+                             msk.reshape(-1))
+            return (nk,), states, (acc,), z_out
+
+        self._fused_stream = make_superstep(
+            (self.summary,), body,
+            local_shardings=(self.word_topic.sharding,),
+            name="lda_docblock_stream")
+
+        # streamed eval: stage (tw, drel, z), rebuild the call's doc
+        # counts from z (XLA scatter — eval is periodic, not the hot
+        # loop), gather word rows through the sharded gather
+        def build_ndk(zf, rows, m):
+            ndk = jnp.zeros((S * nbs * MAXD, tiles, 128), jnp.int16)
+            return ndk.at[rows, zf // 128, zf % 128].add(
+                m.astype(jnp.int16))
+
+        run = self._chunked_ll(gather_w)
+
+        @jax.jit
+        def loglik_stream(nwk3, nk, stacked):
+            tw, _drel, z_in, msk, rows = unpack(stacked)
+            ndk = build_ndk(z_in.reshape(-1), rows, msk.reshape(-1))
+            return run(nwk3, ndk, nk[:K].astype(jnp.float32),
+                       tw.reshape(-1), rows,
+                       msk.reshape(-1).astype(jnp.float32))
+
+        self._loglik_stream = loglik_stream
+
+        # per-call count init (the in-memory mode's build(), one staged
+        # call at a time so HBM never sees the whole stream)
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def init_call(master, nk, stacked):
+            tw, _drel, z_in, msk, _rows = unpack(stacked)
+            zf = z_in.reshape(-1)
+            mf = msk.reshape(-1)
+            master = accumulate(master, zf, tw.reshape(-1), mf)
+            nk = nk.at[zf].add(mf)
+            return master, nk
+
+        self._init_call = init_call
+
+    def _stream_stage(self, k: int) -> np.ndarray:
+        """Host side of staging call ``k``: one stacked [3, S, B] int32
+        array (words, doc-rows, z) — a single H2D transfer per call."""
+        c = self.config
+        S, B = c.steps_per_call, c.batch_tokens
+        sl = slice(k * self._per_call, (k + 1) * self._per_call)
+        return np.stack([self._tw_host[sl].reshape(S, B),
+                         self._drel_host[sl].reshape(S, B),
+                         self._z_host[sl].reshape(S, B)])
+
+    def _stream_calls(self):
+        """Double-buffered H2D pipeline: host slices are stacked on a
+        prefetch thread (utils.async_buffer) and device_put (async) from
+        the consumer, so call k+1's transfer overlaps call k's sweep."""
+        from multiverso_tpu.utils.async_buffer import prefetch_iterator
+
+        def gen():
+            for k in range(self.calls_per_sweep):
+                yield k, self._stream_stage(k)
+
+        for k, stacked in prefetch_iterator(gen(), depth=2):
+            yield k, jax.device_put(stacked, self._stage_sharding)
+
+    def _init_streamed_counts(self) -> None:
+        master = jnp.zeros(self.word_topic.storage_shape, jnp.int32)
+        master = jax.device_put(master, self.word_topic.sharding)
+        nk = jnp.zeros(self.summary.padded_shape, jnp.int32)
+        nk = jax.device_put(nk, self.summary.sharding)
+        for _k, dev in self._stream_calls():
+            master, nk = self._init_call(master, nk, dev)
+        self.word_topic.put_raw(master)
+        self.summary.put_raw(nk)
+
+    def _sweep_streamed(self) -> None:
+        wstale = self._to_stale(self.word_topic.raw())
+        per_call, TB = self._per_call, self._tb
+        # fresh accumulator: after the sweep it IS the new master
+        # (counts telescope — see the superstep body)
+        acc = jax.device_put(
+            jnp.zeros(self.word_topic.storage_shape, jnp.int32),
+            self.word_topic.sharding)
+        pending: list = []
+
+        def drain(item):
+            k, z_out = item
+            sl = slice(k * per_call, (k + 1) * per_call)
+            self._z_host[sl] = np.asarray(z_out).reshape(per_call, TB)
+
+        for k, dev in self._stream_calls():
+            key = jax.random.fold_in(self._key, self._calls_done)
+            self._calls_done += 1
+            (acc,), z_out = self._fused_stream((acc,), wstale, dev, key)
+            try:
+                z_out.copy_to_host_async()
+            except AttributeError:
+                pass
+            pending.append((k, z_out))
+            if len(pending) > 2:
+                drain(pending.pop(0))
+        for item in pending:
+            drain(item)
+        self.word_topic.put_raw(acc)
 
     # -- count init --------------------------------------------------------
 
@@ -984,6 +1289,9 @@ class LightLDA:
 
     def sweep(self) -> None:
         """One full sampling pass over the corpus."""
+        if self._docblock and self.config.stream_blocks:
+            self._sweep_streamed()
+            return
         mh = self.config.sampler == "mh"
         if mh:
             wcdf = self._build_wcdf(self.word_topic.raw())
@@ -1049,6 +1357,11 @@ class LightLDA:
         `Eval` role). Evaluates over the pre-placed device-resident call
         slices — the token stream is static, so no host re-upload."""
         total = 0.0
+        if self._docblock and self.config.stream_blocks:
+            for _k, dev in self._stream_calls():
+                total += float(self._loglik_stream(
+                    self.word_topic.raw(), self.summary.raw(), dev))
+            return total / max(self.num_tokens, 1)
         for i, call in enumerate(self._calls):
             if self._docblock:
                 ws, _drels, msks, _offs = call
@@ -1063,6 +1376,20 @@ class LightLDA:
 
     def doc_topics(self) -> np.ndarray:
         """[num_docs, K] doc-topic counts (worker-local state)."""
+        if self._docblock and self.config.stream_blocks:
+            # host-side scatter over the host-resident z (chunked: the
+            # temporaries stay bounded regardless of corpus size)
+            out = np.zeros((self.num_docs, self.K), np.int32)
+            chunk = max(1, (1 << 22) // self._tb)     # ~4M tokens
+            for lo in range(0, self._nb_pad, chunk):
+                sl = slice(lo, lo + chunk)
+                tw, drel = self._tw_host[sl], self._drel_host[sl]
+                z = self._z_host[sl]
+                blocks = np.arange(lo, lo + len(tw))[:, None]
+                docs = self._doc_of_row[blocks, drel]
+                valid = (tw != self._scratch_word) & (docs >= 0)
+                np.add.at(out, (docs[valid], z[valid]), 1)
+            return out
         if self._docblock:
             blocked = np.asarray(self._ndk)
             out = np.zeros((self.num_docs, self.K), np.int32)
@@ -1120,10 +1447,12 @@ class LightLDA:
         if self._docblock:
             # z is indexed in the packed block layout; ndk exports as the
             # dense [D, K] logical counts
-            dense = np.zeros((self.num_docs + 1, self.K),
-                             np.dtype(self._ndk.dtype))
+            ndk_dtype = np.int16 if self.config.stream_blocks \
+                else np.dtype(self._ndk.dtype)
+            dense = np.zeros((self.num_docs + 1, self.K), ndk_dtype)
             dense[:self.num_docs] = self.doc_topics()
-            z = np.asarray(self._z).reshape(-1)
+            z = self._z_host.reshape(-1) if self.config.stream_blocks \
+                else np.asarray(self._z).reshape(-1)
             layout = "docblock"
         else:
             dense = np.asarray(self._ndk).reshape(self.num_docs + 1,
@@ -1178,11 +1507,20 @@ class LightLDA:
         # packing for doc_blocked): a geometry mismatch would yield a
         # wrong-length z whose out-of-range scatters silently corrupt
         # counts (JAX clamps/drops OOB indices)
-        if len(data["z"]) != int(np.prod(self._z.shape)):
+        streamed = self._docblock and self.config.stream_blocks
+        z_shape = self._z_host.shape if streamed else self._z.shape
+        if len(data["z"]) != int(np.prod(z_shape)):
             raise ValueError(
                 f"checkpoint z length {len(data['z'])} != app stream "
-                f"length {int(np.prod(self._z.shape))}: batch/block "
+                f"length {int(np.prod(z_shape))}: batch/block "
                 "geometry must match the checkpointing run to resume")
+        if streamed:
+            # host z is the sampler state; blocked doc counts are derived
+            # from it per call, so the stored dense ndk is not needed
+            self._z_host = np.asarray(data["z"]).reshape(z_shape) \
+                .astype(np.int32)
+            self._calls_done = int(manifest.get("calls_done", 0))
+            return
         self._z = self._place(
             np.asarray(data["z"]).reshape(self._z.shape), P())
         dense = np.asarray(data["ndk"])

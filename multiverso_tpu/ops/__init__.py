@@ -1,7 +1,8 @@
 """Pallas TPU kernels for hot ops (SURVEY.md §8 hard-part #1: LightLDA's
 sampler throughput is the risk buffer XLA alone doesn't cover)."""
 
-from multiverso_tpu.ops.lda_sampler import (gibbs_sample_docblock,
-                                            gibbs_sample_tiled)
+from multiverso_tpu.ops.lda_sampler import (
+    gibbs_sample_docblock, gibbs_sample_docblock_build, gibbs_sample_tiled)
 
-__all__ = ["gibbs_sample_docblock", "gibbs_sample_tiled"]
+__all__ = ["gibbs_sample_docblock", "gibbs_sample_docblock_build",
+           "gibbs_sample_tiled"]

@@ -225,6 +225,96 @@ def _docblock_kernel(ndk_ref, W_ref, sinv_ref, zi_ref, drel_ref, msk_ref,
         maxd, c, LANES)
 
 
+def _docblock_build_kernel(W_ref, sinv_ref, zi_ref, drel_ref, msk_ref,
+                           u1_ref, u2_ref, znew_ref, nkd_ref, *,
+                           alpha: float, beta: float, tb: int, c: int,
+                           maxd: int):
+    """Count-building variant for the OUT-OF-CORE mode: the block's doc
+    counts are not read from HBM but BUILT in VMEM from (zi, drel) by one
+    MXU matmul (E_masked^T @ onehot(zi)) — valid because whole docs live
+    in one block and each block is visited exactly once per sweep, so
+    counts(z) IS the block's doc-count state. No ndk input, no ndk
+    output: z is the only streamed sampler state."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        nkd_ref[:] = jnp.zeros_like(nkd_ref)
+
+    k = c * LANES
+    W = W_ref[:].astype(jnp.float32)               # [TB, C, 128]
+    zi = zi_ref[:]                                 # [TB, 1]
+    drel = drel_ref[:]                             # [TB, 1]
+    one = msk_ref[:]                               # [TB, 1]
+    kc, kk = _lane_iotas(tb, c)
+    self_oh = ((kk == zi[:, :, None]) & (one[:, :, None] > 0))
+    sohf = self_oh.astype(jnp.float32)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (tb, maxd), 1)
+    Em = ((rows == drel) & (one > 0)).astype(jnp.float32)  # [TB, MAXD]
+    ndk = jnp.dot(Em.T, sohf.reshape(tb, k),
+                  preferred_element_type=jnp.float32)      # [MAXD, K]
+    A = jnp.dot(Em, ndk, preferred_element_type=jnp.float32)
+    A3 = A.reshape(tb, c, LANES)
+    probs = _posterior(A3, W, sinv_ref[:], sohf, alpha, beta)
+    zn = _two_level_draw(probs, kc, u1_ref[:], u2_ref[:], tb, c)
+    znew = jnp.where(one[:, 0] > 0, zn, zi[:, 0])
+    znew_ref[:] = znew[:, None]
+    new_oh = ((kk == znew[:, None, None]) & (one[:, :, None] > 0))
+    nkd_ref[:] += (new_oh.astype(jnp.int32)
+                   - self_oh.astype(jnp.int32)).sum(0)
+
+
+@functools.partial(jax.jit, static_argnames=("alpha", "beta", "tb",
+                                             "maxd", "interpret"))
+def gibbs_sample_docblock_build(W3: jax.Array, sinv: jax.Array,
+                                zi: jax.Array, drel: jax.Array,
+                                msk: jax.Array, u1: jax.Array,
+                                u2: jax.Array, *, alpha: float,
+                                beta: float, tb: int, maxd: int,
+                                interpret: bool = False):
+    """Doc-blocked sampler that BUILDS each block's doc counts in VMEM
+    instead of reading/writing a blocked count array (see
+    :func:`_docblock_build_kernel`). Same draw semantics as
+    :func:`gibbs_sample_docblock` — bit-identical znew for real tokens.
+
+    Returns (znew [NB*TB], nk_delta [C, 128]).
+    """
+    b, c, lanes = W3.shape
+    if lanes != LANES:
+        raise ValueError(f"last dim must be {LANES}, got {lanes}")
+    if b % tb:
+        raise ValueError(f"token count {b} not divisible by tb {tb}")
+    nb = b // tb
+    kern = functools.partial(_docblock_build_kernel, alpha=float(alpha),
+                             beta=float(beta), tb=tb, c=c, maxd=maxd)
+    tok_spec = pl.BlockSpec((tb, 1), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    grid_spec = pl.GridSpec(
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((tb, c, LANES), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((c, LANES), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            tok_spec, tok_spec, tok_spec, tok_spec, tok_spec,
+        ],
+        out_specs=[
+            tok_spec,
+            pl.BlockSpec((c, LANES), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+    )
+    znew2, nkd = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((b, 1), jnp.int32),
+                   jax.ShapeDtypeStruct((c, LANES), jnp.int32)],
+        interpret=interpret,
+    )(W3, sinv, zi[:, None], drel[:, None], msk[:, None],
+      u1[:, None], u2[:, None])
+    return znew2[:, 0], nkd
+
+
 @functools.partial(jax.jit, static_argnames=("alpha", "beta", "tb",
                                              "interpret"))
 def gibbs_sample_docblock(ndk_blk: jax.Array, W3: jax.Array,

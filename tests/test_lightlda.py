@@ -461,3 +461,89 @@ def test_tiled_exact_model_parallel(mesh8, docs):
     assert nwk.sum() == app.num_tokens
     assert np.array_equal(nk[: app.K], nwk.sum(0))
     assert app.ll_history[-1] > app.ll_history[0] + 0.1
+
+
+def test_docblock_streamed_matches_inmemory(mesh_dp8, docs):
+    """Out-of-core mode (host-resident stream/z/doc-counts, per-call
+    staging, on-device count rebuild, incremental master updates) must be
+    BIT-IDENTICAL to the in-memory mode: same kernel sequence, same RNG,
+    and the doc counts are a pure function of z at call boundaries."""
+    tw, td, V = docs
+    kw = dict(num_topics=128, batch_tokens=2048, steps_per_call=2,
+              seed=1, sampler="tiled", doc_blocked=True,
+              block_tokens=256, block_docs=8)
+    ref = LightLDA(tw, td, V, LDAConfig(**kw), mesh=mesh_dp8,
+                   name="db_ref")
+    ref.train(num_iterations=3)
+    ref_w, ref_d = ref.word_topics(), ref.doc_topics()
+    ref_nk = np.asarray(ref.summary.get())
+    ref_z = np.asarray(ref._z)
+    table_base.reset_tables()
+
+    app = LightLDA(tw, td, V, LDAConfig(**kw, stream_blocks=True),
+                   mesh=mesh_dp8, name="db_stream")
+    app.train(num_iterations=3)
+    np.testing.assert_array_equal(app._z_host, ref_z)
+    np.testing.assert_array_equal(app.word_topics(), ref_w)
+    np.testing.assert_array_equal(app.doc_topics(), ref_d)
+    np.testing.assert_array_equal(np.asarray(app.summary.get()), ref_nk)
+    np.testing.assert_allclose(app.ll_history, ref.ll_history, rtol=1e-6)
+
+
+def test_docblock_streamed_model_parallel(devices, docs):
+    """Streamed mode on a dp x mp mesh equals the streamed pure-DP run
+    (sharded master-delta scatters are integer-exact)."""
+    from multiverso_tpu import core
+    tw, td, V = docs
+    kw = dict(num_topics=128, batch_tokens=2048, steps_per_call=2,
+              seed=1, sampler="tiled", doc_blocked=True,
+              block_tokens=256, block_docs=8, stream_blocks=True)
+    mesh_dp = core.init(devices=devices, data_parallel=8,
+                        model_parallel=1)
+    ref = LightLDA(tw, td, V, LDAConfig(**kw), mesh=mesh_dp,
+                   name="dbs_ref")
+    ref.train(num_iterations=2)
+    ref_w, ref_z = ref.word_topics(), ref._z_host.copy()
+    table_base.reset_tables()
+    core.shutdown()
+
+    mesh_mp = core.init(devices=devices, data_parallel=4,
+                        model_parallel=2)
+    app = LightLDA(tw, td, V, LDAConfig(**kw), mesh=mesh_mp,
+                   name="dbs_mp")
+    app.train(num_iterations=2)
+    np.testing.assert_array_equal(app._z_host, ref_z)
+    np.testing.assert_array_equal(app.word_topics(), ref_w)
+    table_base.reset_tables()
+    core.shutdown()
+
+
+def test_docblock_streamed_checkpoint_crossmode(mesh_dp8, docs, tmp_path):
+    """A streamed checkpoint resumes in an in-memory app (same packed z
+    layout) and vice versa."""
+    tw, td, V = docs
+    kw = dict(num_topics=128, batch_tokens=2048, steps_per_call=2,
+              seed=3, sampler="tiled", doc_blocked=True,
+              block_tokens=256, block_docs=8)
+    app = LightLDA(tw, td, V, LDAConfig(**kw, stream_blocks=True),
+                   mesh=mesh_dp8, name="dbs_ck1")
+    app.train(num_iterations=2)
+    prefix = str(tmp_path / "dbs_ckpt")
+    app.store(prefix)
+    z_after = app._z_host.copy()
+    table_base.reset_tables()
+
+    mem = LightLDA(tw, td, V, LDAConfig(**kw), mesh=mesh_dp8,
+                   name="dbs_ck2")
+    mem.load(prefix)
+    np.testing.assert_array_equal(np.asarray(mem._z), z_after)
+    mem.train(num_iterations=1)
+    ref_w = mem.word_topics()
+    table_base.reset_tables()
+
+    # and back into a streamed app: one more sweep must match in-memory
+    st = LightLDA(tw, td, V, LDAConfig(**kw, stream_blocks=True),
+                  mesh=mesh_dp8, name="dbs_ck3")
+    st.load(prefix)
+    st.train(num_iterations=1)
+    np.testing.assert_array_equal(st.word_topics(), ref_w)
