@@ -14,10 +14,15 @@ reference's hash→server partition.
 
 - ``get(keys)``: one jitted gather+compare; missing keys return
   ``default_value`` and a found-mask.
-- ``add(keys, deltas)``: slot assignment (existing slot, else first free
-  slot) is resolved host-side per batch — insertion-order races between
-  duplicate new keys are a host concern, not a device loop — then one
-  jitted scatter applies all updates. Bucket overflow raises.
+- ``add(keys, deltas)``: slot assignment is a DEVICE-SIDE vectorized
+  probe fused into the update program: a key takes its matching slot if
+  present, else the first empty lane of its bucket — same-bucket new
+  keys tie-break by batch order (a sort-free run-rank over the sorted
+  bucket ids). Assignment is a pure function of (table state, batch), so
+  under the SPMD collective contract (every process issues the same
+  adds) multi-host processes stay in lockstep with NO host-side mirror.
+  Bucket overflow drops the batch atomically on device and raises at
+  the next table op (deferred — async adds stay fire-and-forget).
 
 Values may be scalar (``value_dim=0``) or fixed-dim vectors.
 """
@@ -27,7 +32,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 from functools import partial
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -124,18 +129,7 @@ class KVTable:
         self.state = jax.tree.map(
             lambda s: jax.device_put(s, self._val_sharding),
             self.updater.init_state(self.values))
-        # host-side mirror of key→(bucket, slot): authoritative slot
-        # assignment (insertion decisions are host-side; device arrays are
-        # the data plane). That mirror is PER-PROCESS: two hosts inserting
-        # different keys would silently assign conflicting slots — fence
-        # it off until insertion is deterministic from the key alone.
-        if jax.process_count() > 1:
-            raise NotImplementedError(
-                "KVTable slot assignment is host-side and per-process; "
-                "multi-host runs would silently desync. Use ArrayTable/"
-                "MatrixTable for multi-host, or shard keys per host.")
-        self._slot_map: Dict[int, Tuple[int, int]] = {}
-        self._bucket_fill = np.zeros(self.num_buckets, dtype=np.int32)
+        self._pending_over = None   # deferred overflow flag (device scalar)
         self._build_jits()
         self.table_id = _register(self)  # type: ignore[arg-type]
         log.debug("kv table %r: %d buckets x %d slots (capacity %d)",
@@ -158,23 +152,73 @@ class KVTable:
                                jnp.asarray(self.default_value, vals.dtype))
             return picked, found
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2))
-        def scatter_update(keys_arr, values_arr, state, buckets, slot_ids,
-                           query, deltas, option):
-            keys_arr = keys_arr.at[buckets, slot_ids].set(query)
-            old = values_arr[buckets, slot_ids]
-            old_state = jax.tree.map(lambda s: s[buckets, slot_ids], state)
-            new, new_state = self.updater.apply(old, old_state, deltas,
+        n_slots = self.slots
+        scalar_sh = NamedSharding(self.mesh, P())
+        state_sh = jax.tree.map(lambda _: self._val_sharding, self.state)
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2),
+                 out_shardings=(self._key_sharding, self._val_sharding,
+                                state_sh, scalar_sh))
+        def probe_update(keys_arr, values_arr, state, buckets, query,
+                         deltas, option):
+            """Fused slot probe + updater + scatter. The probe is the
+            reference's hash-bucket insertion vectorized: match lane if
+            the key is present, else the (rank+1)-th empty lane where
+            rank = this key's position among the batch's NEW keys of the
+            same bucket (deterministic batch-order tie-break, computed
+            by a run-rank over the sorted bucket ids — no host state).
+            Unplaced keys (bucket overflow) get an out-of-range slot and
+            their scatters DROP; the count comes back for the host to
+            raise on."""
+            rows = jnp.take(keys_arr, buckets, axis=0)       # (n, S, 2)
+            match = (rows == query[:, None, :]).all(-1)      # (n, S)
+            matched = match.any(axis=1)
+            mlane = jnp.argmax(match, axis=1)
+            empty = (rows == jnp.uint32(0xFFFFFFFF)).all(-1)
+            new = ~matched
+            # rank among same-bucket new keys, in batch order
+            perm = jnp.argsort(buckets, stable=True)
+            b_s = jnp.take(buckets, perm)
+            new_s = jnp.take(new, perm).astype(jnp.int32)
+            csx = jnp.cumsum(new_s) - new_s                  # exclusive
+            bound = jnp.concatenate(
+                [jnp.ones(1, bool), b_s[1:] != b_s[:-1]])
+            base = jax.lax.cummax(jnp.where(bound, csx, -1))
+            rank_s = csx - base
+            rank = jnp.zeros_like(rank_s).at[perm].set(rank_s)
+            # (rank+1)-th empty lane of the bucket
+            ecs = jnp.cumsum(empty.astype(jnp.int32), axis=1)
+            hit = empty & (ecs == (rank + 1)[:, None])
+            placed_new = hit.any(axis=1)
+            elane = jnp.argmax(hit, axis=1)
+            ok = matched | placed_new
+            n_over = jnp.sum(~ok)
+            slot = jnp.where(matched, mlane, elane)
+            # all-or-nothing: ANY overflow voids the whole batch (the
+            # raise must leave the table untouched) — out-of-range slots
+            # make every scatter drop
+            slot = jnp.where(ok & (n_over == 0), slot, n_slots)
+            keys_arr = keys_arr.at[buckets, slot].set(query)
+            safe = jnp.minimum(slot, n_slots - 1)
+            old = values_arr[buckets, safe]
+            old_state = jax.tree.map(lambda s: s[buckets, safe], state)
+            upd, new_state = self.updater.apply(old, old_state, deltas,
                                                 option)
-            values_arr = values_arr.at[buckets, slot_ids].set(
-                new.astype(values_arr.dtype))
+            values_arr = values_arr.at[buckets, slot].set(
+                upd.astype(values_arr.dtype))
             state = jax.tree.map(
-                lambda s, ns: s.at[buckets, slot_ids].set(ns.astype(s.dtype)),
+                lambda s, ns: s.at[buckets, slot].set(ns.astype(s.dtype)),
                 state, new_state)
-            return keys_arr, values_arr, state
+            return keys_arr, values_arr, state, n_over
+
+        @partial(jax.jit, out_shardings=scalar_sh)
+        def count_live(keys_arr):
+            return jnp.sum(~(keys_arr == jnp.uint32(0xFFFFFFFF))
+                           .all(-1))
 
         self._lookup = lookup
-        self._scatter_update = scatter_update
+        self._probe_update = probe_update
+        self._count_live = count_live
 
     def _buckets_of(self, keys: np.ndarray) -> np.ndarray:
         return (_hash_u64(keys) % np.uint64(self.num_buckets)).astype(
@@ -189,12 +233,31 @@ class KVTable:
                              "sentinel")
         return keys
 
+    def _check_overflow(self) -> None:
+        """Raise any pending overflow from the previous async add. The
+        check is DEFERRED so ``add(sync=False)`` stays fire-and-forget
+        (an eager scalar readback would serialize host and device every
+        minibatch); the overflowed batch was dropped atomically on
+        device, so the table is consistent — the error just surfaces at
+        the next table op (or ``wait``)."""
+        pending, self._pending_over = self._pending_over, None
+        if pending is None:
+            return
+        n_over = int(np.asarray(pending))
+        if n_over:
+            raise RuntimeError(
+                f"kv table {self.name!r}: {n_over} keys overflowed their "
+                f"buckets ({self.slots} slots) in the previous add (the "
+                "batch was dropped atomically); raise capacity or "
+                "slots_per_bucket")
+
     # -- API ---------------------------------------------------------------
 
     def get(self, keys) -> Tuple[np.ndarray, np.ndarray]:
         """Batched lookup → (values, found_mask). Missing keys yield
         ``default_value`` (the reference's KV semantics: absent = initial
         value)."""
+        self._check_overflow()
         keys = self._check_keys(keys)
         buckets = self._buckets_of(keys)
         vals, found = self._lookup(
@@ -210,6 +273,7 @@ class KVTable:
         Duplicate keys within one batch must be pre-aggregated (the
         client-side Aggregator role) — they raise otherwise.
         """
+        self._check_overflow()
         keys = self._check_keys(keys)
         uniq = np.unique(keys)
         if len(uniq) != len(keys):
@@ -219,36 +283,13 @@ class KVTable:
         if deltas.shape != want:
             raise ValueError(f"deltas shape {deltas.shape} != {want}")
 
-        # Two-pass slot assignment: plan first (no mutation), commit only
-        # once the whole batch is known to fit — an overflow raise must not
-        # leak slots or desynchronize the host mirror from device state.
         buckets = self._buckets_of(keys)
-        slot_ids = np.empty(len(keys), dtype=np.int32)
-        planned_fill: Dict[int, int] = {}
-        new_assignments: Dict[int, Tuple[int, int]] = {}
-        for i, (k, b) in enumerate(zip(keys.tolist(), buckets.tolist())):
-            assigned = self._slot_map.get(k)
-            if assigned is not None:
-                slot_ids[i] = assigned[1]
-                continue
-            fill = planned_fill.get(b, int(self._bucket_fill[b]))
-            if fill >= self.slots:
-                raise RuntimeError(
-                    f"kv table {self.name!r}: bucket {b} overflow "
-                    f"({self.slots} slots); raise capacity or "
-                    "slots_per_bucket")
-            new_assignments[k] = (b, fill)
-            planned_fill[b] = fill + 1
-            slot_ids[i] = fill
-        self._slot_map.update(new_assignments)
-        for b, fill in planned_fill.items():
-            self._bucket_fill[b] = fill
-
         opt = (option or self.default_option).as_jax(self.mesh)
         put = lambda a: core.place(a, mesh=self.mesh)
-        self.keys, self.values, self.state = self._scatter_update(
-            self.keys, self.values, self.state, put(buckets),
-            put(slot_ids), put(_split_keys(keys)), put(deltas), opt)
+        self.keys, self.values, self.state, self._pending_over = \
+            self._probe_update(
+                self.keys, self.values, self.state, put(buckets),
+                put(_split_keys(keys)), put(deltas), opt)
         with self._option_lock:
             self.default_option.step += 1
             self.generation += 1
@@ -256,10 +297,12 @@ class KVTable:
         handle = Handle(table=self, generation=gen)
         if sync:
             handle.wait()
+            self._check_overflow()
         return handle
 
     def wait(self) -> None:
         jax.block_until_ready(self._live_buffers())
+        self._check_overflow()
 
     def _live_buffers(self):
         return (self.keys, self.values, self.state)
@@ -268,16 +311,22 @@ class KVTable:
         return self.values
 
     def __len__(self) -> int:
-        return len(self._slot_map)
+        """Number of live keys (device count — there is no host mirror)."""
+        self._check_overflow()
+        return int(np.asarray(self._count_live(self.keys)))
 
     # -- checkpoint --------------------------------------------------------
 
     KV_MAGIC = "multiverso_tpu.kvtable.v1"
 
     def store(self, uri: str) -> None:
-        payload = {"keys": np.asarray(self.keys),
+        self._check_overflow()
+        host_keys = np.asarray(self.keys)
+        # lanes fill contiguously (no deletion), so fill = live count
+        fill = (~(host_keys == 0xFFFFFFFF).all(-1)).sum(-1)
+        payload = {"keys": host_keys,
                    "values": np.asarray(self.values),
-                   "bucket_fill": self._bucket_fill}
+                   "bucket_fill": fill.astype(np.int32)}
         manifest = {"magic": self.KV_MAGIC, "name": self.name,
                     "capacity": self.capacity, "value_dim": self.value_dim,
                     "slots": self.slots, "num_buckets": self.num_buckets,
@@ -308,12 +357,7 @@ class KVTable:
             data, manifest["n_state_leaves"], self.state,
             lambda leaf, tmpl: jax.device_put(leaf.astype(tmpl.dtype),
                                               self._val_sharding))
-        self._bucket_fill = data["bucket_fill"].copy()
-        self._slot_map = {}
-        joined = _join_keys(host_keys)
-        for b in range(self.num_buckets):
-            for s in range(int(self._bucket_fill[b])):
-                self._slot_map[int(joined[b, s])] = (b, s)
+        # slot assignment is device-derived: nothing host-side to rebuild
         self.default_option.step = int(manifest.get("step", 0))
         # load replaces live state: outstanding add-handles read superseded
         with self._option_lock:

@@ -62,13 +62,35 @@ def main() -> None:
     loss = app.train(X, y)
     assert np.isfinite(loss), loss
 
-    # KVTable is host-assigned: must refuse multi-host
-    try:
-        KVTable(100)
-    except NotImplementedError:
-        pass
-    else:
-        raise SystemExit("KVTable did not raise under process_count=2")
+    # KVTable across both processes: slot assignment is a device-side
+    # probe (pure function of table state + batch), so collective adds
+    # keep every process in lockstep with no host mirror
+    kv = KVTable(128, value_dim=2)
+    ks = np.array([3, 9, 1 << 40, 7], np.uint64)
+    kv.add(ks, np.arange(8, dtype=np.float32).reshape(4, 2), sync=True)
+    vals, found = kv.get(ks)
+    assert found.all(), found
+    np.testing.assert_allclose(vals,
+                               np.arange(8, dtype=np.float32).reshape(4, 2))
+    kv.add(ks[:2], np.ones((2, 2), np.float32), sync=True)
+    vals2, _ = kv.get(ks)
+    np.testing.assert_allclose(vals2[:2], vals[:2] + 1.0)
+    _, missing = kv.get(np.array([12345], np.uint64))
+    assert not missing.any()
+    assert len(kv) == 4
+
+    # sparse logreg (KVTable consumer) trains across the 2-process mesh
+    from multiverso_tpu.apps.sparse_logreg import (SparseLogisticRegression,
+                                                   SparseLRConfig,
+                                                   synthetic_sparse)
+    rows, y = synthetic_sparse(n=200, dim=30_000, num_classes=2, nnz=8,
+                               seed=0)
+    slr = SparseLogisticRegression(SparseLRConfig(
+        num_classes=2, max_features=10, capacity=1 << 13,
+        minibatch_size=50, learning_rate=0.5, epochs=3))
+    slr.train(rows, y)
+    acc = slr.accuracy(rows, y)
+    assert acc > 0.75, acc
 
     # the flagship doc-blocked LDA sampler across BOTH processes: a
     # shard_map'd pallas kernel (interpret mode on CPU) with per-chip

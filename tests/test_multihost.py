@@ -1,7 +1,8 @@
 """Multi-host path test: 2 local processes + jax.distributed CPU
 coordinator (VERDICT round-1 item 4 — the machine_file path had zero
 coverage). The child (tests/_multihost_child.py) exercises init/barrier/
-ArrayTable add/fused superstep/logreg and the KVTable multi-host fence."""
+ArrayTable add/fused superstep/logreg, KVTable collective adds (device-side
+slot probe), sparse LR, and the doc-blocked LDA sampler."""
 
 import os
 import socket
