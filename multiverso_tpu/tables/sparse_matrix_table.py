@@ -234,12 +234,10 @@ class SparseMatrixTable(MatrixTable):
         vals = np.asarray(vals)[:n]
         indptr = np.zeros(n + 1, np.int64)
         np.cumsum(nnz, out=indptr[1:])
-        out_cols = np.empty(indptr[-1], np.int32)
-        out_vals = np.empty(indptr[-1], vals.dtype)
-        for i in range(n):
-            m = vals[i] != 0
-            ci, vi = cols[i][m], vals[i][m]
-            order = np.argsort(ci, kind="stable")
-            out_cols[indptr[i]:indptr[i + 1]] = ci[order]
-            out_vals[indptr[i]:indptr[i + 1]] = vi[order]
-        return indptr, out_cols, out_vals
+        # one vectorized pass over all requested rows (a per-row Python
+        # loop crawls on full-model dumps): np.nonzero walks row-major,
+        # then a single lexsort orders each row's entries by column
+        ri, ci = np.nonzero(vals != 0)
+        ecols = cols[ri, ci]
+        order = np.lexsort((ecols, ri))
+        return indptr, ecols[order], vals[ri, ci][order]
