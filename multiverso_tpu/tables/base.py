@@ -131,7 +131,14 @@ class Handle:
                 and self._table.generation > self._generation)
 
     def done(self) -> bool:
-        """Non-blocking completion check."""
+        """Non-blocking completion check.
+
+        WARNING (add-handles): reports readiness of the table's CURRENT
+        buffers, consistent with :meth:`wait`'s generation contract — so
+        ``done()`` is NOT monotonic: it can flip back to False when a
+        LATER add is dispatched after this handle's update already
+        landed. Poll ``done() or superseded()`` to ask "has *my* update
+        been applied"."""
         values = self._values if self._table is None \
             else self._table._live_buffers()
         return all(getattr(v, "is_ready", lambda: True)()
