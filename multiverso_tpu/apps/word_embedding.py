@@ -228,8 +228,13 @@ class WordEmbedding:
                 w_in = w_in.at[src].add(-grad_v.astype(w_in.dtype))
             return (w_in, w_out), loss
 
-        def body(params, states, locals_, options, srcs, tgts, key, lrs):
-            keys = jax.random.split(key, srcs.shape[0])
+        def body(params, states, locals_, options, pairs, key, lrs):
+            # pairs [S, B, ctx+1]: context ids + target in ONE operand
+            # (one H2D placement per call instead of two — the transfer
+            # RPC count is the measured e2e bottleneck on tunneled hosts)
+            srcs = pairs[..., :-1] if cbow else pairs[..., 0]
+            tgts = pairs[..., -1]
+            keys = jax.random.split(key, pairs.shape[0])
             params, losses = lax.scan(
                 scan_body, params, (srcs, tgts, keys, lrs))
             return params, states, locals_, losses.mean()
@@ -242,15 +247,14 @@ class WordEmbedding:
     # -- data placement ----------------------------------------------------
 
     def _place(self, srcs: np.ndarray, tgts: np.ndarray):
-        """Shard the pair stream over the data axis (batch dim last-level)."""
-        if srcs.ndim == 2:      # skipgram: [S, B]
-            spec = P(None, core.DATA_AXIS)
-        else:                   # cbow: [S, B, 2w]
-            spec = P(None, core.DATA_AXIS, None)
-        s = jax.device_put(srcs, NamedSharding(self.mesh, spec))
-        t = jax.device_put(tgts, NamedSharding(
-            self.mesh, P(None, core.DATA_AXIS)))
-        return s, t
+        """Shard the pair stream over the data axis — ONE combined
+        [S, B, ctx+1] placement per call (src ids + target packed along
+        the trailing axis; the fused body unslices for free)."""
+        if srcs.ndim == 2:      # skipgram: [S, B] -> [S, B, 1]
+            srcs = srcs[..., None]
+        pairs = np.concatenate([srcs, tgts[..., None]], axis=-1)
+        return jax.device_put(pairs, NamedSharding(
+            self.mesh, P(None, core.DATA_AXIS, None)))
 
     # -- training ----------------------------------------------------------
 
@@ -348,9 +352,9 @@ class WordEmbedding:
         lrs = np.maximum(np.linspace(lr_hi, lr_lo, s), floor) \
             .astype(np.float32)
         key = jax.random.fold_in(self._key, call_no)
-        sd, td = self._place(srcs, tgts)
+        pd = self._place(srcs, tgts)
         with dashboard.profile("w2v.superstep"):
-            _, loss = self._fused((), sd, td, key,
+            _, loss = self._fused((), pd, key,
                                   core.place(lrs, mesh=self.mesh))
         self._step_no += s
         return loss

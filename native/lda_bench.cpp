@@ -30,6 +30,7 @@ struct Params {
   int topics = 1000;
   int sweeps = 3;
   int mh_steps = 2;
+  int curve = 0;  // 1: per-sweep (train secs, loglik) records
   double beta = 0.01;
   double alpha = -1.0;  // <0 -> 50/K
   uint64_t seed = 1;
@@ -84,6 +85,7 @@ int main(int argc, char** argv) {
     else if (k == "-topics") p.topics = static_cast<int>(v);
     else if (k == "-sweeps") p.sweeps = static_cast<int>(v);
     else if (k == "-mh_steps") p.mh_steps = static_cast<int>(v);
+    else if (k == "-curve") p.curve = static_cast<int>(v);
     else if (k == "-seed") p.seed = static_cast<uint64_t>(v);
   }
   const int V = p.vocab, D = p.docs, K = p.topics;
@@ -130,6 +132,23 @@ int main(int argc, char** argv) {
   std::vector<Alias> word_alias(static_cast<size_t>(V));
   std::vector<double> wbuf(static_cast<size_t>(K));
 
+  // subsampled per-token predictive log-likelihood (shared by the final
+  // report and the -curve mode; eval time is excluded from the clock)
+  auto eval_ll = [&]() -> double {
+    double ll = 0;
+    for (long i = 0; i < T; i += 97) {
+      const int w = tw[static_cast<size_t>(i)], d = td[static_cast<size_t>(i)];
+      const long dlen = doc_start[static_cast<size_t>(d) + 1] - doc_start[static_cast<size_t>(d)];
+      double s = 0;
+      for (int k = 0; k < K; ++k) {
+        s += (ndk[static_cast<size_t>(d) * static_cast<size_t>(K) + static_cast<size_t>(k)] + alpha) / (dlen + K * alpha) *
+             (nwk[static_cast<size_t>(w) * static_cast<size_t>(K) + static_cast<size_t>(k)] + beta) / (nk[static_cast<size_t>(k)] + vbeta);
+      }
+      ll += std::log(s);
+    }
+    return ll / static_cast<double>((T + 96) / 97);
+  };
+
   auto posterior = [&](long i, int k) -> double {
     // p(z_i = k | rest) with token i removed, unnormalized
     const int w = tw[static_cast<size_t>(i)], d = td[static_cast<size_t>(i)];
@@ -139,6 +158,9 @@ int main(int argc, char** argv) {
            (nk[static_cast<size_t>(k)] - self + vbeta);
   };
 
+  double train_secs = 0;
+  std::vector<double> curve_secs;
+  std::vector<double> curve_ll;
   auto t0 = std::chrono::steady_clock::now();
   for (int sweep = 0; sweep < p.sweeps; ++sweep) {
     // rebuild the stale word-proposal alias tables (per-slice in the
@@ -200,29 +222,35 @@ int main(int argc, char** argv) {
         z[static_cast<size_t>(i)] = cur;
       }
     }
+    if (p.curve) {
+      // pause the clock for eval: the curve compares TRAINING wallclock
+      auto tc = std::chrono::steady_clock::now();
+      train_secs += std::chrono::duration<double>(tc - t0).count();
+      curve_secs.push_back(train_secs);
+      curve_ll.push_back(eval_ll());
+      t0 = std::chrono::steady_clock::now();
+    }
   }
   auto t1 = std::chrono::steady_clock::now();
-  double secs = std::chrono::duration<double>(t1 - t0).count();
+  double secs = train_secs +
+                std::chrono::duration<double>(t1 - t0).count();
 
-  // model log-likelihood (point estimate), to show sampling is real
-  double ll = 0;
-  for (long i = 0; i < T; i += 97) {  // subsample tokens for speed
-    const int w = tw[static_cast<size_t>(i)], d = td[static_cast<size_t>(i)];
-    const long dlen = doc_start[static_cast<size_t>(d) + 1] - doc_start[static_cast<size_t>(d)];
-    double s = 0;
-    for (int k = 0; k < K; ++k) {
-      s += (ndk[static_cast<size_t>(d) * static_cast<size_t>(K) + static_cast<size_t>(k)] + alpha) / (dlen + K * alpha) *
-           (nwk[static_cast<size_t>(w) * static_cast<size_t>(K) + static_cast<size_t>(k)] + beta) / (nk[static_cast<size_t>(k)] + vbeta);
-    }
-    ll += std::log(s);
-  }
-  ll /= static_cast<double>((T + 96) / 97);
+  double ll = eval_ll();
 
   std::printf(
       "{\"doc_tokens_per_sec\": %.1f, \"tokens\": %ld, \"sweeps\": %d, "
       "\"secs\": %.3f, \"topics\": %d, \"vocab\": %d, \"docs\": %d, "
-      "\"mh_steps\": %d, \"loglik\": %.4f}\n",
+      "\"mh_steps\": %d, \"loglik\": %.4f",
       static_cast<double>(T) * p.sweeps / secs, T, p.sweeps, secs, K, V, D,
       p.mh_steps, ll);
+  if (p.curve) {
+    std::printf(", \"curve\": [");
+    for (size_t i = 0; i < curve_ll.size(); ++i) {
+      std::printf("%s{\"sweep\": %zu, \"secs\": %.3f, \"loglik\": %.4f}",
+                  i ? ", " : "", i + 1, curve_secs[i], curve_ll[i]);
+    }
+    std::printf("]");
+  }
+  std::printf("}\n");
   return 0;
 }
