@@ -1,0 +1,42 @@
+"""Probe: does sorting gather indices WITHIN each 512-token block speed
+up the stale-mirror word-row gather? (round-2 log: the zipf W gather is
+~8ms of the 26ms step budget)."""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import jax                                     # noqa: E402
+import jax.numpy as jnp                        # noqa: E402
+
+V, K, B, TB = 50_000, 1024, 512_000, 512
+rng = np.random.default_rng(0)
+p = 1.0 / np.arange(1, V + 1) ** 1.1
+p /= p.sum()
+w = rng.choice(V, B, p=p).astype(np.int32)
+mirror = jnp.zeros((V + 8, K // 128, 128), jnp.bfloat16)
+
+w_blocksorted = w.reshape(-1, TB).copy()
+w_blocksorted.sort(axis=1)
+w_fullsorted = np.sort(w)
+
+gather = jax.jit(lambda m, idx: jnp.take(m, idx, axis=0))
+
+
+def timeit(name, idx):
+    idx_d = jnp.asarray(idx.reshape(-1))
+    out = gather(mirror, idx_d)
+    _ = np.asarray(out[0, 0, 0])               # fence via host transfer
+    t0 = time.perf_counter()
+    for _ in range(10):
+        out = gather(mirror, idx_d)
+    _ = np.asarray(out[0, 0, 0])
+    dt = (time.perf_counter() - t0) / 10
+    print(f"{name}: {dt*1000:.2f} ms per [{B}] gather")
+
+
+timeit("unsorted      ", w)
+timeit("block-sorted  ", w_blocksorted)
+timeit("fully-sorted  ", w_fullsorted)

@@ -107,9 +107,9 @@ def measure_tpu(sampler: str = "tiled", timed_sweeps: int = 3) -> dict:
             "block_tokens": cfg.block_tokens,
             "block_docs": cfg.block_docs,
             # packing fill scales kernel efficiency — record the
-            # measured workload's value
-            "packing_fill": round(getattr(app, "packing_fill",
-                                          float("1.0")), 4),
+            # measured workload's value (None: sampler doesn't pack)
+            "packing_fill": (round(app.packing_fill, 4)
+                             if hasattr(app, "packing_fill") else None),
             "loglik_after": app.loglik()}
 
 

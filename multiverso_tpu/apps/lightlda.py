@@ -198,6 +198,15 @@ class LightLDA:
                 f"got sampler={c.sampler!r}")
         if c.stream_blocks and not c.doc_blocked:
             raise ValueError("stream_blocks requires doc_blocked=True")
+        if c.stream_blocks and jax.process_count() > 1:
+            # per-call z readback assumes the aux output is fully
+            # addressable; multi-host needs per-process corpus shards
+            # with process-local staging — not built yet
+            raise NotImplementedError(
+                "stream_blocks is single-process for now: each process "
+                "would need its own corpus shard + process-local z "
+                "staging. Use the in-memory doc_blocked mode on "
+                "multi-host meshes.")
         # tiled samplers support dp x mp meshes: the word-topic table and
         # its bf16 mirror stay row-sharded over the model axis (each chip
         # holds a [V/mp] vocab slice — the reference's Meta vocab-slicing
@@ -359,21 +368,26 @@ class LightLDA:
         if len(lens) and lens.max() > TB:
             raise ValueError(f"a document has {lens.max()} tokens > "
                              f"block_tokens {TB}")
-        blocks, cur, cur_tok = [], [], 0
-        for di in range(len(doc_ids)):
-            ln = int(lens[di])
-            if cur_tok + ln > TB or len(cur) >= MAXD:
-                blocks.append(cur)
-                cur, cur_tok = [], 0
-            cur.append(di)
+        # greedy whole-doc block assignment (sequential by nature; a
+        # plain scalar loop over doc LENGTHS — the token-level copy
+        # below is fully vectorized so web-scale corpora pack in seconds)
+        n_real = len(doc_ids)
+        blk = np.empty(n_real, np.int64)
+        row = np.empty(n_real, np.int64)
+        off = np.empty(n_real, np.int64)
+        b = 0
+        cur_r = cur_tok = 0
+        for di, ln in enumerate(lens.tolist()):
+            if cur_tok + ln > TB or cur_r >= MAXD:
+                b += 1
+                cur_r = cur_tok = 0
+            blk[di], row[di], off[di] = b, cur_r, cur_tok
+            cur_r += 1
             cur_tok += ln
-        if cur:
-            blocks.append(cur)
-        if not blocks:
-            blocks = [[]]
+        n_blocks = (b + 1) if n_real else 1
         nbs = B // TB                       # blocks per scan step
         per_call = S * nbs
-        n_calls = -(-len(blocks) // per_call)
+        n_calls = -(-n_blocks // per_call)
         nb_pad = n_calls * per_call
         self.calls_per_sweep = n_calls
         self._nb_pad, self._tb, self._maxd = nb_pad, TB, MAXD
@@ -386,17 +400,16 @@ class LightLDA:
         # other document's counts
         self._blk_of_doc = np.full(self.num_docs, -1, np.int64)
         self._row_of_doc = np.full(self.num_docs, -1, np.int64)
-        for b, docs in enumerate(blocks):
-            off = 0
-            for r, di in enumerate(docs):
-                s, e = int(doc_starts[di]), int(doc_ends[di])
-                ln = e - s
-                tw_p[b, off:off + ln] = tw[s:e]
-                drel_p[b, off:off + ln] = r
-                mask_p[b, off:off + ln] = 1
-                self._blk_of_doc[doc_ids[di]] = b
-                self._row_of_doc[doc_ids[di]] = r
-                off += ln
+        if n_real:
+            # each doc's tokens land at (blk, off + position-within-doc)
+            tok_within = np.arange(len(td), dtype=np.int64) \
+                - np.repeat(doc_starts, lens)
+            flat = np.repeat(blk * TB + off, lens) + tok_within
+            tw_p.reshape(-1)[flat] = tw
+            drel_p.reshape(-1)[flat] = np.repeat(row, lens)
+            mask_p.reshape(-1)[flat] = 1
+            self._blk_of_doc[doc_ids] = blk
+            self._row_of_doc[doc_ids] = row
         fill = mask_p.sum() / max(nb_pad * TB, 1)
         self.packing_fill = float(fill)
         log.info("lda doc_blocked: %d blocks (%d/call, %.0f%% fill)",

@@ -547,3 +547,11 @@ def test_docblock_streamed_checkpoint_crossmode(mesh_dp8, docs, tmp_path):
     st.load(prefix)
     st.train(num_iterations=1)
     np.testing.assert_array_equal(st.word_topics(), ref_w)
+
+
+def test_stream_blocks_requires_docblock(mesh_dp8):
+    with pytest.raises(ValueError, match="doc_blocked"):
+        LightLDA(np.zeros(8, np.int32), np.zeros(8, np.int32), 4,
+                 LDAConfig(num_topics=128, sampler="tiled",
+                           stream_blocks=True),
+                 mesh=mesh_dp8, name="lda_sb_bad")
