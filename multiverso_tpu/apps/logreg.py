@@ -51,6 +51,9 @@ class LogRegConfig:
     learning_rate: float = 0.1
     updater: str = "sgd"
     regular_lambda: float = 0.0     # L2 coefficient ("regular=L2" analog)
+    ftrl_l1: float = 0.0            # updater="ftrl": L1 / L2 / beta — the
+    ftrl_l2: float = 0.0            # AddOption lam/rho/momentum fields
+    ftrl_beta: float = 1.0          # (see updaters docstring mapping)
     objective: str = "softmax"      # "softmax" | "sigmoid"
     seed: int = 0
 
@@ -178,10 +181,12 @@ class LogisticRegression:
         init = np.zeros(self.n_weights, np.float32)
         init[: c.input_dim * c.num_classes] = rng.normal(
             0.0, 0.01, c.input_dim * c.num_classes)
+        opt = AddOption.for_ftrl(c.learning_rate, c.ftrl_l1, c.ftrl_l2,
+                                 c.ftrl_beta) if c.updater == "ftrl" \
+            else AddOption(learning_rate=c.learning_rate)
         self.table = ArrayTable(
             self.n_weights, "float32", init_value=init, updater=c.updater,
-            mesh=self.mesh, name=name,
-            default_option=AddOption(learning_rate=c.learning_rate))
+            mesh=self.mesh, name=name, default_option=opt)
         self._data_sharding = NamedSharding(self.mesh, P(core.DATA_AXIS))
         self._build_step()
 

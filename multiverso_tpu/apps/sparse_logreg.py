@@ -19,7 +19,7 @@ deltas, SURVEY.md §4.2/§4.3):
   gradient, and the per-key delta via duplicate-safe scatter-add (the
   client-side Aggregator role, fused on device),
 - ``kv.add(uniq_keys, delta)`` folds the delta through the table's
-  updater (sgd / adagrad — state lives with the table, per key).
+  updater (sgd / adagrad / ftrl — state lives with the table, per key).
 
 Static shapes: samples are padded to ``max_features`` features (extras
 raise), unique-key counts are bucketed to powers of two, and padded
@@ -56,7 +56,10 @@ class SparseLRConfig:
     minibatch_size: int = 4096
     learning_rate: float = 0.1
     regular_lambda: float = 0.0   # lazy L2 on touched rows
-    updater: str = "sgd"          # "sgd" | "adagrad"
+    updater: str = "sgd"          # "sgd" | "adagrad" | "ftrl"
+    ftrl_l1: float = 0.0          # updater="ftrl": L1 / L2 / beta — the
+    ftrl_l2: float = 0.0          # AddOption lam/rho/momentum fields
+    ftrl_beta: float = 1.0        # (see updaters docstring mapping)
     epochs: int = 1
     use_bias: bool = True
     seed: int = 0
@@ -101,11 +104,14 @@ class SparseLogisticRegression:
         c = config
         if c.num_classes < 2:
             raise ValueError("num_classes must be >= 2")
+        opt = AddOption.for_ftrl(c.learning_rate, c.ftrl_l1, c.ftrl_l2,
+                                 c.ftrl_beta) if c.updater == "ftrl" \
+            else AddOption(learning_rate=c.learning_rate)
         self.table = KVTable(
             c.capacity, value_dim=c.num_classes, dtype="float32",
             slots_per_bucket=c.slots_per_bucket,
             updater=c.updater, mesh=self.mesh, name=name,
-            default_option=AddOption(learning_rate=c.learning_rate))
+            default_option=opt)
         self._step_jits: Dict[Tuple[int, int], object] = {}
 
     # -- batch packing -----------------------------------------------------

@@ -24,6 +24,10 @@ Updater semantics (matching the reference's):
 - ``momentum``— velocity ``v = mu * v + delta``; ``param -= lr * v``.
 - ``adam``    — extension beyond the reference set (not in upstream
   Multiverso; provided because modern workloads expect it).
+- ``ftrl``    — FTRL-Proximal, the reference LR app's FTRL-style objective
+  (SURVEY.md §3.6): per-coordinate (z, n) state, closed-form proximal
+  weight with exact-zero L1 shrinkage. AddOption mapping: ``learning_rate``
+  = alpha, ``momentum`` = beta, ``lam`` = L1, ``rho`` = L2.
 """
 
 from multiverso_tpu.updaters.updaters import (AddOption, Updater,

@@ -27,6 +27,14 @@ class AddOption:
     lam: float = 1e-8           # epsilon / regularization knob
     step: int = 0               # global step counter (adam bias correction)
 
+    @classmethod
+    def for_ftrl(cls, learning_rate: float, l1: float = 0.0,
+                 l2: float = 0.0, beta: float = 1.0) -> "AddOption":
+        """The ftrl updater's field mapping in ONE place: ``lam`` = L1,
+        ``rho`` = L2, ``momentum`` = beta (alpha = learning_rate)."""
+        return cls(learning_rate=learning_rate, lam=l1, rho=l2,
+                   momentum=beta)
+
     def as_jax(self, mesh=None) -> "AddOption":
         """Scalar leaves as device arrays. With ``mesh``, the scalars are
         placed replicated on that mesh — NOT on the process default device,
@@ -138,6 +146,45 @@ def _adam_apply(param, state, delta, option):
              "v": jax.tree.map(lambda x: x[2], flat, is_leaf=is_tup)})
 
 
+def _ftrl_init(param: Param) -> State:
+    zeros = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
+    return {"z": jax.tree.map(zeros, param), "n": jax.tree.map(zeros, param)}
+
+
+def _ftrl_apply(param, state, delta, option):
+    """FTRL-Proximal (per-coordinate), the reference LR app's FTRL-style
+    objective (SURVEY.md §3.6 Apps/LogisticRegression).
+
+    ``AddOption`` field mapping for this updater (the struct is the
+    reference's generic hyperparameter carrier, SURVEY.md §3.3):
+    ``learning_rate`` = alpha, ``momentum`` = beta, ``lam`` = L1,
+    ``rho`` = L2. The closed-form proximal weight is recomputed from the
+    (z, n) state, so L1 produces exact zeros — the reason the reference's
+    sparse LR wanted FTRL at all.
+    """
+    alpha, beta = option.learning_rate, option.momentum
+    l1, l2 = option.lam, option.rho
+
+    def upd(p, z, n, d):
+        g = d.astype(jnp.float32)
+        n_new = n + g * g
+        sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / alpha
+        z_new = z + g - sigma * p.astype(jnp.float32)
+        shrunk = jnp.sign(z_new) * jnp.maximum(jnp.abs(z_new) - l1, 0.0)
+        # canonical guard: |z| <= l1 selects w = 0 OUTSIDE the division —
+        # with beta = l2 = 0 a never-touched coordinate has n = z = 0 and
+        # the quotient is 0/0 (NaN) without it
+        w = jnp.where(jnp.abs(z_new) <= l1, 0.0,
+                      -shrunk / ((beta + jnp.sqrt(n_new)) / alpha + l2))
+        return (w.astype(p.dtype), z_new, n_new)
+
+    flat = jax.tree.map(upd, param, state["z"], state["n"], delta)
+    is_tup = lambda x: isinstance(x, tuple)
+    return (jax.tree.map(lambda x: x[0], flat, is_leaf=is_tup),
+            {"z": jax.tree.map(lambda x: x[1], flat, is_leaf=is_tup),
+             "n": jax.tree.map(lambda x: x[2], flat, is_leaf=is_tup)})
+
+
 _REGISTRY: Dict[str, Updater] = {}
 
 
@@ -164,3 +211,4 @@ register_updater(Updater("sgd", _no_state, _sgd_apply))
 register_updater(Updater("adagrad", _adagrad_init, _adagrad_apply))
 register_updater(Updater("momentum", _momentum_init, _momentum_apply))
 register_updater(Updater("adam", _adam_init, _adam_apply))
+register_updater(Updater("ftrl", _ftrl_init, _ftrl_apply))

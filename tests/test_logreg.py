@@ -71,6 +71,33 @@ def test_converges_model_sharded(mesh8):
     assert app.accuracy(X, y) > 0.9
 
 
+def test_ftrl_updater(mesh_dp8):
+    """The reference LR app's FTRL-style objective (SURVEY.md §3.6):
+    selected like any other updater_type; AddOption defaults give a
+    near-zero L1 so plain convergence is preserved."""
+    X, y = synthetic_blobs(1024, input_dim=8, num_classes=2, seed=5)
+    app = LogisticRegression(
+        LogRegConfig(input_dim=8, num_classes=2, minibatch_size=128,
+                     epochs=5, learning_rate=0.5, updater="ftrl"),
+        mesh=mesh_dp8)
+    app.train(X, y)
+    assert app.accuracy(X, y) > 0.9
+
+
+def test_ftrl_l1_sparsifies_weights(mesh_dp8):
+    """ftrl_l1 flows through to the updater: a strong L1 leaves most
+    weights at exactly zero while the model still separates the data."""
+    X, y = synthetic_blobs(1024, input_dim=32, num_classes=2, seed=6)
+    app = LogisticRegression(
+        LogRegConfig(input_dim=32, num_classes=2, minibatch_size=128,
+                     epochs=5, learning_rate=0.5, updater="ftrl",
+                     ftrl_l1=1.0), mesh=mesh_dp8)
+    app.train(X, y)
+    w = np.asarray(app.table.get())
+    assert np.mean(w == 0.0) > 0.2, f"no sparsity: {np.mean(w == 0.0)}"
+    assert app.accuracy(X, y) > 0.8
+
+
 def test_adagrad_updater(mesh_dp8):
     X, y = synthetic_blobs(1024, input_dim=8, num_classes=2, seed=3)
     app = LogisticRegression(

@@ -17,7 +17,8 @@ def _rand(shape, seed):
 class TestRegistry:
     def test_names(self):
         names = updater_names()
-        for expected in ("default", "sgd", "adagrad", "momentum", "adam"):
+        for expected in ("default", "sgd", "adagrad", "momentum", "adam",
+                         "ftrl"):
             assert expected in names
 
     def test_unknown_raises(self):
@@ -95,6 +96,41 @@ class TestNumpyOracle:
             vhat = v / (1 - b2 ** t)
             p -= lr * mhat / (np.sqrt(vhat) + eps)
         np.testing.assert_allclose(got, p, rtol=1e-4)
+
+
+    def test_ftrl(self):
+        p0 = np.zeros(self.N, np.float32)   # FTRL starts from w=0 (z=n=0)
+        deltas = [_rand(self.N, i + 1) for i in range(5)]
+        alpha, beta, l1, l2 = 0.5, 1.0, 0.1, 0.01
+        got = self._run_jax("ftrl", p0, deltas,
+                            {"learning_rate": alpha, "momentum": beta,
+                             "lam": l1, "rho": l2})
+        p = p0.copy()
+        z = np.zeros(self.N, np.float32)
+        n = np.zeros(self.N, np.float32)
+        for g in deltas:
+            n_new = n + g * g
+            sigma = (np.sqrt(n_new) - np.sqrt(n)) / alpha
+            z = z + g - sigma * p
+            n = n_new
+            shrunk = np.sign(z) * np.maximum(np.abs(z) - l1, 0.0)
+            p = -shrunk / ((beta + np.sqrt(n)) / alpha + l2)
+        np.testing.assert_allclose(got, p, rtol=1e-4, atol=1e-6)
+
+    def test_ftrl_l1_produces_exact_zeros(self):
+        """The point of FTRL-proximal: strong L1 zeroes coordinates whose
+        accumulated gradient stays under the threshold."""
+        upd = get_updater("ftrl")
+        p = jnp.zeros(8)
+        st = upd.init_state(p)
+        # small gradient on lanes 0-3, large on 4-7
+        d = jnp.asarray([1e-3] * 4 + [1.0] * 4, jnp.float32)
+        opt = AddOption(learning_rate=0.5, momentum=1.0, lam=0.1,
+                        rho=0.0).as_jax()
+        p, st = jax.jit(upd.apply)(p, st, d, opt)
+        out = np.asarray(p)
+        assert np.all(out[:4] == 0.0)       # under the L1 threshold: exact 0
+        assert np.all(out[4:] != 0.0)
 
 
 class TestJitStability:
