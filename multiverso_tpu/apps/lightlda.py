@@ -198,15 +198,13 @@ class LightLDA:
                 f"got sampler={c.sampler!r}")
         if c.stream_blocks and not c.doc_blocked:
             raise ValueError("stream_blocks requires doc_blocked=True")
-        if c.stream_blocks and jax.process_count() > 1:
-            # per-call z readback assumes the aux output is fully
-            # addressable; multi-host needs per-process corpus shards
-            # with process-local staging — not built yet
-            raise NotImplementedError(
-                "stream_blocks is single-process for now: each process "
-                "would need its own corpus shard + process-local z "
-                "staging. Use the in-memory doc_blocked mode on "
-                "multi-host meshes.")
+        # stream_blocks works multi-host: staging assembles each call's
+        # operand from per-device slices (every process device_puts only
+        # its addressable lanes) and z readback walks addressable shards,
+        # so no process ever materialises another host's device data.
+        # Each process does keep the full HOST-side packed corpus (block
+        # packing is deterministic, so all processes agree on the
+        # layout); host RAM scales with corpus size, HBM does not.
         # tiled samplers support dp x mp meshes: the word-topic table and
         # its bf16 mirror stay row-sharded over the model axis (each chip
         # holds a [V/mp] vocab slice — the reference's Meta vocab-slicing
@@ -428,6 +426,7 @@ class LightLDA:
             self._tw_host = tw_p
             self._drel_host = drel_p
             self._z_host = z0
+            self._z_synced = True    # init z is globally consistent
             self._ndk = None
             # inverse packing map for doc_topics(): (block, row) -> doc
             self._doc_of_row = np.full((nb_pad, MAXD), -1, np.int64)
@@ -861,6 +860,13 @@ class LightLDA:
             z_out = z.reshape(S, B)
             acc = accumulate(acc, z_out.reshape(-1), tw.reshape(-1),
                              msk.reshape(-1))
+            # pin the aux z to the STAGING layout (lanes over the data
+            # axis): each process then drains exactly the lanes it will
+            # stage next sweep — without the constraint XLA may pick a
+            # different aux sharding and a multi-host process would read
+            # back lanes it does not own
+            z_out = lax.with_sharding_constraint(
+                z_out, NamedSharding(self.mesh, P(None, core.DATA_AXIS)))
             return (nk,), states, (acc,), z_out
 
         self._fused_stream = make_superstep(
@@ -901,15 +907,61 @@ class LightLDA:
 
         self._init_call = init_call
 
-    def _stream_stage(self, k: int) -> np.ndarray:
-        """Host side of staging call ``k``: one stacked [3, S, B] int32
-        array (words, doc-rows, z) — a single H2D transfer per call."""
+    def _block_rows(self, k: int, s0: int, s1: int, b0: int,
+                    b1: int) -> np.ndarray:
+        """Host block indices of the [s0:s1, b0:b1] lane rectangle of
+        call ``k`` — THE single (step, B-lane) → packed-host-block
+        mapping. Staging, z readback, and cross-host sync all go through
+        it so they cannot disagree on which blocks a device owns."""
+        TB = self._tb
+        nbs = self.config.batch_tokens // TB
+        return (k * self._per_call + np.arange(s0, s1)[:, None] * nbs
+                + b0 // TB + np.arange((b1 - b0) // TB)[None, :])
+
+    def _stream_stage(self, k: int):
+        """Host side of staging call ``k``. Single-process: one stacked
+        [3, S, B] int32 array (words, doc-rows, z) — a single H2D
+        transfer per call. Multi-process: a list of (device, local
+        chunk) covering ONLY this process's addressable lanes — the host
+        never materialises (or copies) the other hosts' share of the
+        call, so per-process host bandwidth scales with 1/P."""
         c = self.config
         S, B = c.steps_per_call, c.batch_tokens
-        sl = slice(k * self._per_call, (k + 1) * self._per_call)
-        return np.stack([self._tw_host[sl].reshape(S, B),
-                         self._drel_host[sl].reshape(S, B),
-                         self._z_host[sl].reshape(S, B)])
+        if jax.process_count() == 1:
+            sl = slice(k * self._per_call, (k + 1) * self._per_call)
+            return np.stack([self._tw_host[sl].reshape(S, B),
+                             self._drel_host[sl].reshape(S, B),
+                             self._z_host[sl].reshape(S, B)])
+        imap = self._stage_sharding.devices_indices_map((3, S, B))
+        parts = []
+        for d in self._stage_sharding.addressable_devices:
+            _csl, ssl, bsl = imap[d]
+            s0 = 0 if ssl.start is None else ssl.start
+            s1 = S if ssl.stop is None else ssl.stop
+            b0 = 0 if bsl.start is None else bsl.start
+            b1 = B if bsl.stop is None else bsl.stop
+            bidx = self._block_rows(k, s0, s1, b0, b1)
+            shp = (s1 - s0, b1 - b0)
+            parts.append((d, np.stack([
+                self._tw_host[bidx].reshape(shp),
+                self._drel_host[bidx].reshape(shp),
+                self._z_host[bidx].reshape(shp)])))
+        return parts
+
+    def _place_stream(self, staged) -> jax.Array:
+        """Place one staged call on the mesh. Single-process: one async
+        device_put. Multi-process: assemble the global array from the
+        per-device chunks ``_stream_stage`` built — each process
+        transfers ONLY its addressable lanes (process-local staging; the
+        cross-host layout is implied by the sharding, no host ever ships
+        another host's shard)."""
+        sh = self._stage_sharding
+        if jax.process_count() == 1:
+            return jax.device_put(staged, sh)
+        c = self.config
+        shape = (3, c.steps_per_call, c.batch_tokens)
+        shards = [jax.device_put(arr, d) for d, arr in staged]
+        return jax.make_array_from_single_device_arrays(shape, sh, shards)
 
     def _stream_calls(self):
         """Double-buffered H2D pipeline: host slices are stacked on a
@@ -922,7 +974,7 @@ class LightLDA:
                 yield k, self._stream_stage(k)
 
         for k, stacked in prefetch_iterator(gen(), depth=2):
-            yield k, jax.device_put(stacked, self._stage_sharding)
+            yield k, self._place_stream(stacked)
 
     def _init_streamed_counts(self) -> None:
         master = jnp.zeros(self.word_topic.storage_shape, jnp.int32)
@@ -933,6 +985,41 @@ class LightLDA:
             master, nk = self._init_call(master, nk, dev)
         self.word_topic.put_raw(master)
         self.summary.put_raw(nk)
+
+    def _sync_z_host(self) -> None:
+        """Make the host z copy globally complete (multi-process only).
+
+        Training never needs this: each process stages and drains exactly
+        the lanes its devices own. Full-z consumers (doc_topics, store)
+        call it lazily — the owned lanes are exchanged with ONE
+        ``process_allgather`` of equal-sized [n_own, TB] slabs (uniform
+        sharding ⇒ every process owns the same lane count; model-axis
+        replicas write identical data, which is idempotent)."""
+        if jax.process_count() == 1 or self._z_synced:
+            return
+        c = self.config
+        S, B = c.steps_per_call, c.batch_tokens
+        sh = NamedSharding(self.mesh, P(None, core.DATA_AXIS))
+        imap = sh.devices_indices_map((S, B))
+        offs = set()
+        for d in sh.addressable_devices:
+            ssl, bsl = imap[d]
+            s0 = 0 if ssl.start is None else ssl.start
+            s1 = S if ssl.stop is None else ssl.stop
+            b0 = 0 if bsl.start is None else bsl.start
+            b1 = B if bsl.stop is None else bsl.stop
+            offs.update(
+                self._block_rows(0, s0, s1, b0, b1).reshape(-1).tolist())
+        offs = np.sort(np.fromiter(offs, np.int64))
+        blocks = (np.arange(self.calls_per_sweep)[:, None] * self._per_call
+                  + offs[None, :]).reshape(-1)
+        from jax.experimental import multihost_utils
+        all_blocks = np.asarray(multihost_utils.process_allgather(blocks))
+        all_vals = np.asarray(multihost_utils.process_allgather(
+            self._z_host[blocks]))
+        for p in range(all_blocks.shape[0]):
+            self._z_host[all_blocks[p]] = all_vals[p]
+        self._z_synced = True
 
     def _sweep_streamed(self) -> None:
         wstale = self._to_stale(self.word_topic.raw())
@@ -945,9 +1032,19 @@ class LightLDA:
         pending: list = []
 
         def drain(item):
+            # write back by addressable shard: each process updates only
+            # the z lanes its own devices computed (multi-host safe;
+            # model-axis replicas rewrite identical data, which is fine)
             k, z_out = item
-            sl = slice(k * per_call, (k + 1) * per_call)
-            self._z_host[sl] = np.asarray(z_out).reshape(per_call, TB)
+            for shard in z_out.addressable_shards:
+                ssl, bsl = shard.index        # rectangular [S, B] chunk;
+                # XLA may shard the aux over EITHER axis, so honor both
+                s0 = 0 if ssl.start is None else ssl.start
+                b0 = 0 if bsl.start is None else bsl.start
+                data = np.asarray(shard.data)  # [S_local, B_local]
+                bidx = self._block_rows(k, s0, s0 + data.shape[0],
+                                        b0, b0 + data.shape[1])
+                self._z_host[bidx.reshape(-1)] = data.reshape(-1, TB)
 
         for k, dev in self._stream_calls():
             key = jax.random.fold_in(self._key, self._calls_done)
@@ -962,6 +1059,7 @@ class LightLDA:
                 drain(pending.pop(0))
         for item in pending:
             drain(item)
+        self._z_synced = False   # other processes' lanes are now stale
         self.word_topic.put_raw(acc)
 
     # -- count init --------------------------------------------------------
@@ -1390,6 +1488,7 @@ class LightLDA:
     def doc_topics(self) -> np.ndarray:
         """[num_docs, K] doc-topic counts (worker-local state)."""
         if self._docblock and self.config.stream_blocks:
+            self._sync_z_host()
             # host-side scatter over the host-resident z (chunked: the
             # temporaries stay bounded regardless of corpus size)
             out = np.zeros((self.num_docs, self.K), np.int32)
@@ -1464,8 +1563,11 @@ class LightLDA:
                 else np.dtype(self._ndk.dtype)
             dense = np.zeros((self.num_docs + 1, self.K), ndk_dtype)
             dense[:self.num_docs] = self.doc_topics()
-            z = self._z_host.reshape(-1) if self.config.stream_blocks \
-                else np.asarray(self._z).reshape(-1)
+            if self.config.stream_blocks:
+                self._sync_z_host()
+                z = self._z_host.reshape(-1)
+            else:
+                z = np.asarray(self._z).reshape(-1)
             layout = "docblock"
         else:
             dense = np.asarray(self._ndk).reshape(self.num_docs + 1,
@@ -1532,6 +1634,7 @@ class LightLDA:
             # from it per call, so the stored dense ndk is not needed
             self._z_host = np.asarray(data["z"]).reshape(z_shape) \
                 .astype(np.int32)
+            self._z_synced = True    # checkpoint z is globally complete
             self._calls_done = int(manifest.get("calls_done", 0))
             return
         self._z = self._place(

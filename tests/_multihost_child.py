@@ -116,6 +116,25 @@ def main() -> None:
     assert np.isfinite(ll), ll
     nwk = lda.word_topics()
     assert nwk.sum() == lda.num_tokens, (nwk.sum(), lda.num_tokens)
+    z_ref = np.asarray(lda._z)
+
+    # OUT-OF-CORE streamed mode across both processes: process-local
+    # staging (each host device_puts only its addressable lanes) and
+    # shard-local z readback must reproduce the in-memory run
+    # bit-identically — same kernels, same RNG, counts are a pure
+    # function of z at call boundaries
+    lda_s = LightLDA(tw_l, td_l, 16,
+                     LDAConfig(num_topics=128, batch_tokens=tb * 4,
+                               steps_per_call=2, seed=0, sampler="tiled",
+                               doc_blocked=True, block_tokens=tb,
+                               block_docs=16, stream_blocks=True),
+                     name="mh_lda_dbs")
+    lda_s.sweep()
+    lda_s._sync_z_host()   # full-z consumers trigger this lazily
+    np.testing.assert_array_equal(lda_s._z_host, z_ref)
+    np.testing.assert_array_equal(lda_s.word_topics(), nwk)
+    np.testing.assert_array_equal(lda_s.doc_topics(), lda.doc_topics())
+    assert np.isfinite(lda_s.loglik())
 
     core.barrier()
     reset_tables()
