@@ -25,22 +25,22 @@ Three-tier pipeline decomposition (each reported in the JSON line):
 - engine (`value`): pre-staged device operands — pure training engine.
 - engine_fed (`engine_fed_words_per_sec`): host batches pre-GENERATED,
   but every call runs the REAL per-call placement + dispatch path with
-  async overlap. Measured 0.84x of engine on the tunneled chip — the
+  async overlap. Measured ~0.9x of engine on the tunneled chip — the
   placement/dispatch design CAN feed the chip (one combined [S, B,
-  ctx+1] placement per call; placements overlap compute); the ~16% gap
-  is tunnel RPC latency on the placement path, which a PCIe-attached
+  ctx+1] int16 placement per call — ids ship as int16 when the vocab
+  fits, halving H2D bytes; placements overlap compute); the residual
+  gap is tunnel RPC cost on the placement path, which a PCIe-attached
   host does not pay.
 - e2e (`e2e_words_per_sec`): the whole pipeline including host pair
   GENERATION. `gen_words_per_sec` reports the WHOLE-HOST generation
-  rate (native C++ backend, one thread): measured ~2.3M words/s, above
-  ONE chip's engine rate — so on this 1-chip bench the e2e gap is
-  1-core time-slicing (the prefetch thread shares the core with
-  dispatch), not pipeline design: sequential 1/(1/gen + 1/engine_fed)
-  predicts the measured e2e within ~25%, and a ≥2-core attached host
-  overlaps them, making e2e == engine_fed. An n-chip mesh consumes
-  n × the engine rate: feeding it needs ~n generation threads (the
-  prefetch pipeline accepts parallel producers) — compare
-  gen_words_per_sec against n_chips × value before extrapolating.
+  rate (native C++ backend, one thread): measured well above ONE
+  chip's engine rate — so on this 1-chip bench the e2e gap is 1-core
+  time-slicing (the prefetch thread shares the core with dispatch),
+  not pipeline design: a ≥2-core attached host overlaps them, making
+  e2e approach engine_fed. An n-chip mesh consumes n × the engine
+  rate: feeding it needs ~n generation threads (the prefetch pipeline
+  accepts parallel producers) — compare gen_words_per_sec against
+  n_chips × value before extrapolating.
 """
 
 import json
@@ -63,7 +63,10 @@ WINDOW = 5
 NEGATIVE = 5
 SUBSAMPLE = 1e-3     # the reference default; both benches apply it
 BATCH = 4096
-STEPS_PER_CALL = 64
+# 512 steps/call amortizes the fixed per-dispatch cost (~15-45ms on the
+# tunneled chip; probe-measured — at 64 steps/call it was over HALF the
+# engine wall-clock). The prefetch pipeline batches to the same depth.
+STEPS_PER_CALL = 512
 WARMUP_CALLS = 2
 TIMED_CALLS = 8
 LR = 0.01

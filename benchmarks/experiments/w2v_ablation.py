@@ -1,0 +1,128 @@
+"""Attribute the w2v superstep's time: reimplement the scan step
+standalone and knock out one piece at a time.
+
+Variants (all same shapes: B=4096 pairs, K=5 negs, D=100, V=10k, S=64):
+  full        — production math (gather, sample, einsum, 2 scatter-adds)
+  noscatter   — gradients computed but both scatter-adds dropped
+  nosample    — negatives = fixed ids (alias sampling dropped)
+  nogather    — embeddings read as w[:B] slices instead of row gathers
+  bf16        — einsum operands cast to bf16 (f32 accumulation)
+  onehot      — scatter-adds via one-hot matmuls (MXU instead of scatter)
+
+Run: python benchmarks/experiments/w2v_ablation.py
+"""
+import json
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+V, D, B, K, S = 10_000, 100, 4096, 5, 64
+LR = 0.01
+WARMUP, TIMED = 2, 8
+
+
+def make_step(mode):
+    def scan_body(carry, inp):
+        w_in, w_out = carry
+        src, tgt, key = inp
+        if mode == "nogather":
+            v = lax.dynamic_slice_in_dim(w_in, 0, B)
+            u = lax.dynamic_slice_in_dim(w_out, 0, B)[:, None, :] \
+                * jnp.ones((1, 1 + K, 1))
+            ids = jnp.broadcast_to(tgt[:, None], (B, 1 + K))
+        else:
+            v = jnp.take(w_in, src, axis=0)
+            if mode == "nosample":
+                negs = jnp.broadcast_to(
+                    jnp.arange(K, dtype=jnp.int32)[None, :], (B, K))
+            else:
+                kj, ku = jax.random.split(key)
+                j = jax.random.randint(kj, (B, K), 0, V)
+                uu = jax.random.uniform(ku, (B, K))
+                negs = jnp.where(uu < 0.5, j, (j + 1) % V).astype(jnp.int32)
+            ids = jnp.concatenate([tgt[:, None], negs], axis=1)
+            u = jnp.take(w_out, ids, axis=0)
+        if mode == "bf16":
+            vb, ub = v.astype(jnp.bfloat16), u.astype(jnp.bfloat16)
+            logits = jnp.einsum("bd,bkd->bk", vb, ub,
+                                preferred_element_type=jnp.float32)
+        else:
+            logits = jnp.einsum("bd,bkd->bk", v, u)
+        labels = jnp.zeros_like(logits).at[:, 0].set(1.0)
+        sig = jax.nn.sigmoid(logits)
+        loss = -jnp.mean(jnp.sum(
+            labels * jax.nn.log_sigmoid(logits)
+            + (1.0 - labels) * jax.nn.log_sigmoid(-logits), axis=1))
+        g = (sig - labels) * LR
+        if mode == "bf16":
+            grad_v = jnp.einsum("bk,bkd->bd", g.astype(jnp.bfloat16), ub,
+                                preferred_element_type=jnp.float32)
+        else:
+            grad_v = jnp.einsum("bk,bkd->bd", g, u)
+        grad_u = g[:, :, None] * v[:, None, :]
+        if mode == "noscatter":
+            w_out = w_out + 0.0 * grad_u.sum() / V
+            w_in = w_in + 0.0 * grad_v.sum() / V
+        elif mode == "onehot":
+            oh_u = jax.nn.one_hot(ids.reshape(-1), V, dtype=jnp.bfloat16)
+            w_out = w_out - jnp.einsum(
+                "nv,nd->vd", oh_u,
+                grad_u.reshape(-1, D).astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32).astype(w_out.dtype)
+            oh_v = jax.nn.one_hot(src, V, dtype=jnp.bfloat16)
+            w_in = w_in - jnp.einsum(
+                "nv,nd->vd", oh_v, grad_v.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32).astype(w_in.dtype)
+        else:
+            w_out = w_out.at[ids.reshape(-1)].add(
+                -grad_u.reshape(-1, D))
+            w_in = w_in.at[src].add(-grad_v)
+        return (w_in, w_out), loss
+
+    @jax.jit
+    def call(w_in, w_out, srcs, tgts, key):
+        keys = jax.random.split(key, S)
+        (w_in, w_out), losses = lax.scan(
+            scan_body, (w_in, w_out), (srcs, tgts, keys))
+        return w_in, w_out, losses.mean()
+
+    return call
+
+
+def main():
+    rng = np.random.default_rng(0)
+    w_in = jnp.asarray(rng.uniform(-0.005, 0.005, (V, D)), jnp.float32)
+    w_out = jnp.zeros((V, D), jnp.float32)
+    srcs = jnp.asarray(rng.integers(0, V, (S, B)), jnp.int32)
+    tgts = jnp.asarray(rng.integers(0, V, (S, B)), jnp.int32)
+    results = []
+    for mode in ["full", "noscatter", "nosample", "nogather", "bf16",
+                 "onehot"]:
+        call = make_step(mode)
+        wi, wo = w_in, w_out
+        loss = None
+        for i in range(WARMUP):
+            wi, wo, loss = call(wi, wo, srcs, tgts, jax.random.PRNGKey(i))
+        float(loss)
+        t0 = time.perf_counter()
+        for i in range(TIMED):
+            wi, wo, loss = call(wi, wo, srcs, tgts, jax.random.PRNGKey(i))
+        loss = float(loss)
+        dt = time.perf_counter() - t0
+        results.append({"mode": mode,
+                        "us_per_step": round(dt / (TIMED * S) * 1e6, 1),
+                        "pairs_per_sec": round(TIMED * S * B / dt, 1),
+                        "loss": round(loss, 4)})
+        print(json.dumps(results[-1]), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1036,9 +1036,17 @@ class LightLDA:
             # the z lanes its own devices computed (multi-host safe;
             # model-axis replicas rewrite identical data, which is fine)
             k, z_out = item
+            seen = set()
             for shard in z_out.addressable_shards:
                 ssl, bsl = shard.index        # rectangular [S, B] chunk;
-                # XLA may shard the aux over EITHER axis, so honor both
+                # XLA may shard the aux over EITHER axis, so honor both.
+                # Model-axis replicas carry identical data — fetch each
+                # distinct chunk ONCE, not once per replica (mp x the
+                # D2H bytes on the per-call hot path otherwise)
+                key = (ssl.start, ssl.stop, bsl.start, bsl.stop)
+                if key in seen:
+                    continue
+                seen.add(key)
                 s0 = 0 if ssl.start is None else ssl.start
                 b0 = 0 if bsl.start is None else bsl.start
                 data = np.asarray(shard.data)  # [S_local, B_local]
@@ -1486,7 +1494,11 @@ class LightLDA:
         return total / max(self.num_tokens, 1)
 
     def doc_topics(self) -> np.ndarray:
-        """[num_docs, K] doc-topic counts (worker-local state)."""
+        """[num_docs, K] doc-topic counts (worker-local state).
+
+        Multi-process ``stream_blocks`` note: this is a COLLECTIVE —
+        the lazy z sync all-gathers owned lanes, so every process must
+        call it in lockstep (an ``if rank == 0:`` guard deadlocks)."""
         if self._docblock and self.config.stream_blocks:
             self._sync_z_host()
             # host-side scatter over the host-resident z (chunked: the
@@ -1552,7 +1564,12 @@ class LightLDA:
 
     def store(self, uri_prefix: str) -> None:
         """Checkpoint tables AND sampler state (z, doc-topic counts):
-        the three must stay consistent or resumed sweeps corrupt counts."""
+        the three must stay consistent or resumed sweeps corrupt counts.
+
+        Multi-process ``stream_blocks`` note: COLLECTIVE (like table
+        store) — the lazy z sync all-gathers owned lanes, so every
+        process must call it in lockstep (an ``if rank == 0:`` guard
+        deadlocks)."""
         from multiverso_tpu.tables.base import savez_stream
         self.word_topic.store(f"{uri_prefix}.word_topic.npz")
         self.summary.store(f"{uri_prefix}.summary.npz")

@@ -135,6 +135,25 @@ def main() -> None:
     np.testing.assert_array_equal(lda_s.word_topics(), nwk)
     np.testing.assert_array_equal(lda_s.doc_topics(), lda.doc_topics())
     assert np.isfinite(lda_s.loglik())
+    ref_dt = lda.doc_topics()
+
+    # and on a dp x mp mesh (2 x 2): model-axis replica dedup in the z
+    # drain, per-replica staging, and the sync's uniform-ownership
+    # allgather all run with REAL replicas; still bit-identical
+    from multiverso_tpu.tables import base as table_base
+    table_base.reset_tables()
+    core.shutdown()
+    core.set_mesh(Mesh(np.array(jax.devices()).reshape(2, 2),
+                       ("data", "model")))
+    lda_m = LightLDA(tw_l, td_l, 16,
+                     LDAConfig(num_topics=128, batch_tokens=tb * 4,
+                               steps_per_call=2, seed=0, sampler="tiled",
+                               doc_blocked=True, block_tokens=tb,
+                               block_docs=16, stream_blocks=True),
+                     name="mh_lda_dbs_mp")
+    lda_m.sweep()
+    np.testing.assert_array_equal(lda_m.word_topics(), nwk)
+    np.testing.assert_array_equal(lda_m.doc_topics(), ref_dt)
 
     core.barrier()
     reset_tables()

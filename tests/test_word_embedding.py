@@ -51,6 +51,14 @@ def test_build_alias_distribution():
     np.testing.assert_allclose(emp, probs, atol=0.005)
 
 
+def test_build_unigram_table_distribution():
+    from multiverso_tpu.apps.word_embedding import build_unigram_table
+    probs = np.array([0.5, 0.25, 0.125, 0.125])
+    table = build_unigram_table(probs, 1 << 16)
+    counts = np.bincount(table, minlength=4) / (1 << 16)
+    np.testing.assert_allclose(counts, probs, atol=1e-4)
+
+
 def test_build_alias_degenerate():
     prob, alias = build_alias(np.array([1.0]))
     assert prob[0] == 1.0
