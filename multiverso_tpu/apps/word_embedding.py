@@ -429,6 +429,19 @@ class WordEmbedding:
         return float(va @ vb / max(np.linalg.norm(va) * np.linalg.norm(vb),
                                    1e-12))
 
+    def save_text(self, path: str) -> None:
+        """The reference word2vec's text output format: a header line
+        ``vocab_size dim`` then one ``word v1 .. vD`` line per word.
+        Collective (the embedding fetch is); only process 0 writes."""
+        emb = self.embeddings()
+        if core.rank() != 0:
+            return
+        words = self.corpus.words
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(f"{len(words)} {emb.shape[1]}\n")
+            for w, row in zip(words, emb):
+                f.write(w + " " + " ".join(f"{x:.6g}" for x in row) + "\n")
+
     def store(self, uri_prefix: str) -> None:
         self.w_in.store(f"{uri_prefix}.in.npz")
         self.w_out.store(f"{uri_prefix}.out.npz")
@@ -452,6 +465,7 @@ def main(argv=None) -> None:
     configure.define_float("sample", 1e-3, "subsampling threshold", overwrite=True)
     configure.define_int("min_count", 5, "vocab min count", overwrite=True)
     configure.define_string("output_file", "", "embedding checkpoint prefix", overwrite=True)
+    configure.define_string("output_text", "", "text-format embedding dump (the reference's output format)", overwrite=True)
     core.init(argv)
     train_file = configure.get_flag("train_file")
     if not train_file:
@@ -476,6 +490,9 @@ def main(argv=None) -> None:
     out = configure.get_flag("output_file")
     if out:
         app.store(out)
+    out_text = configure.get_flag("output_text")
+    if out_text:
+        app.save_text(out_text)
     core.barrier()
 
 

@@ -92,6 +92,25 @@ def main() -> None:
     acc = slr.accuracy(rows, y)
     assert acc > 0.75, acc
 
+    # word2vec across both processes: pair stream device_put sharded
+    # over the data axis spanning hosts, embeddings on the 2x2 mesh
+    from multiverso_tpu.apps.word_embedding import W2VConfig, WordEmbedding
+    from multiverso_tpu.data.corpus import Corpus
+    from multiverso_tpu.data.native import CorpusData
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 50, 4000).astype(np.int32)
+    counts = np.maximum(np.bincount(ids, minlength=50), 1).astype(np.int64)
+    corpus = Corpus(CorpusData(words=[f"w{i}" for i in range(50)],
+                               counts=counts, ids=ids,
+                               total_raw_tokens=len(ids)), subsample=0)
+    w2v = WordEmbedding(corpus,
+                        W2VConfig(embedding_dim=16, window=2, negative=3,
+                                  batch_size=64, steps_per_call=2,
+                                  epochs=1, subsample=0, seed=0),
+                        name="mh_w2v")
+    w2v.train(total_steps=4)
+    assert np.all(np.isfinite(w2v.loss_history))
+
     # the flagship doc-blocked LDA sampler across BOTH processes: a
     # shard_map'd pallas kernel (interpret mode on CPU) with per-chip
     # block ownership and psum'd summary deltas over the 2-host mesh

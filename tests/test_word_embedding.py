@@ -86,6 +86,57 @@ def test_variants_loss_decreases(mesh_dp8, tmp_path, model, objective):
     assert late < early, f"loss did not decrease: {early:.3f} -> {late:.3f}"
 
 
+def test_save_text_format(mesh_dp8, tmp_path):
+    """The reference word2vec's text dump: header + word-per-line."""
+    corpus, _ = _clustered_corpus(tmp_path, n_sents=100)
+    cfg = W2VConfig(embedding_dim=8, window=2, negative=2, batch_size=128,
+                    steps_per_call=2, epochs=1, subsample=0, seed=0)
+    app = WordEmbedding(corpus, cfg, mesh=mesh_dp8, name="w2v_txt")
+    app.train(total_steps=2)
+    out = tmp_path / "vec.txt"
+    app.save_text(str(out))
+    lines = out.read_text().splitlines()
+    v, d = map(int, lines[0].split())
+    assert v == corpus.vocab_size and d == 8
+    assert len(lines) == v + 1
+    first = lines[1].split()
+    assert first[0] == corpus.words[0] and len(first) == 1 + d
+
+
+def test_alias_sampler_config(mesh_dp8, tmp_path):
+    """ns_sampler='alias' (the exact Vose draw) keeps training — the
+    default moved to the reference's unigram-table draw."""
+    corpus, _ = _clustered_corpus(tmp_path, n_sents=300)
+    cfg = W2VConfig(embedding_dim=16, window=3, negative=4,
+                    batch_size=256, steps_per_call=4, learning_rate=0.05,
+                    epochs=1, subsample=0, seed=1, ns_sampler="alias")
+    app = WordEmbedding(corpus, cfg, mesh=mesh_dp8, name="w2v_alias")
+    app.train()
+    hist = app.loss_history
+    assert len(hist) >= 6 and np.all(np.isfinite(hist))
+    assert np.mean(hist[-3:]) < np.mean(hist[:3])
+
+
+def test_large_vocab_int32_pairs(mesh_dp8):
+    """Vocab past the int16 range must ship pairs as int32 (the _place
+    dtype switch) and still train."""
+    from multiverso_tpu.data.native import CorpusData
+    from multiverso_tpu.data.corpus import Corpus
+    v = 40_000
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, v, 20_000).astype(np.int32)
+    counts = np.maximum(np.bincount(ids, minlength=v), 1).astype(np.int64)
+    corpus = Corpus(CorpusData(words=[f"w{i}" for i in range(v)],
+                               counts=counts, ids=ids,
+                               total_raw_tokens=len(ids)), subsample=0)
+    cfg = W2VConfig(embedding_dim=8, window=2, negative=2, batch_size=256,
+                    steps_per_call=2, epochs=1, subsample=0, seed=0)
+    app = WordEmbedding(corpus, cfg, mesh=mesh_dp8, name="w2v_bigv")
+    assert app._scratch >= np.iinfo(np.int16).max  # int32 path active
+    app.train(total_steps=4)
+    assert np.all(np.isfinite(app.loss_history))
+
+
 def test_skipgram_recovers_clusters(mesh_dp8, tmp_path):
     corpus, clusters = _clustered_corpus(tmp_path, n_sents=800, seed=3)
     cfg = W2VConfig(embedding_dim=24, window=3, negative=5,
