@@ -122,6 +122,18 @@ class LDAConfig:
     # of a sweep-end full-stream rebuild (integer-identical). Device HBM
     # use is INDEPENDENT of corpus size: word table + mirror + summary
     # + two in-flight call buffers.
+    local_corpus: bool = False      # stream_blocks only: PER-PROCESS
+    # corpus shards — each process passes ONLY its own (token_words,
+    # token_docs) slice (global doc ids, disjoint doc sets) and packs
+    # its docs into exactly the block slots its devices own; host RAM
+    # per process scales with the LOCAL shard, the reference's
+    # workers-each-read-their-own-DataBlocks model. Geometry (calls per
+    # sweep, global doc/token counts) is agreed collectively at init.
+    # z init hashes (seed, GLOBAL block slot, position), so a slot's
+    # draw doesn't depend on which process owns it — but a doc's slot
+    # comes from greedy packing of the LOCAL shard, so changing the
+    # doc-to-process split (or process count) still changes
+    # trajectories; only a fixed layout is deterministic.
     mh_steps: int = 2               # MH: rounds of (word + doc) proposal
     precision: str = "float32"      # posterior/CDF math dtype; bfloat16
     # is measured equal-speed at large batches (the op mix is not
@@ -149,6 +161,19 @@ def load_docs(path: str) -> Tuple[np.ndarray, np.ndarray, int]:
     token_docs = np.repeat(doc_of_entry, word_counts)
     vocab = int(word_ids.max()) + 1 if len(word_ids) else 1
     return token_words, token_docs, vocab
+
+
+def _hash_z(seed: int, gblocks: np.ndarray, tb: int, K: int) -> np.ndarray:
+    """Process-independent z init for local_corpus mode: splitmix64 of
+    (seed, global block, position) mod K — any process computes the same
+    draw for a given slot without materialising the global stream."""
+    x = (gblocks.astype(np.uint64)[:, None] * np.uint64(tb)
+         + np.arange(tb, dtype=np.uint64)[None, :]
+         + (np.uint64(seed & 0xFFFFFFFF) << np.uint64(32)))
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    x = x ^ (x >> np.uint64(31))
+    return (x % np.uint64(K)).astype(np.int32)
 
 
 def _predictive_ll(A, W, S, m, alpha, beta, K, vbeta):
@@ -198,13 +223,26 @@ class LightLDA:
                 f"got sampler={c.sampler!r}")
         if c.stream_blocks and not c.doc_blocked:
             raise ValueError("stream_blocks requires doc_blocked=True")
+        if c.local_corpus and not c.stream_blocks:
+            raise ValueError("local_corpus requires stream_blocks=True")
+        if c.local_corpus and jax.process_count() > 1:
+            # per-process corpus shards: agree on the global doc-id
+            # space and token count (loglik normalization, count
+            # invariants) before any geometry is derived
+            from jax.experimental import multihost_utils
+            g = np.asarray(multihost_utils.process_allgather(
+                np.array([self.num_docs, self.num_tokens], np.int64)))
+            self.num_docs = int(g[:, 0].max())
+            self.num_tokens = int(g[:, 1].sum())
         # stream_blocks works multi-host: staging assembles each call's
         # operand from per-device slices (every process device_puts only
         # its addressable lanes) and z readback walks addressable shards,
         # so no process ever materialises another host's device data.
-        # Each process does keep the full HOST-side packed corpus (block
-        # packing is deterministic, so all processes agree on the
-        # layout); host RAM scales with corpus size, HBM does not.
+        # By default each process keeps the full HOST-side packed corpus
+        # (deterministic packing keeps layouts agreed); with
+        # local_corpus=True each process passes and packs ONLY its own
+        # doc shard, so host RAM also scales 1/P — the reference's
+        # workers-each-read-their-own-DataBlocks model.
         # tiled samplers support dp x mp meshes: the word-topic table and
         # its bf16 mirror stay row-sharded over the model axis (each chip
         # holds a [V/mp] vocab slice — the reference's Meta vocab-slicing
@@ -385,14 +423,42 @@ class LightLDA:
         n_blocks = (b + 1) if n_real else 1
         nbs = B // TB                       # blocks per scan step
         per_call = S * nbs
-        n_calls = -(-n_blocks // per_call)
-        nb_pad = n_calls * per_call
+        self._per_call = per_call
+        self._tb, self._maxd = TB, MAXD
+        local = c.stream_blocks and c.local_corpus
+        if local:
+            # per-process corpus shard: this process packs its docs into
+            # ONLY the block slots its devices own (the reference's
+            # workers-each-own-their-DataBlocks model); the other
+            # processes fill the rest of the global block space
+            self._own_offs = self._owned_call_offsets()
+            self._own_per_call = cap = len(self._own_offs)
+            n_calls = -(-n_blocks // cap)
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+                mask = np.zeros(per_call, np.int32)
+                mask[self._own_offs] = 1
+                owners = np.asarray(multihost_utils.process_allgather(
+                    mask)).sum(axis=0)
+                if not np.all(owners == 1):
+                    raise ValueError(
+                        "local_corpus requires every data lane to be "
+                        "owned by exactly one process (got per-lane "
+                        f"owner counts {sorted(set(owners.tolist()))}); "
+                        "shard the mesh's data axis across processes")
+                n_calls = int(np.asarray(multihost_utils.process_allgather(
+                    np.array([n_calls]))).max())
+        else:
+            cap = per_call
+            n_calls = -(-n_blocks // cap)
+        nb_alloc = n_calls * cap            # blocks on THIS process
+        nb_pad = n_calls * per_call         # GLOBAL padded block count
         self.calls_per_sweep = n_calls
-        self._nb_pad, self._tb, self._maxd = nb_pad, TB, MAXD
+        self._nb_pad = nb_pad
 
-        tw_p = np.full((nb_pad, TB), self._scratch_word, np.int32)
-        drel_p = np.full((nb_pad, TB), MAXD - 1, np.int32)
-        mask_p = np.zeros((nb_pad, TB), np.int32)
+        tw_p = np.full((nb_alloc, TB), self._scratch_word, np.int32)
+        drel_p = np.full((nb_alloc, TB), MAXD - 1, np.int32)
+        mask_p = np.zeros((nb_alloc, TB), np.int32)
         # -1 = document with zero tokens (never packed into any block);
         # doc_topics()/store() must yield zero rows for those, not some
         # other document's counts
@@ -408,16 +474,21 @@ class LightLDA:
             mask_p.reshape(-1)[flat] = 1
             self._blk_of_doc[doc_ids] = blk
             self._row_of_doc[doc_ids] = row
-        fill = mask_p.sum() / max(nb_pad * TB, 1)
+        fill = mask_p.sum() / max(nb_alloc * TB, 1)
         self.packing_fill = float(fill)
         log.info("lda doc_blocked: %d blocks (%d/call, %.0f%% fill)",
-                 nb_pad, per_call, 100 * fill)
-        self._per_call = per_call
+                 nb_alloc, cap, 100 * fill)
 
-        # random init z (shared by both residency modes so the streamed
-        # and in-memory runs are bit-identical for the same seed)
-        rng = np.random.default_rng(c.seed)
-        z0 = rng.integers(0, self.K, (nb_pad, TB)).astype(np.int32)
+        # init z — shared by both residency modes so the streamed and
+        # in-memory runs are bit-identical for the same seed. local mode
+        # instead hashes (seed, GLOBAL block, position) so the draw for
+        # a given slot is independent of the process layout
+        if local:
+            z0 = _hash_z(c.seed, self._global_of_local(
+                np.arange(nb_alloc, dtype=np.int64)), TB, self.K)
+        else:
+            rng = np.random.default_rng(c.seed)
+            z0 = rng.integers(0, self.K, (nb_pad, TB)).astype(np.int32)
 
         if c.stream_blocks:
             # OUT-OF-CORE: stream/z/doc-counts stay host-resident (the
@@ -429,7 +500,7 @@ class LightLDA:
             self._z_synced = True    # init z is globally consistent
             self._ndk = None
             # inverse packing map for doc_topics(): (block, row) -> doc
-            self._doc_of_row = np.full((nb_pad, MAXD), -1, np.int64)
+            self._doc_of_row = np.full((nb_alloc, MAXD), -1, np.int64)
             valid = self._blk_of_doc >= 0
             self._doc_of_row[self._blk_of_doc[valid],
                              self._row_of_doc[valid]] = \
@@ -907,6 +978,45 @@ class LightLDA:
 
         self._init_call = init_call
 
+    def _owned_call_offsets(self) -> np.ndarray:
+        """Sorted per-call block offsets owned by THIS process's devices
+        under the staging layout (lanes over the data axis). Model-axis
+        replicas collapse to one entry."""
+        c = self.config
+        S, B = c.steps_per_call, c.batch_tokens
+        sh = NamedSharding(self.mesh, P(None, core.DATA_AXIS))
+        imap = sh.devices_indices_map((S, B))
+        offs = set()
+        for d in sh.addressable_devices:
+            ssl, bsl = imap[d]
+            s0 = 0 if ssl.start is None else ssl.start
+            s1 = S if ssl.stop is None else ssl.stop
+            b0 = 0 if bsl.start is None else bsl.start
+            b1 = B if bsl.stop is None else bsl.stop
+            # call-0 block ids ARE the per-call offsets — go through
+            # _block_rows so ownership can never desync from staging
+            offs.update(
+                self._block_rows(0, s0, s1, b0, b1).reshape(-1).tolist())
+        return np.sort(np.fromiter(offs, np.int64))
+
+    def _global_of_local(self, l: np.ndarray) -> np.ndarray:
+        """local_corpus: host-array block index -> global block id
+        (identity otherwise — host arrays ARE globally indexed then)."""
+        if not (self.config.stream_blocks and self.config.local_corpus):
+            return l
+        k, pos = np.divmod(l, self._own_per_call)
+        return k * self._per_call + self._own_offs[pos]
+
+    def _local_of_global(self, g: np.ndarray) -> np.ndarray:
+        """local_corpus: global block id -> host-array index. Only ever
+        called for blocks this process owns (staging/drain walk the
+        process's own lanes)."""
+        if not (self.config.stream_blocks and self.config.local_corpus):
+            return g
+        k, off = np.divmod(g, self._per_call)
+        return k * self._own_per_call + np.searchsorted(self._own_offs,
+                                                        off)
+
     def _block_rows(self, k: int, s0: int, s1: int, b0: int,
                     b1: int) -> np.ndarray:
         """Host block indices of the [s0:s1, b0:b1] lane rectangle of
@@ -940,7 +1050,8 @@ class LightLDA:
             s1 = S if ssl.stop is None else ssl.stop
             b0 = 0 if bsl.start is None else bsl.start
             b1 = B if bsl.stop is None else bsl.stop
-            bidx = self._block_rows(k, s0, s1, b0, b1)
+            bidx = self._local_of_global(
+                self._block_rows(k, s0, s1, b0, b1))
             shp = (s1 - s0, b1 - b0)
             parts.append((d, np.stack([
                 self._tw_host[bidx].reshape(shp),
@@ -995,22 +1106,12 @@ class LightLDA:
         ``process_allgather`` of equal-sized [n_own, TB] slabs (uniform
         sharding ⇒ every process owns the same lane count; model-axis
         replicas write identical data, which is idempotent)."""
-        if jax.process_count() == 1 or self._z_synced:
+        if jax.process_count() == 1 or self._z_synced \
+                or self.config.local_corpus:
+            # local_corpus: z is per-process BY DESIGN (each process owns
+            # its shard's lanes); there is no global host z to complete
             return
-        c = self.config
-        S, B = c.steps_per_call, c.batch_tokens
-        sh = NamedSharding(self.mesh, P(None, core.DATA_AXIS))
-        imap = sh.devices_indices_map((S, B))
-        offs = set()
-        for d in sh.addressable_devices:
-            ssl, bsl = imap[d]
-            s0 = 0 if ssl.start is None else ssl.start
-            s1 = S if ssl.stop is None else ssl.stop
-            b0 = 0 if bsl.start is None else bsl.start
-            b1 = B if bsl.stop is None else bsl.stop
-            offs.update(
-                self._block_rows(0, s0, s1, b0, b1).reshape(-1).tolist())
-        offs = np.sort(np.fromiter(offs, np.int64))
+        offs = self._owned_call_offsets()
         blocks = (np.arange(self.calls_per_sweep)[:, None] * self._per_call
                   + offs[None, :]).reshape(-1)
         from jax.experimental import multihost_utils
@@ -1050,8 +1151,9 @@ class LightLDA:
                 s0 = 0 if ssl.start is None else ssl.start
                 b0 = 0 if bsl.start is None else bsl.start
                 data = np.asarray(shard.data)  # [S_local, B_local]
-                bidx = self._block_rows(k, s0, s0 + data.shape[0],
-                                        b0, b0 + data.shape[1])
+                bidx = self._local_of_global(
+                    self._block_rows(k, s0, s0 + data.shape[0],
+                                     b0, b0 + data.shape[1]))
                 self._z_host[bidx.reshape(-1)] = data.reshape(-1, TB)
 
         for k, dev in self._stream_calls():
@@ -1498,14 +1600,16 @@ class LightLDA:
 
         Multi-process ``stream_blocks`` note: this is a COLLECTIVE —
         the lazy z sync all-gathers owned lanes, so every process must
-        call it in lockstep (an ``if rank == 0:`` guard deadlocks)."""
+        call it in lockstep (an ``if rank == 0:`` guard deadlocks).
+        Under ``local_corpus`` there is no sync: the returned counts
+        cover THIS process's docs; other processes' rows are zero."""
         if self._docblock and self.config.stream_blocks:
             self._sync_z_host()
             # host-side scatter over the host-resident z (chunked: the
             # temporaries stay bounded regardless of corpus size)
             out = np.zeros((self.num_docs, self.K), np.int32)
             chunk = max(1, (1 << 22) // self._tb)     # ~4M tokens
-            for lo in range(0, self._nb_pad, chunk):
+            for lo in range(0, len(self._tw_host), chunk):
                 sl = slice(lo, lo + chunk)
                 tw, drel = self._tw_host[sl], self._drel_host[sl]
                 z = self._z_host[sl]
@@ -1574,17 +1678,25 @@ class LightLDA:
         self.word_topic.store(f"{uri_prefix}.word_topic.npz")
         self.summary.store(f"{uri_prefix}.summary.npz")
         if self._docblock:
-            # z is indexed in the packed block layout; ndk exports as the
-            # dense [D, K] logical counts
-            ndk_dtype = np.int16 if self.config.stream_blocks \
-                else np.dtype(self._ndk.dtype)
-            dense = np.zeros((self.num_docs + 1, self.K), ndk_dtype)
-            dense[:self.num_docs] = self.doc_topics()
-            if self.config.stream_blocks:
-                self._sync_z_host()
+            if self.config.local_corpus:
+                # per-process shard: z alone is the sampler state (load
+                # for streamed layouts never reads ndk) — a global-size
+                # dense ndk per rank would defeat the 1/P host scaling
+                dense = np.zeros((0, self.K), np.int16)
                 z = self._z_host.reshape(-1)
             else:
-                z = np.asarray(self._z).reshape(-1)
+                # z is indexed in the packed block layout; ndk exports
+                # as the dense [D, K] logical counts (the in-memory
+                # loader rebuilds its blocked counts from it)
+                ndk_dtype = np.int16 if self.config.stream_blocks \
+                    else np.dtype(self._ndk.dtype)
+                dense = np.zeros((self.num_docs + 1, self.K), ndk_dtype)
+                dense[:self.num_docs] = self.doc_topics()
+                if self.config.stream_blocks:
+                    self._sync_z_host()
+                    z = self._z_host.reshape(-1)
+                else:
+                    z = np.asarray(self._z).reshape(-1)
             layout = "docblock"
         else:
             dense = np.asarray(self._ndk).reshape(self.num_docs + 1,
@@ -1602,15 +1714,33 @@ class LightLDA:
             # lengths with different block geometry must not load
             manifest["block_tokens"] = self.config.block_tokens
             manifest["block_docs"] = self.config.block_docs
-        savez_stream(f"{uri_prefix}.state.npz", manifest,
-                     {"z": z, "ndk": dense})
+        state_path = f"{uri_prefix}.state.npz"
+        if self.config.local_corpus:
+            # per-process sampler-state shard (z and doc counts are
+            # process-local under local_corpus); same process layout
+            # required to resume
+            manifest["layout"] = "docblock_local"
+            manifest["processes"] = jax.process_count()
+            state_path = (f"{uri_prefix}.state"
+                          f".rank{jax.process_index()}.npz")
+        savez_stream(state_path, manifest, {"z": z, "ndk": dense})
 
     def load(self, uri_prefix: str) -> None:
         from multiverso_tpu.tables.base import loadz_stream
         self.word_topic.load(f"{uri_prefix}.word_topic.npz")
         self.summary.load(f"{uri_prefix}.summary.npz")
-        manifest, data = loadz_stream(f"{uri_prefix}.state.npz",
+        state_path = f"{uri_prefix}.state.npz"
+        if self.config.local_corpus:
+            state_path = (f"{uri_prefix}.state"
+                          f".rank{jax.process_index()}.npz")
+        manifest, data = loadz_stream(state_path,
                                       "multiverso_tpu.lda_state.v1")
+        if self.config.local_corpus and \
+                manifest.get("processes") != jax.process_count():
+            raise ValueError(
+                f"local_corpus checkpoint was written by "
+                f"{manifest.get('processes')} processes, app has "
+                f"{jax.process_count()}: z shards are per-process")
         if manifest["num_tokens"] != self.num_tokens:
             raise ValueError(
                 f"checkpoint has {manifest['num_tokens']} tokens, app has "
@@ -1621,7 +1751,8 @@ class LightLDA:
                 f"{manifest['perm_seed']}, app has seed "
                 f"{self.config.seed}: z is indexed in the seed-derived "
                 "stream permutation, so the seeds must match to resume")
-        my_layout = "docblock" if self._docblock else "stream"
+        my_layout = "stream" if not self._docblock else \
+            ("docblock_local" if self.config.local_corpus else "docblock")
         ck_layout = manifest.get("layout", "stream")
         if ck_layout != my_layout:
             raise ValueError(

@@ -174,6 +174,35 @@ def main() -> None:
     np.testing.assert_array_equal(lda_m.word_topics(), nwk)
     np.testing.assert_array_equal(lda_m.doc_topics(), ref_dt)
 
+    # PER-PROCESS corpus shards (local_corpus): each rank passes ONLY
+    # its own docs (disjoint by parity, global doc ids); device-side
+    # counts must equal the host recount allgathered across ranks, and
+    # the run must be deterministic
+    from jax.experimental import multihost_utils
+    reset_tables()
+    core.set_mesh(Mesh(np.array(jax.devices()).reshape(4, 1),
+                       ("data", "model")))
+    mine = (td_l % 2) == pid
+    lda_lc = LightLDA(tw_l[mine], td_l[mine], 16,
+                      LDAConfig(num_topics=128, batch_tokens=tb * 4,
+                                steps_per_call=2, seed=0,
+                                sampler="tiled", doc_blocked=True,
+                                block_tokens=tb, block_docs=16,
+                                stream_blocks=True, local_corpus=True),
+                      name="mh_lda_lc")
+    assert lda_lc.num_tokens == len(tw_l)       # global, agreed
+    lda_lc.sweep()
+    nwk_lc = lda_lc.word_topics()
+    assert nwk_lc.sum() == len(tw_l)
+    local_count = np.zeros((16, 128), np.int64)
+    valid = lda_lc._tw_host < 16
+    np.add.at(local_count, (lda_lc._tw_host[valid],
+                            lda_lc._z_host[valid]), 1)
+    total = np.asarray(multihost_utils.process_allgather(
+        local_count)).sum(axis=0)
+    np.testing.assert_array_equal(total, nwk_lc.astype(np.int64))
+    assert np.isfinite(lda_lc.loglik())
+
     core.barrier()
     reset_tables()
     print(f"MULTIHOST_OK rank={pid}")

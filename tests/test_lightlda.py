@@ -518,6 +518,69 @@ def test_docblock_streamed_model_parallel(devices, docs):
     core.shutdown()
 
 
+def test_local_corpus_single_process(mesh_dp8, docs):
+    """local_corpus on one process owns every lane — count invariants
+    hold, training improves, and the run is deterministic."""
+    tw, td, V = docs
+    kw = dict(num_topics=128, batch_tokens=2048, steps_per_call=2,
+              seed=1, sampler="tiled", doc_blocked=True,
+              block_tokens=256, block_docs=8, stream_blocks=True,
+              local_corpus=True)
+    app = LightLDA(tw, td, V, LDAConfig(**kw), mesh=mesh_dp8,
+                   name="lc_a")
+    app.train(num_iterations=3)
+    nwk = app.word_topics()
+    assert nwk.sum() == app.num_tokens
+    # host recount of (tw, z) must equal the device-side master
+    recount = np.zeros((V, app.K), np.int64)
+    valid = app._tw_host < V
+    np.add.at(recount, (app._tw_host[valid], app._z_host[valid]), 1)
+    np.testing.assert_array_equal(recount, nwk.astype(np.int64))
+    assert app.ll_history[-1] > app.ll_history[0]
+    dt = app.doc_topics()
+    lens = np.bincount(td, minlength=app.num_docs)
+    np.testing.assert_array_equal(dt.sum(1), lens)
+    table_base.reset_tables()
+
+    app2 = LightLDA(tw, td, V, LDAConfig(**kw), mesh=mesh_dp8,
+                    name="lc_b")
+    app2.train(num_iterations=3)
+    np.testing.assert_array_equal(app2.word_topics(), nwk)
+
+
+def test_local_corpus_checkpoint_roundtrip(mesh_dp8, docs, tmp_path):
+    """local_corpus store/load: per-rank z shard (no global dense ndk);
+    resumed training continues deterministically."""
+    tw, td, V = docs
+    kw = dict(num_topics=128, batch_tokens=2048, steps_per_call=2,
+              seed=1, sampler="tiled", doc_blocked=True,
+              block_tokens=256, block_docs=8, stream_blocks=True,
+              local_corpus=True)
+    app = LightLDA(tw, td, V, LDAConfig(**kw), mesh=mesh_dp8,
+                   name="lcc_a")
+    app.train(num_iterations=2)
+    app.store(str(tmp_path / "ck"))
+    app.train(num_iterations=1)
+    want = app.word_topics()
+    table_base.reset_tables()
+
+    app2 = LightLDA(tw, td, V, LDAConfig(**kw), mesh=mesh_dp8,
+                    name="lcc_b")
+    app2.load(str(tmp_path / "ck"))
+    app2.train(num_iterations=1)
+    np.testing.assert_array_equal(app2.word_topics(), want)
+
+
+def test_local_corpus_requires_stream(mesh_dp8, docs):
+    tw, td, V = docs
+    with pytest.raises(ValueError, match="local_corpus requires"):
+        LightLDA(tw, td, V,
+                 LDAConfig(num_topics=128, batch_tokens=2048,
+                           steps_per_call=2, sampler="tiled",
+                           doc_blocked=True, local_corpus=True),
+                 mesh=mesh_dp8, name="lc_bad")
+
+
 def test_docblock_streamed_checkpoint_crossmode(mesh_dp8, docs, tmp_path):
     """A streamed checkpoint resumes in an in-memory app (same packed z
     layout) and vice versa."""
