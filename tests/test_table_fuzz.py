@@ -107,3 +107,77 @@ def test_random_op_sequences_match_numpy(mesh8, tmp_path, tiled, updater):
             assert t.generation == expect_gen
 
     np.testing.assert_allclose(t.get(), mirror.m, rtol=2e-4, atol=2e-4)
+
+
+class KVMirror:
+    """KVTable contract in numpy: dict of key -> (value, state)."""
+
+    def __init__(self, dim, updater, lr):
+        self.d = {}
+        self.dim = dim
+        self.updater = updater
+        self.lr = lr
+
+    def add(self, keys, deltas):
+        for k, dv in zip(keys, deltas):
+            old, h = self.d.get(int(k),
+                                (np.zeros(self.dim, np.float64),
+                                 np.zeros(self.dim, np.float64)))
+            dv = dv.astype(np.float64)
+            if self.updater == "default":
+                new = old + dv
+            elif self.updater == "sgd":
+                new = old - self.lr * dv
+            else:                        # adagrad, eps = AddOption.lam
+                h = h + dv * dv
+                new = old - self.lr * dv / (np.sqrt(h) + 1e-8)
+            self.d[int(k)] = (new, h)
+
+    def get(self, keys):
+        vals = np.stack([self.d.get(int(k), (np.zeros(self.dim),))[0]
+                         for k in keys])
+        found = np.array([int(k) in self.d for k in keys])
+        return vals, found
+
+
+@pytest.mark.parametrize("updater", ["default", "sgd", "adagrad"])
+def test_kv_random_op_sequences_match_dict(mesh8, tmp_path, updater):
+    """The device-side slot probe (no host mirror) against a dict: random
+    interleavings of add (new + existing keys), get (hit + miss), len,
+    and checkpoint round-trips."""
+    from multiverso_tpu.tables import KVTable
+    dim, lr = 3, 0.25
+    keyspace = np.array([3, 9, 17, 1 << 40, (1 << 63) + 5, 1234567,
+                         42, 7, 2**32 - 1, 2**32], np.uint64)
+    rng = np.random.default_rng(
+        99 + ["default", "sgd", "adagrad"].index(updater))
+    t = KVTable(256, value_dim=dim, updater=updater, name=f"kvf_{updater}",
+                default_option=AddOption(learning_rate=lr, lam=1e-8))
+    mirror = KVMirror(dim, updater, lr)
+
+    for step in range(30):
+        op = rng.integers(0, 4)
+        if op == 0:                          # add a unique random subset
+            n = int(rng.integers(1, len(keyspace) + 1))
+            ks = rng.choice(keyspace, n, replace=False)
+            d = rng.normal(0, 1, (n, dim)).astype(np.float32)
+            t.add(ks, d, sync=bool(rng.integers(0, 2)))
+            mirror.add(ks, d)
+        elif op == 1:                        # lookup hits and misses
+            qs = np.concatenate([rng.choice(keyspace, 3),
+                                 np.array([999999], np.uint64)])
+            vals, found = t.get(qs)
+            mvals, mfound = mirror.get(qs)
+            np.testing.assert_array_equal(found, mfound)
+            np.testing.assert_allclose(vals, mvals, rtol=2e-4, atol=2e-4)
+        elif op == 2:                        # live-key count
+            assert len(t) == len(mirror.d)
+        else:                                # checkpoint round-trip
+            uri = str(tmp_path / f"kvf_{step}.npz")
+            t.store(uri)
+            t.load(uri)
+
+    vals, found = t.get(keyspace)
+    mvals, mfound = mirror.get(keyspace)
+    np.testing.assert_array_equal(found, mfound)
+    np.testing.assert_allclose(vals, mvals, rtol=2e-4, atol=2e-4)
