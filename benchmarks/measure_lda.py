@@ -58,7 +58,7 @@ def measure_cpu(sweeps: int = 2, curve: bool = False) -> dict:
     return json.loads(out)
 
 
-def _tpu_app(sampler: str):
+def _tpu_app(sampler: str, steps_per_call: int = 1):
     import numpy as np
     from multiverso_tpu import core
     from multiverso_tpu.apps.lightlda import LightLDA, LDAConfig
@@ -74,13 +74,19 @@ def _tpu_app(sampler: str):
         num_topics=K_TPU,
         # doc-blocked batches must be a block_tokens multiple
         batch_tokens=512_000 if tiled else BATCH,
-        steps_per_call=1, seed=1, sampler=sampler,
+        # steps_per_call=1 measured fastest on a quiet tunnel (19.6M
+        # tok/s; 4 and 10 were 15.7/14.3M) — but when the tunnel's
+        # per-dispatch cost degrades, more steps/call amortizes it
+        # (same lever as bench.py's 512 steps/call); pass it as argv[2]
+        # to re-measure under current conditions
+        steps_per_call=steps_per_call, seed=1, sampler=sampler,
         stale_words=tiled, doc_blocked=tiled))
 
 
-def measure_tpu(sampler: str = "tiled", timed_sweeps: int = 3) -> dict:
+def measure_tpu(sampler: str = "tiled", timed_sweeps: int = 3,
+                steps_per_call: int = 1) -> dict:
     import numpy as np
-    app = _tpu_app(sampler)
+    app = _tpu_app(sampler, steps_per_call)
     app.sweep()                                   # compile + first sweep
 
     def sync():
@@ -182,7 +188,8 @@ if __name__ == "__main__":
         print(json.dumps(result, indent=2))
         sys.exit(0)
     cpu = pinned_cpu()
-    tpu = measure_tpu(sampler_arg)
+    spc = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    tpu = measure_tpu(sampler_arg, steps_per_call=spc)
     result = {
         "metric": "LightLDA doc-tokens/sec",
         "cpu_worker": cpu,
