@@ -228,10 +228,10 @@ class LightLDA:
         if c.local_corpus and jax.process_count() > 1:
             # per-process corpus shards: agree on the global doc-id
             # space and token count (loglik normalization, count
-            # invariants) before any geometry is derived
-            from jax.experimental import multihost_utils
-            g = np.asarray(multihost_utils.process_allgather(
-                np.array([self.num_docs, self.num_tokens], np.int64)))
+            # invariants) before any geometry is derived (int64-safe:
+            # process_allgather truncates int64 to int32 without x64)
+            from multiverso_tpu.parallel.multihost import allgather_i64
+            g = allgather_i64([self.num_docs, self.num_tokens])
             self.num_docs = int(g[:, 0].max())
             self.num_tokens = int(g[:, 1].sum())
         # stream_blocks works multi-host: staging assembles each call's
@@ -435,19 +435,12 @@ class LightLDA:
             self._own_per_call = cap = len(self._own_offs)
             n_calls = -(-n_blocks // cap)
             if jax.process_count() > 1:
-                from jax.experimental import multihost_utils
+                from multiverso_tpu.parallel.multihost import (
+                    allgather_i64, validate_single_owner)
                 mask = np.zeros(per_call, np.int32)
                 mask[self._own_offs] = 1
-                owners = np.asarray(multihost_utils.process_allgather(
-                    mask)).sum(axis=0)
-                if not np.all(owners == 1):
-                    raise ValueError(
-                        "local_corpus requires every data lane to be "
-                        "owned by exactly one process (got per-lane "
-                        f"owner counts {sorted(set(owners.tolist()))}); "
-                        "shard the mesh's data axis across processes")
-                n_calls = int(np.asarray(multihost_utils.process_allgather(
-                    np.array([n_calls]))).max())
+                validate_single_owner(mask, "local_corpus")
+                n_calls = int(allgather_i64([n_calls]).max())
         else:
             cap = per_call
             n_calls = -(-n_blocks // cap)

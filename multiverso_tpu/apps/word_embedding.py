@@ -76,6 +76,13 @@ class W2VConfig:
     # for the same purpose; 1M slots bounds per-word probability error
     # at ~1e-6 of mass, negligible for NS)
     max_code_len: int = 40      # HS: Huffman code pad length
+    local_data: bool = False    # multi-process: each process generates
+    # ONLY its devices' share of every batch from ITS OWN corpus shard
+    # (seed folded with the rank so streams differ) — the reference's
+    # workers-each-stream-their-own-corpus model. batch_size stays the
+    # GLOBAL batch; processes must own disjoint data lanes (validated).
+    # Call counts are agreed collectively from the shards' sizes; each
+    # process cycles its local corpus to fill the agreed schedule.
     seed: int = 0
     dtype: str = "float32"
 
@@ -191,7 +198,48 @@ class WordEmbedding:
         self._key = core.prng_key(c.seed, mesh=self.mesh)
         self._step_no = 0
         self.loss_history: list = []
+        self._local_chunks = None   # local_data: [(device, b0, b1), ...]
+        if c.local_data and jax.process_count() > 1:
+            self._setup_local_data()
         self._build_superstep()
+
+    def _setup_local_data(self) -> None:
+        """Per-process data lanes: which contiguous B-chunks of the
+        global batch this process's devices own (sorted by offset), with
+        a single-owner validation across processes and a shared-
+        dictionary check (the replicated NS table / Huffman arrays and
+        the table shapes are all built from the local corpus — every
+        process must hold the SAME dictionary, only the token stream is
+        per-process)."""
+        import zlib
+        from multiverso_tpu.parallel.multihost import (
+            allgather_i64, owned_axis_slices, validate_single_owner)
+        c = self.config
+        B = c.batch_size
+        sh = NamedSharding(self.mesh, P(None, core.DATA_AXIS, None))
+        self._dev_slices = owned_axis_slices(
+            sh, (c.steps_per_call, B, 1), axis=1)
+        # distinct chunks (in-process model replicas share one), sorted:
+        # the local batch is their concatenation in offset order
+        self._local_chunks = sorted({(b0, b1)
+                                     for _, b0, b1 in self._dev_slices})
+        self._local_batch = sum(b1 - b0 for b0, b1 in self._local_chunks)
+        mask = np.zeros(B, np.int32)
+        for b0, b1 in self._local_chunks:
+            mask[b0:b1] = 1
+        validate_single_owner(mask, "local_data")
+        counts = np.ascontiguousarray(
+            np.asarray(self.corpus.unigram_probs(c.unigram_power),
+                       np.float64))
+        digest = np.array([self.corpus.vocab_size,
+                           zlib.crc32(counts.tobytes())], np.int64)
+        gathered = allgather_i64(digest)
+        if not np.all(gathered == gathered[0]):
+            raise ValueError(
+                "local_data requires the SAME dictionary (vocab + "
+                "frequencies) on every process — only the token stream "
+                f"is per-process; got per-rank (vocab, counts-crc32) = "
+                f"{gathered.tolist()}")
 
     # -- the fused superstep ----------------------------------------------
 
@@ -302,13 +350,30 @@ class WordEmbedding:
         pairs = np.concatenate([srcs, tgts[..., None]], axis=-1)
         if self._scratch < np.iinfo(np.int16).max:
             pairs = pairs.astype(np.int16)
-        return jax.device_put(pairs, NamedSharding(
-            self.mesh, P(None, core.DATA_AXIS, None)))
+        sh = NamedSharding(self.mesh, P(None, core.DATA_AXIS, None))
+        if self._local_chunks is None:
+            return jax.device_put(pairs, sh)
+        # local_data: ``pairs`` is this process's [S, B_local, C] share;
+        # slice it back out per device (replicas get the same chunk) and
+        # assemble the global array — no process ships another's lanes
+        c = self.config
+        off = {}
+        acc = 0
+        for b0, b1 in self._local_chunks:
+            off[b0] = acc
+            acc += b1 - b0
+        shards = [jax.device_put(
+            pairs[:, off[b0]:off[b0] + (b1 - b0)], d)
+            for d, b0, b1 in self._dev_slices]
+        return jax.make_array_from_single_device_arrays(
+            (c.steps_per_call, c.batch_size, pairs.shape[-1]), sh, shards)
 
     # -- training ----------------------------------------------------------
 
     def _batches(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         c = self.config
+        if self._local_chunks is not None:
+            return self._local_batches()
         if c.model == "skipgram":
             it = self.corpus.skipgram_batches(
                 c.batch_size, window=c.window, seed=c.seed, epochs=c.epochs)
@@ -317,6 +382,37 @@ class WordEmbedding:
         return self.corpus.cbow_batches(
             c.batch_size, window=c.window, seed=c.seed, epochs=c.epochs,
             pad_id=self._scratch)
+
+    def _local_batches(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """local_data: this process's [*, B_local] share of every batch
+        from ITS corpus shard, rank-folded seed, cycling the shard
+        forever (train() bounds the loop with the agreed call count)."""
+        c = self.config
+        rank = jax.process_index()
+        bl = self._local_batch
+        epoch = 0
+        while True:
+            seed = c.seed + 7919 * (rank + 1) + 104729 * epoch
+            if c.model == "skipgram":
+                it = self.corpus.skipgram_batches(
+                    bl, window=c.window, seed=seed, epochs=1)
+            else:
+                it = self.corpus.cbow_batches(
+                    bl, window=c.window, seed=seed, epochs=1,
+                    pad_id=self._scratch)
+            got = False
+            for item in it:
+                got = True
+                yield item
+            if not got:
+                # an empty shard must fail LOUDLY: returning here would
+                # leave this process with zero dispatches while the
+                # others run the agreed collective schedule — deadlock
+                raise ValueError(
+                    f"local_data: this process's corpus shard yields no "
+                    f"{self._local_batch}-pair batches; every process "
+                    "must contribute data (or drop local_data)")
+            epoch += 1
 
     def train(self, total_steps: Optional[int] = None) -> float:
         """Run the full training loop; returns the final mean loss."""
@@ -327,12 +423,22 @@ class WordEmbedding:
                              f"data-axis size {d}")
         # linear lr decay over the whole corpus (reference's alpha decay);
         # skip-gram emits ~2b pairs per center, b ~ U[1, window] -> E = w+1
-        est_pairs = self.corpus.num_tokens * c.epochs * (c.window + 1) \
-            if c.model == "skipgram" else self.corpus.num_tokens * c.epochs
+        tokens = self.corpus.num_tokens
+        if self._local_chunks is not None and jax.process_count() > 1:
+            # local_data: the schedule must be identical on every
+            # process — agree on the GLOBAL token count (int64-safe)
+            from multiverso_tpu.parallel.multihost import allgather_i64
+            tokens = int(allgather_i64([tokens]).sum())
+        est_pairs = tokens * c.epochs * (c.window + 1) \
+            if c.model == "skipgram" else tokens * c.epochs
         est_calls = max(int(est_pairs) //
                         (c.batch_size * c.steps_per_call), 1)
         if total_steps is not None:
             est_calls = max(total_steps // c.steps_per_call, 1)
+        elif self._local_chunks is not None:
+            # the cycling local generator never exhausts — the agreed
+            # schedule is the stop condition
+            total_steps = est_calls * c.steps_per_call
 
         srcs_buf, tgts_buf = [], []
         losses, call_no = [], 0

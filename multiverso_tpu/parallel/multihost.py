@@ -1,0 +1,64 @@
+"""Multi-host helpers shared by the per-process data-shard modes
+(lightlda ``local_corpus``, word2vec ``local_data``).
+
+``jax.experimental.multihost_utils.process_allgather`` canonicalizes
+int64 down to int32 when ``jax_enable_x64`` is off (the default), so
+counts past 2^31 would silently wrap — :func:`allgather_i64` ships the
+two 32-bit halves instead.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+
+def allgather_i64(vals) -> np.ndarray:
+    """process_allgather of an int64 vector without x64 truncation.
+    Returns [P, n] int64 (single-process: [1, n])."""
+    import jax
+    v = np.atleast_1d(np.asarray(vals, np.int64))
+    if jax.process_count() == 1:
+        return v[None]
+    from jax.experimental import multihost_utils
+    hi = (v >> np.int64(32)).astype(np.int32)
+    lo = (v & np.int64(0xFFFFFFFF)).astype(np.int32)
+    g = np.asarray(multihost_utils.process_allgather(
+        np.stack([hi, lo])))                        # [P, 2, n] int32
+    return (g[:, 0].astype(np.int64) << np.int64(32)) \
+        | (g[:, 1].astype(np.int64) & np.int64(0xFFFFFFFF))
+
+
+def validate_single_owner(mask: np.ndarray, what: str) -> None:
+    """Every lane owned by exactly one process, or raise. ``mask`` is
+    this process's 0/1 ownership vector over the lane space."""
+    import jax
+    if jax.process_count() == 1:
+        if not np.all(mask == 1):
+            raise ValueError(
+                f"{what}: single process must own every lane")
+        return
+    from jax.experimental import multihost_utils
+    owners = np.asarray(multihost_utils.process_allgather(
+        mask.astype(np.int32))).sum(axis=0)
+    if not np.all(owners == 1):
+        raise ValueError(
+            f"{what} requires every data lane to be owned by exactly "
+            f"one process (got per-lane owner counts "
+            f"{sorted(set(owners.tolist()))}); shard the mesh's data "
+            "axis across processes")
+
+
+def owned_axis_slices(sharding, shape: Tuple[int, ...],
+                      axis: int) -> List[Tuple[object, int, int]]:
+    """[(device, lo, hi)] — every addressable device's chunk of ``axis``
+    under ``sharding`` (None-start/stop normalized)."""
+    imap = sharding.devices_indices_map(shape)
+    out = []
+    for d in sharding.addressable_devices:
+        sl = imap[d][axis]
+        lo = 0 if sl.start is None else sl.start
+        hi = shape[axis] if sl.stop is None else sl.stop
+        out.append((d, lo, hi))
+    return out

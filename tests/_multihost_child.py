@@ -111,6 +111,26 @@ def main() -> None:
     w2v.train(total_steps=4)
     assert np.all(np.isfinite(w2v.loss_history))
 
+    # local_data: shared dictionary, PER-RANK token stream — each
+    # process generates only its devices' half of every batch from its
+    # own shard (the reference's workers-each-stream-their-own-corpus)
+    rng_r = np.random.default_rng(100 + pid)
+    ids_r = rng_r.integers(0, 50, 3000).astype(np.int32)
+    corpus_r = Corpus(CorpusData(words=[f"w{i}" for i in range(50)],
+                                 counts=counts, ids=ids_r,
+                                 total_raw_tokens=len(ids_r)),
+                      subsample=0)
+    w2v_l = WordEmbedding(corpus_r,
+                          W2VConfig(embedding_dim=16, window=2,
+                                    negative=3, batch_size=64,
+                                    steps_per_call=2, epochs=1,
+                                    subsample=0, seed=0,
+                                    local_data=True),
+                          name="mh_w2v_local")
+    assert w2v_l._local_batch == 32     # half the global batch per rank
+    w2v_l.train(total_steps=4)
+    assert np.all(np.isfinite(w2v_l.loss_history))
+
     # the flagship doc-blocked LDA sampler across BOTH processes: a
     # shard_map'd pallas kernel (interpret mode on CPU) with per-chip
     # block ownership and psum'd summary deltas over the 2-host mesh

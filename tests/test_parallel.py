@@ -106,3 +106,32 @@ class TestUlyssesAttention:
         with pytest.raises(ValueError, match="divide"):
             ulysses_attention(jnp.asarray(q), jnp.asarray(k),
                               jnp.asarray(v), mesh=mesh_dp8)
+
+
+class TestMultihostHelpers:
+    """Single-process behavior of the shared local-shard helpers (the
+    2-process paths run in tests/test_multihost.py)."""
+
+    def test_allgather_i64_roundtrips_big_values(self):
+        from multiverso_tpu.parallel.multihost import allgather_i64
+        vals = [3, (1 << 40) + 7, (1 << 62) + 123]   # past int32
+        out = allgather_i64(vals)
+        assert out.shape == (1, 3)
+        assert out[0].tolist() == vals
+
+    def test_validate_single_owner_single_process(self):
+        import pytest as _pytest
+        from multiverso_tpu.parallel.multihost import validate_single_owner
+        validate_single_owner(np.ones(8, np.int32), "t")
+        with _pytest.raises(ValueError, match="own every lane"):
+            validate_single_owner(np.array([1, 0, 1, 1], np.int32), "t")
+
+    def test_owned_axis_slices_cover_axis(self, mesh_dp8):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from multiverso_tpu.parallel.multihost import owned_axis_slices
+        sh = NamedSharding(mesh_dp8, P(None, "data", None))
+        slices = owned_axis_slices(sh, (2, 64, 3), axis=1)
+        lanes = np.zeros(64, np.int32)
+        for _d, lo, hi in slices:
+            lanes[lo:hi] += 1
+        assert np.all(lanes >= 1)        # full coverage
