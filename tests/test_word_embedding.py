@@ -86,6 +86,21 @@ def test_variants_loss_decreases(mesh_dp8, tmp_path, model, objective):
     assert late < early, f"loss did not decrease: {early:.3f} -> {late:.3f}"
 
 
+def test_local_batches_empty_shard_raises(mesh_dp8, tmp_path):
+    """A shard too small to yield one local batch must raise, not return
+    — a silent return would deadlock the other processes' collective
+    schedule (the 2-process happy path runs in test_multihost)."""
+    corpus, _ = _clustered_corpus(tmp_path, n_sents=5)
+    cfg = W2VConfig(embedding_dim=8, window=2, negative=2, batch_size=64,
+                    steps_per_call=2, epochs=1, subsample=0, seed=0)
+    app = WordEmbedding(corpus, cfg, mesh=mesh_dp8, name="w2v_empty")
+    assert app._local_chunks is None      # single-process: mode inert
+    app._local_chunks = [(0, 64)]
+    app._local_batch = 1 << 20            # no shard can fill this
+    with pytest.raises(ValueError, match="yields no"):
+        next(app._local_batches())
+
+
 def test_save_text_format(mesh_dp8, tmp_path):
     """The reference word2vec's text dump: header + word-per-line."""
     corpus, _ = _clustered_corpus(tmp_path, n_sents=100)
