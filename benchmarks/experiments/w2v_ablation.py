@@ -12,13 +12,9 @@ Variants (all same shapes: B=4096 pairs, K=5 negs, D=100, V=10k, S=64):
 Run: python benchmarks/experiments/w2v_ablation.py
 """
 import json
-import sys
 import time
-from functools import partial
 
 import numpy as np
-
-sys.path.insert(0, "/root/repo")
 
 import jax
 import jax.numpy as jnp

@@ -174,7 +174,9 @@ class Table:
         updater_name = updater if updater is not None \
             else configure.get_flag("updater_type")
         self.updater: Updater = get_updater(updater_name)
-        self.default_option = default_option or AddOption()
+        from multiverso_tpu.updaters.updaters import resolve_default_option
+        self.default_option = resolve_default_option(updater_name,
+                                                     default_option)
         self._option_lock = threading.Lock()
         # monotonically increasing update counter backing the Handle
         # generation contract (bumped on every applied update/load)

@@ -102,7 +102,9 @@ class KVTable:
         updater_name = updater if updater is not None \
             else configure.get_flag("updater_type")
         self.updater = get_updater(updater_name)
-        self.default_option = default_option or AddOption()
+        from multiverso_tpu.updaters.updaters import resolve_default_option
+        self.default_option = resolve_default_option(updater_name,
+                                                     default_option)
         self._option_lock = threading.Lock()
         self.generation = 0
 
@@ -129,7 +131,9 @@ class KVTable:
         self.state = jax.tree.map(
             lambda s: jax.device_put(s, self._val_sharding),
             self.updater.init_state(self.values))
-        self._pending_over = None   # deferred overflow flag (device scalar)
+        self._pending_over: list = []  # deferred overflow flags (device
+        # scalars, one per in-flight add; drained non-blocking in add,
+        # blocking at every other table op)
         self._build_jits()
         self.table_id = _register(self)  # type: ignore[arg-type]
         log.debug("kv table %r: %d buckets x %d slots (capacity %d)",
@@ -233,23 +237,42 @@ class KVTable:
                              "sentinel")
         return keys
 
+    def _raise_overflow(self, n_over: int) -> None:
+        raise RuntimeError(
+            f"kv table {self.name!r}: {n_over} keys overflowed their "
+            f"buckets ({self.slots} slots) in a previous add (the "
+            "batch was dropped atomically); raise capacity or "
+            "slots_per_bucket. NOTE: the dropped add still advanced "
+            "the table generation and option step (its buffers were "
+            "swapped; overflow is only known after device execution) — "
+            "re-issue the dropped batch after resizing")
+
     def _check_overflow(self) -> None:
-        """Raise any pending overflow from the previous async add. The
-        check is DEFERRED so ``add(sync=False)`` stays fire-and-forget
-        (an eager scalar readback would serialize host and device every
-        minibatch); the overflowed batch was dropped atomically on
-        device, so the table is consistent — the error just surfaces at
-        the next table op (or ``wait``)."""
-        pending, self._pending_over = self._pending_over, None
-        if pending is None:
-            return
-        n_over = int(np.asarray(pending))
+        """Raise any pending overflow from previous async adds —
+        BLOCKING (drains every in-flight flag). Called by every table
+        op except ``add``: their own D2H results already serialize
+        behind the in-flight updates, so the extra readback costs
+        nothing; the overflowed batches were dropped atomically on
+        device, so the table is consistent."""
+        pending, self._pending_over = self._pending_over, []
+        n_over = sum(int(np.asarray(p)) for p in pending)
         if n_over:
-            raise RuntimeError(
-                f"kv table {self.name!r}: {n_over} keys overflowed their "
-                f"buckets ({self.slots} slots) in the previous add (the "
-                "batch was dropped atomically); raise capacity or "
-                "slots_per_bucket")
+            self._raise_overflow(n_over)
+
+    def _poll_overflow(self) -> None:
+        """Non-blocking drain for the ``add`` hot path: only flags whose
+        device scalar is already computed are inspected, so back-to-back
+        ``add(sync=False)`` calls keep pipelining (a blocking readback
+        here would cap the async queue at depth 1 — the exact
+        serialization the deferral exists to avoid)."""
+        still, ready = [], []
+        for p in self._pending_over:
+            is_ready = getattr(p, "is_ready", None)
+            (ready if is_ready is None or is_ready() else still).append(p)
+        self._pending_over = still
+        n_over = sum(int(np.asarray(p)) for p in ready)
+        if n_over:
+            self._raise_overflow(n_over)
 
     # -- API ---------------------------------------------------------------
 
@@ -272,8 +295,13 @@ class KVTable:
 
         Duplicate keys within one batch must be pre-aggregated (the
         client-side Aggregator role) — they raise otherwise.
+
+        On bucket overflow the batch is dropped atomically ON DEVICE and
+        the error surfaces at a later table op; the returned Handle and
+        the option step still advance (overflow is unknowable at
+        dispatch time without serializing the async queue).
         """
-        self._check_overflow()
+        self._poll_overflow()
         keys = self._check_keys(keys)
         uniq = np.unique(keys)
         if len(uniq) != len(keys):
@@ -286,10 +314,11 @@ class KVTable:
         buckets = self._buckets_of(keys)
         opt = (option or self.default_option).as_jax(self.mesh)
         put = lambda a: core.place(a, mesh=self.mesh)
-        self.keys, self.values, self.state, self._pending_over = \
+        self.keys, self.values, self.state, n_over = \
             self._probe_update(
                 self.keys, self.values, self.state, put(buckets),
                 put(_split_keys(keys)), put(deltas), opt)
+        self._pending_over.append(n_over)
         with self._option_lock:
             self.default_option.step += 1
             self.generation += 1
@@ -336,6 +365,10 @@ class KVTable:
         savez_stream(uri, manifest, payload)
 
     def load(self, uri: str) -> None:
+        # load is a table op: a pending overflow surfaces HERE, before
+        # the restore replaces the state it refers to (a post-load raise
+        # about pre-load state would be spurious)
+        self._check_overflow()
         manifest, data = loadz_stream(uri, self.KV_MAGIC)
         for field in ("num_buckets", "slots", "value_dim", "dtype"):
             mine = getattr(self, field) if field != "dtype" \

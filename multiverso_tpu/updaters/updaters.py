@@ -185,6 +185,29 @@ def _ftrl_apply(param, state, delta, option):
              "n": jax.tree.map(lambda x: x[2], flat, is_leaf=is_tup)})
 
 
+def resolve_default_option(updater_name: str,
+                           option: "AddOption | None") -> AddOption:
+    """Table-constructor helper: the right default AddOption for an
+    updater. The generic defaults are adam-oriented (momentum=0.9,
+    rho=0.999) — under ``ftrl``'s field mapping those would silently
+    become beta=0.9 and L2=0.999, so a missing option resolves to
+    ``AddOption.for_ftrl()`` instead, and a generic-looking option gets
+    a loud warning pointing at the mapping."""
+    if updater_name != "ftrl":
+        return option or AddOption()
+    if option is None:
+        return AddOption.for_ftrl(AddOption().learning_rate)
+    if option.momentum == 0.9 and option.rho == 0.999:
+        from multiverso_tpu.utils import log
+        log.warn(
+            "updater='ftrl' reads AddOption fields as (lam, rho, "
+            "momentum) = (L1, L2, beta); this option carries the "
+            "generic adam-oriented defaults (momentum=0.9, rho=0.999), "
+            "which mean beta=0.9 and L2=0.999 under ftrl — build it "
+            "with AddOption.for_ftrl(lr, l1, l2, beta) instead")
+    return option
+
+
 _REGISTRY: Dict[str, Updater] = {}
 
 

@@ -386,6 +386,39 @@ class TestKVTable:
         _, found = t.get(same)
         assert not found.any()
 
+    def test_overflow_surfaces_at_load_not_after(self, mesh8, tmp_path):
+        """load() is a table op: a pending overflow raises BEFORE the
+        restore replaces the state it refers to; the restored table
+        carries no stale flag."""
+        t = KVTable(8, slots_per_bucket=1, updater="default",
+                    name="kv_ovl")
+        t.add([5], [1.0], sync=True)
+        uri = str(tmp_path / "kv.npz")
+        t.store(uri)
+        b0 = t._buckets_of(np.asarray([1], np.uint64))[0]
+        same = [k for k in range(1, 5000)
+                if t._buckets_of(np.asarray([k], np.uint64))[0] == b0][:2]
+        t.add(same, [1.0, 2.0])          # async overflow, flag pending
+        with pytest.raises(RuntimeError, match="overflow"):
+            t.load(uri)
+        t.load(uri)                      # flag consumed; restore works
+        vals, found = t.get([5])
+        assert found.all() and vals[0] == 1.0
+
+    def test_async_adds_pipeline_without_readback(self, mesh8):
+        """Back-to-back async adds queue freely; every pending overflow
+        flag (one per in-flight add) drains at the next blocking op."""
+        t = KVTable(1 << 10, value_dim=2, updater="default",
+                    name="kv_pipe")
+        ks = np.arange(1, 9, dtype=np.uint64)
+        for i in range(6):
+            t.add(ks, np.full((8, 2), float(i + 1), np.float32))
+        t.wait()
+        assert t._pending_over == []     # all drained
+        vals, found = t.get(ks)
+        assert found.all()
+        np.testing.assert_allclose(vals, 21.0)   # 1+2+..+6
+
 
 class TestCheckpoint:
     def test_array_store_load(self, mesh8, tmp_path):

@@ -133,6 +133,28 @@ class TestNumpyOracle:
         assert np.all(out[4:] != 0.0)
 
 
+class TestDefaultOptionResolution:
+    def test_ftrl_table_default_is_ftrl_shaped(self):
+        """A table built with updater='ftrl' and no option must NOT
+        inherit the adam-oriented AddOption defaults (momentum=0.9 ->
+        beta, rho=0.999 -> a huge L2)."""
+        from multiverso_tpu.updaters.updaters import resolve_default_option
+        opt = resolve_default_option("ftrl", None)
+        assert opt.momentum == 1.0      # beta
+        assert opt.rho == 0.0           # L2
+        assert opt.lam == 0.0           # L1
+
+    def test_other_updaters_keep_generic_defaults(self):
+        from multiverso_tpu.updaters.updaters import resolve_default_option
+        opt = resolve_default_option("adam", None)
+        assert opt.momentum == 0.9 and opt.rho == 0.999
+
+    def test_explicit_option_passes_through(self):
+        from multiverso_tpu.updaters.updaters import resolve_default_option
+        mine = AddOption.for_ftrl(0.3, l1=0.5)
+        assert resolve_default_option("ftrl", mine) is mine
+
+
 class TestJitStability:
     def test_lr_change_no_retrace(self):
         """AddOption values are traced operands — changing lr must not
