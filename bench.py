@@ -170,13 +170,19 @@ def main() -> None:
     # (placement included) vs e2e (generation included) decomposes the
     # pipeline. Dispatches stay async until the final loss fence, so
     # placements overlap compute exactly as the prefetch pipeline would.
+    # Best of 2 passes: the tunneled chip's RPC latency swings a LOT
+    # between runs (observed 2x intra-day) and this tier exists to
+    # measure the placement DESIGN, not tunnel weather; the engine tier
+    # above is dispatch-amortized and stays stable without this.
     ef_loss = dispatch(0, app._place(*host_calls[0]))   # warm the path
     float(ef_loss)
-    t0 = time.perf_counter()
-    for i, (s, t) in enumerate(host_calls[WARMUP_CALLS:]):
-        ef_loss = dispatch(i, app._place(s, t))
-    float(ef_loss)
-    ef_dt = time.perf_counter() - t0
+    ef_dt = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for i, (s, t) in enumerate(host_calls[WARMUP_CALLS:]):
+            ef_loss = dispatch(i, app._place(s, t))
+        float(ef_loss)
+        ef_dt = min(ef_dt, time.perf_counter() - t0)
     ef_pairs = TIMED_CALLS * BATCH * STEPS_PER_CALL
     ef_words = ef_pairs / ef_dt / pairs_per_token / max(n_chips, 1)
 
@@ -186,16 +192,22 @@ def main() -> None:
     # separate jit cache entry — compile must stay out of the timing.
     e2e_calls = 10
     app.train(total_steps=STEPS_PER_CALL)
-    steps_before = app._step_no
-    t0 = time.perf_counter()
-    app.train(total_steps=e2e_calls * STEPS_PER_CALL)
-    e2e_dt = time.perf_counter() - t0
-    # count the steps actually dispatched: a corpus epoch exhausting
-    # early would otherwise silently inflate the number
-    e2e_pairs = (app._step_no - steps_before) * BATCH
-    if e2e_pairs == 0:
-        raise SystemExit("e2e run dispatched no steps (corpus exhausted)")
-    e2e_words = e2e_pairs / pairs_per_token / e2e_dt / max(n_chips, 1)
+    e2e_words, e2e_dt = 0.0, float("inf")
+    for _ in range(2):          # best of 2 (same tunnel-noise rationale
+        steps_before = app._step_no            # as the engine-fed tier)
+        t0 = time.perf_counter()
+        app.train(total_steps=e2e_calls * STEPS_PER_CALL)
+        dt_pass = time.perf_counter() - t0
+        # count the steps actually dispatched: a corpus epoch exhausting
+        # early would otherwise silently inflate the number
+        e2e_pairs = (app._step_no - steps_before) * BATCH
+        if e2e_pairs == 0:
+            raise SystemExit("e2e run dispatched no steps "
+                             "(corpus exhausted)")
+        words = e2e_pairs / pairs_per_token / dt_pass / max(n_chips, 1)
+        if words > e2e_words:          # keep rate and clock of the SAME
+            e2e_words, e2e_dt = words, dt_pass       # best pass
+
 
     print(json.dumps({
         "pairs_per_sec": round(pairs_per_sec, 1),

@@ -40,7 +40,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from multiverso_tpu import core
 from multiverso_tpu.io import open_stream
-from multiverso_tpu.updaters import AddOption, Updater, get_updater
+from multiverso_tpu.updaters import (AddOption, Updater, get_updater,
+                                     resolve_default_option)
 from multiverso_tpu.utils import configure, log
 
 CHECKPOINT_MAGIC = "multiverso_tpu.table.v1"
@@ -174,7 +175,6 @@ class Table:
         updater_name = updater if updater is not None \
             else configure.get_flag("updater_type")
         self.updater: Updater = get_updater(updater_name)
-        from multiverso_tpu.updaters.updaters import resolve_default_option
         self.default_option = resolve_default_option(updater_name,
                                                      default_option)
         self._option_lock = threading.Lock()

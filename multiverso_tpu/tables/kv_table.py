@@ -43,7 +43,8 @@ from multiverso_tpu import core
 from multiverso_tpu.tables.base import (Handle, Table, _register,
                                         loadz_stream, pack_state,
                                         savez_stream, unpack_state)
-from multiverso_tpu.updaters import AddOption, get_updater
+from multiverso_tpu.updaters import (AddOption, get_updater,
+                                     resolve_default_option)
 from multiverso_tpu.utils import configure, log
 
 EMPTY_KEY = np.uint64(0xFFFFFFFFFFFFFFFF)
@@ -102,7 +103,6 @@ class KVTable:
         updater_name = updater if updater is not None \
             else configure.get_flag("updater_type")
         self.updater = get_updater(updater_name)
-        from multiverso_tpu.updaters.updaters import resolve_default_option
         self.default_option = resolve_default_option(updater_name,
                                                      default_option)
         self._option_lock = threading.Lock()

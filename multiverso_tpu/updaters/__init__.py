@@ -32,7 +32,8 @@ Updater semantics (matching the reference's):
 
 from multiverso_tpu.updaters.updaters import (AddOption, Updater,
                                               get_updater, register_updater,
+                                              resolve_default_option,
                                               updater_names)
 
 __all__ = ["AddOption", "Updater", "get_updater", "register_updater",
-           "updater_names"]
+           "resolve_default_option", "updater_names"]
