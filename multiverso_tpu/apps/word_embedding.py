@@ -18,9 +18,10 @@ TPU design (the whole point — nothing here is a translation):
 - The reference's Trainer-thread Hogwild + per-block aggregation becomes
   the batched scatter-add: duplicate rows within a minibatch accumulate
   additively (`.at[].add`), exactly the reference's Aggregator semantics.
-- Negative sampling runs **on device** via the alias method: the unigram^p
-  distribution is preprocessed into (prob, alias) arrays once; a sample is
-  two uniforms + two gathers — no host RNG in the hot loop
+- Negative sampling runs **on device**: by default a precomputed unigram
+  table (the reference word2vec's own ``InitUnigramTable`` — one uniform
+  + ONE gather per draw), or the exact Vose alias method
+  (``ns_sampler="alias"``); no host RNG in the hot loop
   (`jax.random.fold_in`-per-step keys keep it reproducible across chips).
 - Data parallelism: the pair stream is sharded over the mesh ``"data"``
   axis; the embedding tables keep their row sharding, so XLA inserts the
