@@ -154,6 +154,14 @@ class TestDefaultOptionResolution:
         mine = AddOption.for_ftrl(0.3, l1=0.5)
         assert resolve_default_option("ftrl", mine) is mine
 
+    def test_generic_option_with_ftrl_warns(self, capsys):
+        from multiverso_tpu.updaters.updaters import resolve_default_option
+        generic = AddOption(learning_rate=0.1)   # adam-shaped defaults
+        out = resolve_default_option("ftrl", generic)
+        assert out is generic                    # passed through, loudly
+        err = capsys.readouterr().err            # framework logger writes
+        assert "for_ftrl" in err and "[WARN]" in err  # to stderr
+
 
 class TestJitStability:
     def test_lr_change_no_retrace(self):
