@@ -1081,10 +1081,10 @@ class LightLDA:
             yield k, self._place_stream(stacked)
 
     def _init_streamed_counts(self) -> None:
-        master = jnp.zeros(self.word_topic.storage_shape, jnp.int32)
-        master = jax.device_put(master, self.word_topic.sharding)
-        nk = jnp.zeros(self.summary.padded_shape, jnp.int32)
-        nk = jax.device_put(nk, self.summary.sharding)
+        master = core.sharded_zeros(self.word_topic.storage_shape,
+                                    jnp.int32, self.word_topic.sharding)
+        nk = core.sharded_zeros(self.summary.padded_shape, jnp.int32,
+                                self.summary.sharding)
         for _k, dev in self._stream_calls():
             master, nk = self._init_call(master, nk, dev)
         self.word_topic.put_raw(master)
@@ -1120,9 +1120,8 @@ class LightLDA:
         per_call, TB = self._per_call, self._tb
         # fresh accumulator: after the sweep it IS the new master
         # (counts telescope — see the superstep body)
-        acc = jax.device_put(
-            jnp.zeros(self.word_topic.storage_shape, jnp.int32),
-            self.word_topic.sharding)
+        acc = core.sharded_zeros(self.word_topic.storage_shape, jnp.int32,
+                                 self.word_topic.sharding)
         pending: list = []
 
         def drain(item):

@@ -165,6 +165,20 @@ def place(value, spec: P = P(), *, mesh: Optional[Mesh] = None) -> jax.Array:
     return jax.device_put(value, NamedSharding(m, spec))
 
 
+def sharded_zeros(shape, dtype, sharding) -> jax.Array:
+    """Zeros created DIRECTLY under a sharding — never on the default
+    device and never materialised on host.
+
+    A bare ``jnp.zeros(...)`` allocates on the process default backend
+    before any ``device_put`` can move it (double allocation, and a crash
+    when the default platform is broken — the same hazard ``place``
+    documents); passing the sharding as ``device=`` makes jax allocate
+    each shard on its target device only, with no per-call jit wrapper.
+    """
+    import jax.numpy as jnp
+    return jnp.zeros(shape, dtype, device=sharding)
+
+
 def prng_key(seed: int, *, mesh: Optional[Mesh] = None) -> jax.Array:
     """A PRNG key resident on the mesh, never on the default device.
 
