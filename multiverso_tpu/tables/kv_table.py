@@ -370,7 +370,7 @@ class KVTable:
         # about pre-load state would be spurious)
         self._check_overflow()
         manifest, data = loadz_stream(uri, self.KV_MAGIC)
-        for field in ("num_buckets", "slots", "value_dim", "dtype"):
+        for field in ("value_dim", "dtype"):
             mine = getattr(self, field) if field != "dtype" \
                 else self.dtype.name
             theirs = manifest[field]
@@ -382,12 +382,26 @@ class KVTable:
             raise ValueError(
                 f"checkpoint updater {manifest['updater']!r} != "
                 f"{self.updater.name!r}")
-        host_keys = data["keys"]
+        if manifest["num_buckets"] != self.num_buckets \
+                or manifest["slots"] != self.slots:
+            # mesh-portable restore: num_buckets is padded to the mesh
+            # model-axis size at construction, so a checkpoint written on
+            # mp=2 has a different geometry than an mp=1/4 table.  Dense
+            # tables repad (base.py); here the live triples are rehashed
+            # into the current geometry instead.
+            host_keys, host_vals, host_state = \
+                self._rehash_checkpoint(manifest, data)
+            state_src = {f"state_{i}": leaf
+                         for i, leaf in enumerate(host_state)}
+        else:
+            host_keys = data["keys"]
+            host_vals = data["values"]
+            state_src = data
         self.keys = jax.device_put(host_keys, self._key_sharding)
-        self.values = jax.device_put(data["values"].astype(self.dtype),
+        self.values = jax.device_put(host_vals.astype(self.dtype),
                                      self._val_sharding)
         self.state = unpack_state(
-            data, manifest["n_state_leaves"], self.state,
+            state_src, manifest["n_state_leaves"], self.state,
             lambda leaf, tmpl: jax.device_put(leaf.astype(tmpl.dtype),
                                               self._val_sharding))
         # slot assignment is device-derived: nothing host-side to rebuild
@@ -395,3 +409,50 @@ class KVTable:
         # load replaces live state: outstanding add-handles read superseded
         with self._option_lock:
             self.generation += 1
+
+    def _rehash_checkpoint(self, manifest, data):
+        """Re-insert a checkpoint's live (key, value, state) triples into
+        THIS table's (num_buckets, slots) geometry.
+
+        Host-side: a checkpoint restore is not a hot path, and the insert
+        needs data-dependent bucket occupancy that a fixed-shape device
+        program handles worse than numpy.  Lane order within a bucket is
+        the checkpoint's bucket-major traversal order — deterministic,
+        and lookup/probe semantics don't depend on lane order."""
+        ck_keys = data["keys"]                        # [B0, S0, 2] u32
+        live = ~(ck_keys == np.uint32(0xFFFFFFFF)).all(-1)
+        bb, ss = np.nonzero(live)
+        k2 = ck_keys[bb, ss]                          # [n, 2]
+        u64 = (k2[:, 0].astype(np.uint64) << np.uint64(32)) \
+            | k2[:, 1].astype(np.uint64)
+        buckets = self._buckets_of(u64)
+        order = np.argsort(buckets, kind="stable")
+        sb = buckets[order]
+        n = len(sb)
+        # lane = rank within each bucket run of the sorted order
+        pos = np.arange(n)
+        run_start = np.concatenate([[True], sb[1:] != sb[:-1]]) \
+            if n else np.zeros(0, bool)
+        lane = pos - np.maximum.accumulate(np.where(run_start, pos, 0))
+        if n and lane.max() >= self.slots:
+            crowded = sb[lane >= self.slots][0]
+            raise ValueError(
+                f"kv table {self.name!r}: rehash from "
+                f"{manifest['num_buckets']}x{manifest['slots']} to "
+                f"{self.num_buckets}x{self.slots} overflows bucket "
+                f"{int(crowded)} (> {self.slots} keys); use a table with "
+                f"more slots_per_bucket or larger capacity")
+        kv_shape = (self.num_buckets, self.slots)
+        new_keys = np.full(kv_shape + (2,), 0xFFFFFFFF, np.uint32)
+        new_keys[sb, lane] = k2[order]
+
+        def remap(arr, fill):
+            out_shape = kv_shape + arr.shape[2:]
+            out = np.full(out_shape, fill, arr.dtype)
+            out[sb, lane] = arr[bb, ss][order]
+            return out
+
+        new_vals = remap(data["values"], self.default_value)
+        new_state = [remap(data[f"state_{i}"], 0)
+                     for i in range(manifest["n_state_leaves"])]
+        return new_keys, new_vals, new_state

@@ -13,12 +13,16 @@ import os
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                            " --xla_force_host_platform_device_count=8").strip()
 # Note: this image's sitecustomize force-registers the axon TPU platform and
-# pins jax_platforms="axon,cpu"; we therefore select CPU devices explicitly
-# (jax.devices("cpu")) rather than via JAX_PLATFORMS.
+# pins jax_platforms="axon,cpu" (the JAX_PLATFORMS env var is ignored). The
+# suite runs on the virtual CPU mesh ONLY — and a mere jax.devices("cpu")
+# initialises EVERY registered platform, so a degraded/hung TPU tunnel
+# would hang the whole suite at collection (observed 2026-07-30). Restrict
+# the platform list in-code before any backend initialises.
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_device", None)
 
 

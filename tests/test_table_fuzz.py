@@ -112,6 +112,8 @@ def test_random_op_sequences_match_numpy(mesh8, tmp_path, tiled, updater):
 class KVMirror:
     """KVTable contract in numpy: dict of key -> (value, state)."""
 
+    FTRL_L1, FTRL_L2, FTRL_BETA = 0.1, 0.01, 1.0
+
     def __init__(self, dim, updater, lr):
         self.d = {}
         self.dim = dim
@@ -120,14 +122,30 @@ class KVMirror:
 
     def add(self, keys, deltas):
         for k, dv in zip(keys, deltas):
-            old, h = self.d.get(int(k),
-                                (np.zeros(self.dim, np.float64),
-                                 np.zeros(self.dim, np.float64)))
+            zeros = np.zeros(self.dim, np.float64)
+            init = (zeros, (zeros, zeros)) if self.updater == "ftrl" \
+                else (zeros, zeros)
+            old, h = self.d.get(int(k), init)
+            if self.updater == "ftrl":
+                z, n = h
             dv = dv.astype(np.float64)
             if self.updater == "default":
                 new = old + dv
             elif self.updater == "sgd":
                 new = old - self.lr * dv
+            elif self.updater == "ftrl":
+                # FTRL-Proximal, the exact updaters.py math: the apply
+                # REPLACES the value with the closed-form proximal w
+                alpha, beta = self.lr, self.FTRL_BETA
+                l1, l2 = self.FTRL_L1, self.FTRL_L2
+                n_new = n + dv * dv
+                sigma = (np.sqrt(n_new) - np.sqrt(n)) / alpha
+                z_new = z + dv - sigma * old
+                shrunk = np.sign(z_new) * np.maximum(np.abs(z_new) - l1, 0)
+                new = np.where(
+                    np.abs(z_new) <= l1, 0.0,
+                    -shrunk / ((beta + np.sqrt(n_new)) / alpha + l2))
+                h = (z_new, n_new)
             else:                        # adagrad, eps = AddOption.lam
                 h = h + dv * dv
                 new = old - self.lr * dv / (np.sqrt(h) + 1e-8)
@@ -140,19 +158,23 @@ class KVMirror:
         return vals, found
 
 
-@pytest.mark.parametrize("updater", ["default", "sgd", "adagrad"])
+@pytest.mark.parametrize("updater", ["default", "sgd", "adagrad", "ftrl"])
 def test_kv_random_op_sequences_match_dict(mesh8, tmp_path, updater):
     """The device-side slot probe (no host mirror) against a dict: random
     interleavings of add (new + existing keys), get (hit + miss), len,
-    and checkpoint round-trips."""
+    and checkpoint round-trips. ``ftrl`` exercises the per-key (z, n)
+    state pytree through _probe_update (ADVICE r3)."""
     from multiverso_tpu.tables import KVTable
     dim, lr = 3, 0.25
     keyspace = np.array([3, 9, 17, 1 << 40, (1 << 63) + 5, 1234567,
                          42, 7, 2**32 - 1, 2**32], np.uint64)
     rng = np.random.default_rng(
-        99 + ["default", "sgd", "adagrad"].index(updater))
+        99 + ["default", "sgd", "adagrad", "ftrl"].index(updater))
+    opt = AddOption.for_ftrl(lr, KVMirror.FTRL_L1, KVMirror.FTRL_L2,
+                             KVMirror.FTRL_BETA) if updater == "ftrl" \
+        else AddOption(learning_rate=lr, lam=1e-8)
     t = KVTable(256, value_dim=dim, updater=updater, name=f"kvf_{updater}",
-                default_option=AddOption(learning_rate=lr, lam=1e-8))
+                default_option=opt)
     mirror = KVMirror(dim, updater, lr)
 
     for step in range(30):
