@@ -1095,24 +1095,32 @@ class LightLDA:
 
         Training never needs this: each process stages and drains exactly
         the lanes its devices own. Full-z consumers (doc_topics, store)
-        call it lazily — the owned lanes are exchanged with ONE
-        ``process_allgather`` of equal-sized [n_own, TB] slabs (uniform
-        sharding ⇒ every process owns the same lane count; model-axis
-        replicas write identical data, which is idempotent)."""
+        call it lazily — the owned lanes are exchanged with one
+        ``process_allgather`` of equal-sized [cap, TB] slabs PER SWEEP
+        CALL (uniform sharding ⇒ every process owns the same lane count;
+        model-axis replicas write identical data, which is idempotent).
+        Chunking by call keeps the peak device/host transfer bounded for
+        out-of-core-scale corpora — a single whole-sweep allgather would
+        materialise the global z through device memory on every host at
+        once (ADVICE r3), exactly what stream_blocks exists to avoid."""
         if jax.process_count() == 1 or self._z_synced \
                 or self.config.local_corpus:
             # local_corpus: z is per-process BY DESIGN (each process owns
             # its shard's lanes); there is no global host z to complete
             return
         offs = self._owned_call_offsets()
-        blocks = (np.arange(self.calls_per_sweep)[:, None] * self._per_call
-                  + offs[None, :]).reshape(-1)
         from jax.experimental import multihost_utils
-        all_blocks = np.asarray(multihost_utils.process_allgather(blocks))
-        all_vals = np.asarray(multihost_utils.process_allgather(
-            self._z_host[blocks]))
-        for p in range(all_blocks.shape[0]):
-            self._z_host[all_blocks[p]] = all_vals[p]
+        # ownership offsets are call-invariant: gather them ONCE and
+        # derive each call's global block ids locally (one collective
+        # per chunk instead of two)
+        all_offs = np.asarray(multihost_utils.process_allgather(offs))
+        for k in range(self.calls_per_sweep):
+            blocks = k * self._per_call + offs
+            all_vals = np.asarray(multihost_utils.process_allgather(
+                self._z_host[blocks]))
+            for p in range(all_offs.shape[0]):
+                self._z_host[k * self._per_call + all_offs[p]] = \
+                    all_vals[p]
         self._z_synced = True
 
     def _sweep_streamed(self) -> None:
@@ -1713,9 +1721,38 @@ class LightLDA:
             # required to resume
             manifest["layout"] = "docblock_local"
             manifest["processes"] = jax.process_count()
+            # per-rank shard identity (ADVICE r3): the process-count and
+            # num_tokens checks alone would accept a DIFFERENT doc-to-
+            # process split (or device order) of equal sizes, silently
+            # binding the loaded z to the wrong documents/blocks
+            crc, ntok = self._local_shard_digest()
+            manifest["shard_crc32"] = crc
+            manifest["local_tokens"] = ntok
             state_path = (f"{uri_prefix}.state"
                           f".rank{jax.process_index()}.npz")
-        savez_stream(state_path, manifest, {"z": z, "ndk": dense})
+            savez_stream(state_path, manifest, {"z": z, "ndk": dense})
+        elif jax.process_index() == 0:
+            # shared-path write: ranks write THE SAME state.npz (and z is
+            # globally complete after the sync above), so concurrent
+            # 'wb' on a shared filesystem would corrupt — rank 0 only,
+            # mirroring dump_model's guard
+            savez_stream(state_path, manifest, {"z": z, "ndk": dense})
+        if jax.process_count() > 1:
+            core.barrier()   # writes visible before any rank loads
+
+    def _local_shard_digest(self):
+        """(crc32, local token count) identifying THIS rank's corpus
+        shard AND its packed layout: token words, doc-relative rows, and
+        the device-order-derived owned lane offsets all feed the crc, so
+        resuming with a different split/ordering of equal sizes is
+        rejected instead of corrupting counts."""
+        import zlib
+        crc = zlib.crc32(self._tw_host.tobytes())
+        crc = zlib.crc32(self._drel_host.tobytes(), crc)
+        crc = zlib.crc32(np.ascontiguousarray(
+            np.asarray(self._own_offs, np.int64)).tobytes(), crc)
+        ntok = int((self._tw_host != self._scratch_word).sum())
+        return int(crc), ntok
 
     def load(self, uri_prefix: str) -> None:
         from multiverso_tpu.tables.base import loadz_stream
@@ -1733,6 +1770,18 @@ class LightLDA:
                 f"local_corpus checkpoint was written by "
                 f"{manifest.get('processes')} processes, app has "
                 f"{jax.process_count()}: z shards are per-process")
+        if self.config.local_corpus and "shard_crc32" in manifest:
+            crc, ntok = self._local_shard_digest()
+            if (manifest["shard_crc32"], manifest["local_tokens"]) \
+                    != (crc, ntok):
+                raise ValueError(
+                    f"local_corpus checkpoint rank shard mismatch "
+                    f"(crc32 {manifest['shard_crc32']:#x}/"
+                    f"{manifest['local_tokens']} tokens != this app's "
+                    f"{crc:#x}/{ntok}): the doc-to-process split and "
+                    "device order must match the checkpointing run — "
+                    "loading z against a different shard silently "
+                    "corrupts counts")
         if manifest["num_tokens"] != self.num_tokens:
             raise ValueError(
                 f"checkpoint has {manifest['num_tokens']} tokens, app has "

@@ -367,11 +367,20 @@ class Table:
             host_padded.reshape(self.storage_shape), self.sharding)
 
     def store(self, uri: str) -> None:
-        """Serialize param + updater state through the stream layer."""
+        """Serialize param + updater state through the stream layer.
+
+        Multi-process: COLLECTIVE (the export fetch is a device
+        collective, so every rank must call), but only rank 0 writes —
+        concurrent 'wb' on the same shared-filesystem path corrupts; a
+        barrier makes the write visible before any rank loads."""
         payload = {"param": self._export_param()}
         manifest = self._manifest()
         manifest["n_state_leaves"] = pack_state(self.state, payload)
-        savez_stream(uri, manifest, payload)
+        if jax.process_index() == 0:
+            savez_stream(uri, manifest, payload)
+        if jax.process_count() > 1:
+            from multiverso_tpu import core
+            core.barrier()
 
     def load(self, uri: str) -> None:
         manifest, data = loadz_stream(uri, CHECKPOINT_MAGIC)

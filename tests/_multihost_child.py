@@ -1,9 +1,17 @@
-"""Child process for the 2-process multi-host test (SURVEY.md §5: the
-mpirun-np-N analog extended to REAL multi-process — two local processes
+"""Child process for the P-process multi-host test (SURVEY.md §5: the
+mpirun-np-N analog extended to REAL multi-process — P local processes
 with a CPU coordinator exercising init/barrier/table ops/logreg).
 
-Run by tests/test_multihost.py:  python _multihost_child.py <port> <pid>
-(env: JAX_PLATFORMS=cpu, XLA_FLAGS=--xla_force_host_platform_device_count=2)
+Run by tests/test_multihost.py:
+    python _multihost_child.py <port> <pid> [<nprocs>=2]
+(env: JAX_PLATFORMS=cpu, XLA_FLAGS=--xla_force_host_platform_device_count=2
+ — 2 devices per process, so the global mesh has 2*P devices)
+
+All the P-generic arithmetic (owned_axis_slices, allgather_i64, z-sync
+slab exchange, local_data/local_corpus chunk ownership) runs here at
+WHATEVER P the parent passes: several off-by-one/ordering bug classes
+are invisible at P=2 (VERDICT r3 weak #5), so the parent runs P=2 and
+P=4 with the same child.
 """
 
 import sys
@@ -13,26 +21,28 @@ import numpy as np
 
 def main() -> None:
     port, pid = int(sys.argv[1]), int(sys.argv[2])
+    P = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+    n_dev = 2 * P                       # 2 virtual CPU devices per process
 
     import jax
     # the image's sitecustomize pins jax_platforms="axon,cpu" (overriding
-    # the JAX_PLATFORMS env var); force pure-CPU so two processes don't
+    # the JAX_PLATFORMS env var); force pure-CPU so the processes don't
     # fight over the single tunneled TPU chip
     jax.config.update("jax_platforms", "cpu")
     from multiverso_tpu import core
     from multiverso_tpu.tables import ArrayTable, KVTable, reset_tables
 
     mesh = core.init([f"-machine_file=127.0.0.1:{port}",
-                      "-num_processes=2", f"-process_id={pid}",
-                      "-data_parallel=2", "-model_parallel=2"])
-    assert jax.process_count() == 2, jax.process_count()
-    assert len(jax.devices()) == 4, jax.devices()
-    assert core.size() == 2 and core.rank() == pid
-    assert core.num_workers() == 4 and core.num_servers() == 4
+                      f"-num_processes={P}", f"-process_id={pid}",
+                      f"-data_parallel={P}", "-model_parallel=2"])
+    assert jax.process_count() == P, jax.process_count()
+    assert len(jax.devices()) == n_dev, jax.devices()
+    assert core.size() == P and core.rank() == pid
+    assert core.num_workers() == n_dev and core.num_servers() == n_dev
 
     core.barrier()
 
-    # ArrayTable sharded over BOTH hosts' devices: add + replicated get
+    # ArrayTable sharded over ALL hosts' devices: add + replicated get
     t = ArrayTable(10, "float32", updater="sgd")
     from multiverso_tpu.updaters import AddOption
     t.add(np.arange(10, dtype=np.float32),
@@ -52,7 +62,7 @@ def main() -> None:
     np.testing.assert_allclose(t.get(), 1.0 - 0.5 * np.arange(10),
                                rtol=1e-6)
 
-    # logreg: one real data-parallel epoch across the two processes
+    # logreg: one real data-parallel epoch across the P processes
     from multiverso_tpu.apps.logreg import (LogisticRegression,
                                             LogRegConfig, synthetic_blobs)
     X, y = synthetic_blobs(64, 8, 3, seed=0)
@@ -62,7 +72,7 @@ def main() -> None:
     loss = app.train(X, y)
     assert np.isfinite(loss), loss
 
-    # KVTable across both processes: slot assignment is a device-side
+    # KVTable across all processes: slot assignment is a device-side
     # probe (pure function of table state + batch), so collective adds
     # keep every process in lockstep with no host mirror
     kv = KVTable(128, value_dim=2)
@@ -79,7 +89,7 @@ def main() -> None:
     assert not missing.any()
     assert len(kv) == 4
 
-    # sparse logreg (KVTable consumer) trains across the 2-process mesh
+    # sparse logreg (KVTable consumer) trains across the P-process mesh
     from multiverso_tpu.apps.sparse_logreg import (SparseLogisticRegression,
                                                    SparseLRConfig,
                                                    synthetic_sparse)
@@ -92,8 +102,8 @@ def main() -> None:
     acc = slr.accuracy(rows, y)
     assert acc > 0.75, acc
 
-    # word2vec across both processes: pair stream device_put sharded
-    # over the data axis spanning hosts, embeddings on the 2x2 mesh
+    # word2vec across all processes: pair stream device_put sharded
+    # over the data axis spanning hosts, embeddings on the P x 2 mesh
     from multiverso_tpu.apps.word_embedding import W2VConfig, WordEmbedding
     from multiverso_tpu.data.corpus import Corpus
     from multiverso_tpu.data.native import CorpusData
@@ -112,7 +122,7 @@ def main() -> None:
     assert np.all(np.isfinite(w2v.loss_history))
 
     # local_data: shared dictionary, PER-RANK token stream — each
-    # process generates only its devices' half of every batch from its
+    # process generates only its devices' share of every batch from its
     # own shard (the reference's workers-each-stream-their-own-corpus)
     rng_r = np.random.default_rng(100 + pid)
     ids_r = rng_r.integers(0, 50, 3000).astype(np.int32)
@@ -127,25 +137,25 @@ def main() -> None:
                                     subsample=0, seed=0,
                                     local_data=True),
                           name="mh_w2v_local")
-    assert w2v_l._local_batch == 32     # half the global batch per rank
+    assert w2v_l._local_batch == 64 // P   # 1/P of the global batch
     w2v_l.train(total_steps=4)
     assert np.all(np.isfinite(w2v_l.loss_history))
 
-    # the flagship doc-blocked LDA sampler across BOTH processes: a
+    # the flagship doc-blocked LDA sampler across ALL processes: a
     # shard_map'd pallas kernel (interpret mode on CPU) with per-chip
-    # block ownership and psum'd summary deltas over the 2-host mesh
+    # block ownership and psum'd summary deltas over the P-host mesh
     from jax.sharding import Mesh
     from multiverso_tpu.apps.lightlda import LDAConfig, LightLDA
     core.shutdown()
-    core.set_mesh(Mesh(np.array(jax.devices()).reshape(4, 1),
+    core.set_mesh(Mesh(np.array(jax.devices()).reshape(n_dev, 1),
                        ("data", "model")))
     rng = np.random.default_rng(0)
     tb = 64
-    n_tok = tb * 4 * 2
+    n_tok = tb * n_dev * 2
     td_l = np.sort(rng.integers(0, 32, n_tok)).astype(np.int32)
     tw_l = rng.integers(0, 16, n_tok).astype(np.int32)
     lda = LightLDA(tw_l, td_l, 16,
-                   LDAConfig(num_topics=128, batch_tokens=tb * 4,
+                   LDAConfig(num_topics=128, batch_tokens=tb * n_dev,
                              steps_per_call=2, seed=0, sampler="tiled",
                              doc_blocked=True, block_tokens=tb,
                              block_docs=16),
@@ -157,13 +167,13 @@ def main() -> None:
     assert nwk.sum() == lda.num_tokens, (nwk.sum(), lda.num_tokens)
     z_ref = np.asarray(lda._z)
 
-    # OUT-OF-CORE streamed mode across both processes: process-local
+    # OUT-OF-CORE streamed mode across all processes: process-local
     # staging (each host device_puts only its addressable lanes) and
     # shard-local z readback must reproduce the in-memory run
     # bit-identically — same kernels, same RNG, counts are a pure
     # function of z at call boundaries
     lda_s = LightLDA(tw_l, td_l, 16,
-                     LDAConfig(num_topics=128, batch_tokens=tb * 4,
+                     LDAConfig(num_topics=128, batch_tokens=tb * n_dev,
                                steps_per_call=2, seed=0, sampler="tiled",
                                doc_blocked=True, block_tokens=tb,
                                block_docs=16, stream_blocks=True),
@@ -176,16 +186,28 @@ def main() -> None:
     assert np.isfinite(lda_s.loglik())
     ref_dt = lda.doc_topics()
 
-    # and on a dp x mp mesh (2 x 2): model-axis replica dedup in the z
+    # multi-process streamed store/load: store is collective (z sync +
+    # chunked allgather) but only rank 0 writes the shared state path;
+    # the barrier inside store makes it safe for every rank to load
+    # immediately — the round-trip must preserve z exactly
+    import os
+    import tempfile
+    ck_s = os.path.join(tempfile.gettempdir(), f"mh_ck_{port}_s")
+    lda_s.store(ck_s)
+    z_before = lda_s._z_host.copy()
+    lda_s.load(ck_s)
+    np.testing.assert_array_equal(lda_s._z_host, z_before)
+
+    # and on a dp x mp mesh (P x 2): model-axis replica dedup in the z
     # drain, per-replica staging, and the sync's uniform-ownership
     # allgather all run with REAL replicas; still bit-identical
     from multiverso_tpu.tables import base as table_base
     table_base.reset_tables()
     core.shutdown()
-    core.set_mesh(Mesh(np.array(jax.devices()).reshape(2, 2),
+    core.set_mesh(Mesh(np.array(jax.devices()).reshape(P, 2),
                        ("data", "model")))
     lda_m = LightLDA(tw_l, td_l, 16,
-                     LDAConfig(num_topics=128, batch_tokens=tb * 4,
+                     LDAConfig(num_topics=128, batch_tokens=tb * n_dev,
                                steps_per_call=2, seed=0, sampler="tiled",
                                doc_blocked=True, block_tokens=tb,
                                block_docs=16, stream_blocks=True),
@@ -195,16 +217,16 @@ def main() -> None:
     np.testing.assert_array_equal(lda_m.doc_topics(), ref_dt)
 
     # PER-PROCESS corpus shards (local_corpus): each rank passes ONLY
-    # its own docs (disjoint by parity, global doc ids); device-side
-    # counts must equal the host recount allgathered across ranks, and
-    # the run must be deterministic
+    # its own docs (disjoint by doc-id mod P, global doc ids);
+    # device-side counts must equal the host recount allgathered across
+    # ranks, and the run must be deterministic
     from jax.experimental import multihost_utils
     reset_tables()
-    core.set_mesh(Mesh(np.array(jax.devices()).reshape(4, 1),
+    core.set_mesh(Mesh(np.array(jax.devices()).reshape(n_dev, 1),
                        ("data", "model")))
-    mine = (td_l % 2) == pid
+    mine = (td_l % P) == pid
     lda_lc = LightLDA(tw_l[mine], td_l[mine], 16,
-                      LDAConfig(num_topics=128, batch_tokens=tb * 4,
+                      LDAConfig(num_topics=128, batch_tokens=tb * n_dev,
                                 steps_per_call=2, seed=0,
                                 sampler="tiled", doc_blocked=True,
                                 block_tokens=tb, block_docs=16,
@@ -222,6 +244,32 @@ def main() -> None:
         local_count)).sum(axis=0)
     np.testing.assert_array_equal(total, nwk_lc.astype(np.int64))
     assert np.isfinite(lda_lc.loglik())
+
+    # local_corpus store/load: per-rank shard files; the manifest's
+    # shard digest must accept the SAME shard and reject a DIFFERENT
+    # doc-to-process split of equal process count and global tokens
+    ck_lc = os.path.join(tempfile.gettempdir(), f"mh_ck_{port}_lc")
+    lda_lc.store(ck_lc)
+    z_lc = lda_lc._z_host.copy()
+    lda_lc.load(ck_lc)
+    np.testing.assert_array_equal(lda_lc._z_host, z_lc)
+    reset_tables()
+    theirs = (td_l % P) == ((pid + 1) % P)      # the complement split
+    lda_wrong = LightLDA(tw_l[theirs], td_l[theirs], 16,
+                         LDAConfig(num_topics=128,
+                                   batch_tokens=tb * n_dev,
+                                   steps_per_call=2, seed=0,
+                                   sampler="tiled", doc_blocked=True,
+                                   block_tokens=tb, block_docs=16,
+                                   stream_blocks=True, local_corpus=True),
+                         name="mh_lda_lc_w")
+    assert lda_wrong.num_tokens == len(tw_l)    # global totals agree...
+    try:
+        lda_wrong.load(ck_lc)                   # ...but the shard differs
+    except ValueError as e:
+        assert "shard mismatch" in str(e), e
+    else:
+        raise AssertionError("wrong-shard load was not rejected")
 
     core.barrier()
     reset_tables()
