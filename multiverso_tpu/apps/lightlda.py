@@ -1730,15 +1730,11 @@ class LightLDA:
             manifest["local_tokens"] = ntok
             state_path = (f"{uri_prefix}.state"
                           f".rank{jax.process_index()}.npz")
-            savez_stream(state_path, manifest, {"z": z, "ndk": dense})
-        elif jax.process_index() == 0:
-            # shared-path write: ranks write THE SAME state.npz (and z is
-            # globally complete after the sync above), so concurrent
-            # 'wb' on a shared filesystem would corrupt — rank 0 only,
-            # mirroring dump_model's guard
-            savez_stream(state_path, manifest, {"z": z, "ndk": dense})
-        if jax.process_count() > 1:
-            core.barrier()   # writes visible before any rank loads
+        # every rank writes (z is globally complete after the sync above,
+        # so the shared-path payloads are identical; per-process targets
+        # like mem:// need their own copy); shared-path safety comes from
+        # the stream layer's atomic rename
+        savez_stream(state_path, manifest, {"z": z, "ndk": dense})
 
     def _local_shard_digest(self):
         """(crc32, local token count) identifying THIS rank's corpus

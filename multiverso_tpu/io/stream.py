@@ -39,12 +39,57 @@ def _split_uri(uri: str) -> Tuple[str, str]:
     return "file", uri
 
 
+class _AtomicWriteFile:
+    """Write mode lands in a pid-unique temp file, atomically renamed
+    into place on close.  Multi-process collective stores write the SAME
+    checkpoint path from every rank (required: mem:// and per-host local
+    disks are per-process, so a rank-0-only write would strand the other
+    ranks); on a shared filesystem the renames race, but each is atomic
+    and the payloads are identical, so readers always see a complete
+    file — never the interleaved bytes concurrent 'wb' would produce.
+    A crash mid-write leaks only the .tmp file, not a torn checkpoint.
+    """
+
+    def __init__(self, path: str, mode: str) -> None:
+        self._final = path
+        self._tmp = f"{path}.tmp.{os.getpid()}"
+        self._f = open(self._tmp, mode)
+
+    def write(self, b):
+        return self._f.write(b)
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+            os.replace(self._tmp, self._final)
+
+    @property
+    def closed(self):
+        return self._f.closed
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if exc[0] is not None:          # failed write: drop the temp,
+            self._f.close()             # never replace the target
+            try:
+                os.remove(self._tmp)
+            except OSError:
+                pass
+            return False
+        self.close()
+        return False
+
+
 def _open_local(path: str, mode: str) -> Stream:
     if "w" in mode or "a" in mode:
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
     if "b" not in mode:
         mode += "b"
+    if "w" in mode:
+        return _AtomicWriteFile(path, mode)   # type: ignore[return-value]
     return open(path, mode)
 
 

@@ -369,18 +369,15 @@ class Table:
     def store(self, uri: str) -> None:
         """Serialize param + updater state through the stream layer.
 
-        Multi-process: COLLECTIVE (the export fetch is a device
-        collective, so every rank must call), but only rank 0 writes —
-        concurrent 'wb' on the same shared-filesystem path corrupts; a
-        barrier makes the write visible before any rank loads."""
+        Multi-process: COLLECTIVE — every rank runs the export fetch (a
+        device collective) and every rank writes, so per-process targets
+        (mem://, per-host local disks) each get a copy; on a shared
+        filesystem the identical payloads land via the stream layer's
+        atomic rename, so same-path writers never interleave."""
         payload = {"param": self._export_param()}
         manifest = self._manifest()
         manifest["n_state_leaves"] = pack_state(self.state, payload)
-        if jax.process_index() == 0:
-            savez_stream(uri, manifest, payload)
-        if jax.process_count() > 1:
-            from multiverso_tpu import core
-            core.barrier()
+        savez_stream(uri, manifest, payload)
 
     def load(self, uri: str) -> None:
         manifest, data = loadz_stream(uri, CHECKPOINT_MAGIC)

@@ -362,13 +362,10 @@ class KVTable:
                     "dtype": self.dtype.name, "updater": self.updater.name,
                     "n_state_leaves": pack_state(self.state, payload),
                     "step": self.default_option.step}
-        # rank-0 write + barrier: same shared-path rationale as
-        # tables/base.py store
-        if jax.process_index() == 0:
-            savez_stream(uri, manifest, payload)
-        if jax.process_count() > 1:
-            from multiverso_tpu import core
-            core.barrier()
+        # every rank writes (per-process targets need their own copy);
+        # shared-path safety comes from the stream layer's atomic rename
+        # — same rationale as tables/base.py store
+        savez_stream(uri, manifest, payload)
 
     def load(self, uri: str) -> None:
         # load is a table op: a pending overflow surfaces HERE, before

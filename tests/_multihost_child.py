@@ -42,6 +42,12 @@ def main() -> None:
 
     core.barrier()
 
+    # P-agnostic app phases (logreg, sparse LR, dense w2v) run at P=2
+    # only: P=4 exists to exercise the P-GENERIC arithmetic (owned lane
+    # offsets, z-sync slabs, local_data/local_corpus ownership), and the
+    # single-core CI host pays ~P x compile for every extra phase
+    full = P <= 2
+
     # ArrayTable sharded over ALL hosts' devices: add + replicated get
     t = ArrayTable(10, "float32", updater="sgd")
     from multiverso_tpu.updaters import AddOption
@@ -62,15 +68,17 @@ def main() -> None:
     np.testing.assert_allclose(t.get(), 1.0 - 0.5 * np.arange(10),
                                rtol=1e-6)
 
-    # logreg: one real data-parallel epoch across the P processes
-    from multiverso_tpu.apps.logreg import (LogisticRegression,
-                                            LogRegConfig, synthetic_blobs)
-    X, y = synthetic_blobs(64, 8, 3, seed=0)
-    app = LogisticRegression(LogRegConfig(
-        input_dim=8, num_classes=3, minibatch_size=32, epochs=2,
-        learning_rate=0.1))
-    loss = app.train(X, y)
-    assert np.isfinite(loss), loss
+    if full:
+        # logreg: one real data-parallel epoch across the P processes
+        from multiverso_tpu.apps.logreg import (LogisticRegression,
+                                                LogRegConfig,
+                                                synthetic_blobs)
+        X, y = synthetic_blobs(64, 8, 3, seed=0)
+        app = LogisticRegression(LogRegConfig(
+            input_dim=8, num_classes=3, minibatch_size=32, epochs=2,
+            learning_rate=0.1))
+        loss = app.train(X, y)
+        assert np.isfinite(loss), loss
 
     # KVTable across all processes: slot assignment is a device-side
     # probe (pure function of table state + batch), so collective adds
@@ -89,37 +97,40 @@ def main() -> None:
     assert not missing.any()
     assert len(kv) == 4
 
-    # sparse logreg (KVTable consumer) trains across the P-process mesh
-    from multiverso_tpu.apps.sparse_logreg import (SparseLogisticRegression,
-                                                   SparseLRConfig,
-                                                   synthetic_sparse)
-    rows, y = synthetic_sparse(n=200, dim=30_000, num_classes=2, nnz=8,
-                               seed=0)
-    slr = SparseLogisticRegression(SparseLRConfig(
-        num_classes=2, max_features=10, capacity=1 << 13,
-        minibatch_size=50, learning_rate=0.5, epochs=3))
-    slr.train(rows, y)
-    acc = slr.accuracy(rows, y)
-    assert acc > 0.75, acc
+    if full:
+        # sparse logreg (KVTable consumer) trains across the P-process
+        # mesh
+        from multiverso_tpu.apps.sparse_logreg import (
+            SparseLogisticRegression, SparseLRConfig, synthetic_sparse)
+        rows, y = synthetic_sparse(n=200, dim=30_000, num_classes=2,
+                                   nnz=8, seed=0)
+        slr = SparseLogisticRegression(SparseLRConfig(
+            num_classes=2, max_features=10, capacity=1 << 13,
+            minibatch_size=50, learning_rate=0.5, epochs=3))
+        slr.train(rows, y)
+        acc = slr.accuracy(rows, y)
+        assert acc > 0.75, acc
 
-    # word2vec across all processes: pair stream device_put sharded
-    # over the data axis spanning hosts, embeddings on the P x 2 mesh
     from multiverso_tpu.apps.word_embedding import W2VConfig, WordEmbedding
     from multiverso_tpu.data.corpus import Corpus
     from multiverso_tpu.data.native import CorpusData
     rng = np.random.default_rng(1)
     ids = rng.integers(0, 50, 4000).astype(np.int32)
     counts = np.maximum(np.bincount(ids, minlength=50), 1).astype(np.int64)
-    corpus = Corpus(CorpusData(words=[f"w{i}" for i in range(50)],
-                               counts=counts, ids=ids,
-                               total_raw_tokens=len(ids)), subsample=0)
-    w2v = WordEmbedding(corpus,
-                        W2VConfig(embedding_dim=16, window=2, negative=3,
-                                  batch_size=64, steps_per_call=2,
-                                  epochs=1, subsample=0, seed=0),
-                        name="mh_w2v")
-    w2v.train(total_steps=4)
-    assert np.all(np.isfinite(w2v.loss_history))
+    if full:
+        # word2vec across all processes: pair stream device_put sharded
+        # over the data axis spanning hosts, embeddings on the P x 2 mesh
+        corpus = Corpus(CorpusData(words=[f"w{i}" for i in range(50)],
+                                   counts=counts, ids=ids,
+                                   total_raw_tokens=len(ids)), subsample=0)
+        w2v = WordEmbedding(corpus,
+                            W2VConfig(embedding_dim=16, window=2,
+                                      negative=3, batch_size=64,
+                                      steps_per_call=2, epochs=1,
+                                      subsample=0, seed=0),
+                            name="mh_w2v")
+        w2v.train(total_steps=4)
+        assert np.all(np.isfinite(w2v.loss_history))
 
     # local_data: shared dictionary, PER-RANK token stream — each
     # process generates only its devices' share of every batch from its

@@ -90,3 +90,37 @@ class TestCheckpointThroughMem:
         t2.load("mem://ckpt/arr.npz")
         np.testing.assert_allclose(t2.get(), want)
         reset_tables()
+
+
+class TestAtomicLocalWrite:
+    """file:// write mode is temp+rename (multi-process collective
+    stores write the same path from every rank; readers must never see
+    interleaved or truncated bytes)."""
+
+    def test_write_lands_complete_no_temp_residue(self, tmp_path):
+        import glob
+        from multiverso_tpu.io import open_stream
+        target = str(tmp_path / "a.bin")
+        with open_stream(target, "wb") as s:
+            s.write(b"hello ")
+            s.write(b"world")
+        with open(target, "rb") as f:
+            assert f.read() == b"hello world"
+        assert not glob.glob(target + ".tmp.*")
+
+    def test_failed_write_leaves_no_torn_target(self, tmp_path):
+        import glob
+        import os
+        from multiverso_tpu.io import open_stream
+        target = str(tmp_path / "b.bin")
+        with open_stream(target, "wb") as s:     # a prior good version
+            s.write(b"v1")
+        try:
+            with open_stream(target, "wb") as s:
+                s.write(b"partial v2")
+                raise RuntimeError("simulated crash")
+        except RuntimeError:
+            pass
+        with open(target, "rb") as f:            # good version survives
+            assert f.read() == b"v1"
+        assert not glob.glob(target + ".tmp.*")

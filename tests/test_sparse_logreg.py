@@ -32,7 +32,7 @@ def test_converges_on_100k_dims(mesh8):
                                nnz=15, seed=0)
     app = SparseLogisticRegression(SparseLRConfig(
         num_classes=3, max_features=16, capacity=1 << 17,
-        minibatch_size=500, learning_rate=0.5, epochs=6, use_bias=False))
+        minibatch_size=1000, learning_rate=0.5, epochs=4, use_bias=False))
     app.train(rows, y)
     acc = app.accuracy(rows, y)
     assert acc > 0.8, f"train accuracy {acc:.3f}"
@@ -81,7 +81,7 @@ def test_regularization_shrinks_weights(mesh8):
     for lam, nm in ((0.0, "noreg"), (0.5, "reg")):
         app = SparseLogisticRegression(SparseLRConfig(
             num_classes=2, max_features=10, capacity=1 << 13,
-            minibatch_size=100, epochs=3, regular_lambda=lam), name=nm)
+            minibatch_size=200, epochs=2, regular_lambda=lam), name=nm)
         app.train(rows, y)
         keys = np.unique(
             np.concatenate([[i + 1 for i, _ in r] for r in rows])
