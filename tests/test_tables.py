@@ -593,8 +593,8 @@ class TestCheckpoint:
             got, found = t2.get(keys)
             assert found.all()
             np.testing.assert_allclose(got, src_vals, rtol=1e-6)
-            _, missing = t2.get(rng.choice(2 ** 40, 8).astype(np.uint64))
-            assert not missing.any()   # no phantom keys after rehash
+            _, found = t2.get(rng.choice(2 ** 40, 8).astype(np.uint64))
+            assert not found.any()     # no phantom keys after rehash
             # adagrad state survives the rehash: the same continuation
             # add produces the same values as on the source table
             t2.add(keys[:5], np.ones((5, 3), np.float32), sync=True)
