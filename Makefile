@@ -3,7 +3,7 @@
 #
 #   make test     - full suite on the 8-virtual-CPU-device mesh
 #   make dryrun   - multi-chip sharding compile/execute check (8 devices)
-#   make bench    - driver benchmark on the default devices (one JSON line)
+#   make bench    - driver benchmark on the default devices (metric JSON lines; last line carries both metrics)
 #   make native   - C++ data loader + baseline binaries
 #   make ci       - everything CI runs, in order
 

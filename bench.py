@@ -1,9 +1,16 @@
 """Driver benchmark: word2vec steady-state training throughput on the
-default JAX devices (the real TPU chip under the driver).
+default JAX devices (the real TPU chip under the driver), plus the
+LightLDA metric of record.
 
-Prints ONE JSON line:
+Prints the metric JSON line TWICE on success: first without, then with
+the LDA keys —
   {"metric": "w2v_words_per_sec_per_chip", "value": N, "unit": "words/s",
-   "vs_baseline": R}
+   "vs_baseline": R, ..., "lda_doc_tokens_per_sec": N2,
+   "lda_vs_baseline": R2}
+The driver records the LAST complete JSON line (both BASELINE.json
+metrics ride it); printing the w2v-only line first means a tunnel wedge
+during the LDA tier can't lose the w2v capture. Consumers wanting a
+single document should take the last stdout line.
 
 vs_baseline = per-chip words/sec divided by one CPU worker's words/sec
 from benchmarks/baseline_cpu.json (the faithful reference-hot-loop
@@ -25,12 +32,14 @@ Three-tier pipeline decomposition (each reported in the JSON line):
 - engine (`value`): pre-staged device operands — pure training engine.
 - engine_fed (`engine_fed_words_per_sec`): host batches pre-GENERATED,
   but every call runs the REAL per-call placement + dispatch path with
-  async overlap. Measured ~0.9x of engine on the tunneled chip — the
-  placement/dispatch design CAN feed the chip (one combined [S, B,
-  ctx+1] int16 placement per call — ids ship as int16 when the vocab
-  fits, halving H2D bytes; placements overlap compute); the residual
-  gap is tunnel RPC cost on the placement path, which a PCIe-attached
-  host does not pay.
+  async overlap (one combined [S, B, ctx+1] int16 placement per call —
+  ids ship as int16 when the vocab fits, halving H2D bytes; placements
+  overlap compute). The fraction of engine this reaches depends on the
+  tunnel's RPC weather: driver-captured 0.505 (BENCH_r03) on a bad
+  window vs 0.895 measured 2026-07-30 with the gap accounted as ~2.7
+  non-overlapped ~12ms placement RPCs per call
+  (benchmarks/experiments/tunnel_rpc_account.json) — tunnel RPC cost on
+  the placement path, which a PCIe-attached host does not pay.
 - e2e (`e2e_words_per_sec`): the whole pipeline including host pair
   GENERATION. `gen_words_per_sec` reports the WHOLE-HOST generation
   rate (native C++ backend, one thread): measured well above ONE
@@ -70,6 +79,54 @@ STEPS_PER_CALL = 512
 WARMUP_CALLS = 2
 TIMED_CALLS = 8
 LR = 0.01
+
+
+def measure_lda_tier() -> dict:
+    """The second metric of record (BASELINE.json): LightLDA
+    doc-tokens/sec on the production doc-blocked pallas sampler, vs the
+    pinned 1-worker CPU MH baseline (benchmarks/measure_lda.py protocol —
+    V=50k, 10M tokens, K=1024 vs the CPU's K=1000).
+
+    Reuses the pinned CPU measurement from benchmarks/lda_results.json
+    (the best recorded run — generous to the reference; re-measuring on
+    this noisy shared host would only deflate the baseline); falls back
+    to a fresh native-binary measurement when the artifact is missing.
+    Raises on failure — main() catches and substitutes {} so the w2v
+    capture still prints.
+
+    `lda_doc_tokens_per_sec` is the BEST of 10 timed sweeps — the same
+    tunnel-noise rationale as the engine-fed/e2e best-of-3 above: a slow
+    sweep is an RPC stall on the tunneled chip (observed 35% swings
+    within minutes of a 1.4%-spread run), not sampler work; each sweep
+    is ~0.5s so the extra passes are cheap insurance against a bad
+    window. The mean and spread ride along so the dispersion is on the
+    record.
+    """
+    sys.path.insert(0, os.path.join(HERE, "benchmarks"))
+    import measure_lda
+
+    try:
+        with open(os.path.join(HERE, "benchmarks", "lda_results.json")) as f:
+            cpu = json.load(f)["cpu_worker"]
+        # same workload-match guard as measure_lda.pinned_cpu: a stale
+        # artifact from changed workload constants must not skew the
+        # metric of record
+        want = {"tokens": measure_lda.T, "topics": measure_lda.K_CPU,
+                "vocab": measure_lda.V, "docs": measure_lda.D}
+        if any(cpu.get(k) != v for k, v in want.items()):
+            raise KeyError("cpu_worker workload mismatch")
+    except (OSError, KeyError, ValueError):
+        cpu = measure_lda.pinned_cpu()
+    tpu = measure_lda.measure_tpu("tiled", timed_sweeps=10,
+                                  time_budget_s=45.0, eval_loglik=False)
+    best = max(tpu["runs_tok_per_sec"])
+    return {
+        "lda_doc_tokens_per_sec": round(best, 1),
+        "lda_vs_baseline": round(best / cpu["doc_tokens_per_sec"], 3),
+        "lda_mean_doc_tokens_per_sec": round(tpu["doc_tokens_per_sec"], 1),
+        "lda_spread_pct": tpu["spread_pct"],
+        "lda_baseline_cpu_doc_tokens_per_sec": cpu["doc_tokens_per_sec"],
+    }
 
 
 def load_baseline() -> float:
@@ -170,14 +227,14 @@ def main() -> None:
     # (placement included) vs e2e (generation included) decomposes the
     # pipeline. Dispatches stay async until the final loss fence, so
     # placements overlap compute exactly as the prefetch pipeline would.
-    # Best of 2 passes: the tunneled chip's RPC latency swings a LOT
+    # Best of 3 passes: the tunneled chip's RPC latency swings a LOT
     # between runs (observed 2x intra-day) and this tier exists to
     # measure the placement DESIGN, not tunnel weather; the engine tier
     # above is dispatch-amortized and stays stable without this.
     ef_loss = dispatch(0, app._place(*host_calls[0]))   # warm the path
     float(ef_loss)
     ef_dt = float("inf")
-    for _ in range(2):
+    for _ in range(3):
         t0 = time.perf_counter()
         for i, (s, t) in enumerate(host_calls[WARMUP_CALLS:]):
             ef_loss = dispatch(i, app._place(s, t))
@@ -193,7 +250,7 @@ def main() -> None:
     e2e_calls = 10
     app.train(total_steps=STEPS_PER_CALL)
     e2e_words, e2e_dt = 0.0, float("inf")
-    for _ in range(2):          # best of 2 (same tunnel-noise rationale
+    for _ in range(3):          # best of 3 (same tunnel-noise rationale
         steps_before = app._step_no            # as the engine-fed tier)
         t0 = time.perf_counter()
         app.train(total_steps=e2e_calls * STEPS_PER_CALL)
@@ -218,7 +275,8 @@ def main() -> None:
         "e2e_secs": round(e2e_dt, 3),
         "baseline_cpu_words_per_sec": baseline,
     }), file=sys.stderr)
-    print(json.dumps({
+
+    w2v_line = {
         "metric": "w2v_words_per_sec_per_chip",
         "value": round(per_chip, 1),
         "unit": "words/s",
@@ -228,7 +286,22 @@ def main() -> None:
         "gen_words_per_sec": round(gen_words_per_sec, 1),
         "e2e_words_per_sec": round(e2e_words, 1),
         "e2e_vs_baseline": round(e2e_words / baseline, 3),
-    }))
+    }
+    # print the w2v capture BEFORE attempting the LDA tier: the driver
+    # records the LAST complete JSON line, so if the tunnel wedges
+    # mid-LDA (a hang, not an exception — observed), the w2v metrics
+    # survive in the log tail instead of being lost with the process
+    print(json.dumps(w2v_line), flush=True)
+
+    # second metric of record, carried on the SAME final JSON line:
+    # LightLDA doc-tokens/sec
+    try:
+        lda = measure_lda_tier()
+    except Exception as e:             # never lose the w2v capture
+        print(f"lda tier failed: {e!r}", file=sys.stderr)
+        lda = {}
+    if lda:
+        print(json.dumps({**w2v_line, **lda}))
 
 
 if __name__ == "__main__":

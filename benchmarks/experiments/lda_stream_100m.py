@@ -51,8 +51,21 @@ rng = np.random.default_rng(0)
 p = 1.0 / np.arange(1, V + 1) ** 1.1
 p /= p.sum()
 t0 = time.perf_counter()
-tw = rng.choice(V, T, p=p).astype(np.int32)
-td = np.sort(rng.integers(0, D, T)).astype(np.int32)
+cache = os.environ.get("MVTPU_CORPUS_NPZ", "")
+if cache and os.path.exists(cache):
+    with np.load(cache) as d:           # pre-generated corpus (the
+        tw, td = d["tw"], d["td"]       # zipf draw is ~minutes at 300M+)
+        meta = {k: int(d[k]) for k in ("V", "D", "seed") if k in d}
+    assert len(tw) == T and len(td) == T, (len(tw), len(td), T)
+    # a cache built for different workload parameters must not silently
+    # feed the measured artifact a mismatched corpus
+    assert meta.get("V", V) == V and meta.get("D", D) == D, (meta, V, D)
+    assert int(tw.max()) < V and int(td.max()) < D, "corpus out of range"
+else:
+    tw = rng.choice(V, T, p=p).astype(np.int32)
+    td = np.sort(rng.integers(0, D, T)).astype(np.int32)
+    if cache:
+        np.savez(cache, tw=tw, td=td, V=V, D=D, seed=0)
 gen_secs = time.perf_counter() - t0
 print(f"gen: {gen_secs:.0f}s  ram_hwm={ram_hwm_gb()}GB", flush=True)
 

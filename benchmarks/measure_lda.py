@@ -84,7 +84,14 @@ def _tpu_app(sampler: str, steps_per_call: int = 1):
 
 
 def measure_tpu(sampler: str = "tiled", timed_sweeps: int = 3,
-                steps_per_call: int = 1) -> dict:
+                steps_per_call: int = 1, time_budget_s: float = None,
+                eval_loglik: bool = True) -> dict:
+    """``time_budget_s`` caps the TIMED phase's wall-clock: when the
+    tunnel degrades, a sweep can stall 10x (driver risk: an unbounded
+    loop blows the bench timeout and loses the whole capture) — stop
+    after the budget as long as 2 sweeps landed.  ``eval_loglik=False``
+    also skips the final likelihood eval (a full eval pass, ~the cost of
+    a sweep) for time-budgeted callers that only need throughput."""
     import numpy as np
     app = _tpu_app(sampler, steps_per_call)
     app.sweep()                                   # compile + first sweep
@@ -93,11 +100,15 @@ def measure_tpu(sampler: str = "tiled", timed_sweeps: int = 3,
         return float(np.asarray(app.summary.raw())[0])
     sync()
     runs = []
+    budget_t0 = time.perf_counter()
     for _ in range(timed_sweeps):                 # the host is noisy:
         t0 = time.perf_counter()                  # report mean +- spread
         app.sweep()
         sync()
         runs.append(time.perf_counter() - t0)
+        if time_budget_s is not None and len(runs) >= 2 \
+                and time.perf_counter() - budget_t0 > time_budget_s:
+            break
     cfg = app.config
     rates = [T / r for r in runs]
     return {"doc_tokens_per_sec": T * len(runs) / sum(runs),
@@ -116,7 +127,7 @@ def measure_tpu(sampler: str = "tiled", timed_sweeps: int = 3,
             # measured workload's value (None: sampler doesn't pack)
             "packing_fill": (round(app.packing_fill, 4)
                              if hasattr(app, "packing_fill") else None),
-            "loglik_after": app.loglik()}
+            "loglik_after": app.loglik() if eval_loglik else None}
 
 
 def quality_curve(tpu_sweeps: int = 40, cpu_sweeps: int = 12) -> dict:
