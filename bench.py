@@ -129,40 +129,19 @@ def measure_lda_tier() -> dict:
     }
 
 
-def load_baseline() -> float:
-    try:
-        with open(BASELINE_PATH) as f:
-            return float(json.load(f)["words_per_sec"])
-    except (OSError, KeyError, ValueError):
-        # fall back to measuring on the spot (slow path)
-        sys.path.insert(0, os.path.join(HERE, "benchmarks"))
-        from measure_cpu_baseline import measure
-        return float(measure(repeats=1)["words_per_sec"])
-
-
-def main() -> None:
-    import jax
-    from multiverso_tpu import core
-    from multiverso_tpu.apps.word_embedding import W2VConfig, WordEmbedding
+def build_bench_corpus():
+    """The matched w2v workload both the bench and its probes measure."""
     from multiverso_tpu.data.corpus import Corpus, synthetic_text
-
-    baseline = load_baseline()
-    n_chips = len(jax.devices())
-    mesh = core.init()
-
     with tempfile.TemporaryDirectory() as tmp:
         path = os.path.join(tmp, "corpus.txt")
         synthetic_text(path, num_tokens=TOKENS, vocab_size=VOCAB, seed=1)
-        corpus = Corpus.from_file(path, min_count=1, subsample=SUBSAMPLE)
+        return Corpus.from_file(path, min_count=1, subsample=SUBSAMPLE)
 
-    cfg = W2VConfig(embedding_dim=DIM, window=WINDOW, negative=NEGATIVE,
-                    batch_size=BATCH, steps_per_call=STEPS_PER_CALL,
-                    learning_rate=LR, epochs=1, subsample=SUBSAMPLE, seed=1)
-    app = WordEmbedding(corpus, cfg, mesh=mesh, name="bench_w2v")
 
-    # pre-generate host pair batches once; the engine loop pre-stages
-    # them on device, the engine-fed loop re-places them per call
-    need_calls = WARMUP_CALLS + TIMED_CALLS
+def stage_host_calls(corpus, need_calls: int):
+    """Pre-generate host pair batches: [(srcs, tgts)] x need_calls,
+    each [STEPS_PER_CALL, BATCH]. Shared by bench.py and the tunnel
+    probe so both measure the SAME staging/dispatch pipeline."""
     host_calls = []
     buf_s, buf_t = [], []
     it = corpus.skipgram_batches(BATCH, window=WINDOW, seed=1,
@@ -178,6 +157,54 @@ def main() -> None:
     if len(host_calls) < need_calls:
         raise SystemExit(f"corpus too small: staged {len(host_calls)} "
                          f"calls, need {need_calls}")
+    return host_calls
+
+
+def make_dispatch(app):
+    """The per-call dispatch closure (fold_in key + fused superstep),
+    shared with the tunnel probe."""
+    import jax
+    import jax.numpy as jnp
+    lrs_dev = jnp.asarray(np.full(STEPS_PER_CALL, LR, np.float32))
+
+    def dispatch(i, placed):
+        key = jax.random.fold_in(app._key, i)
+        _, loss = app._fused((), placed, key, lrs_dev)
+        return loss
+
+    return dispatch
+
+
+def load_baseline() -> float:
+    try:
+        with open(BASELINE_PATH) as f:
+            return float(json.load(f)["words_per_sec"])
+    except (OSError, KeyError, ValueError):
+        # fall back to measuring on the spot (slow path)
+        sys.path.insert(0, os.path.join(HERE, "benchmarks"))
+        from measure_cpu_baseline import measure
+        return float(measure(repeats=1)["words_per_sec"])
+
+
+def main() -> None:
+    import jax
+    from multiverso_tpu import core
+    from multiverso_tpu.apps.word_embedding import W2VConfig, WordEmbedding
+
+    baseline = load_baseline()
+    n_chips = len(jax.devices())
+    mesh = core.init()
+
+    corpus = build_bench_corpus()
+    cfg = W2VConfig(embedding_dim=DIM, window=WINDOW, negative=NEGATIVE,
+                    batch_size=BATCH, steps_per_call=STEPS_PER_CALL,
+                    learning_rate=LR, epochs=1, subsample=SUBSAMPLE, seed=1)
+    app = WordEmbedding(corpus, cfg, mesh=mesh, name="bench_w2v")
+
+    # pre-generate host pair batches once; the engine loop pre-stages
+    # them on device, the engine-fed loop re-places them per call
+    need_calls = WARMUP_CALLS + TIMED_CALLS
+    host_calls = stage_host_calls(corpus, need_calls)
     calls = [app._place(s, t) for s, t in host_calls]
     # pairs/token ratio for converting pairs/sec -> words/sec, measured
     # from one full epoch's worth of generation — TIMED, because the
@@ -192,14 +219,7 @@ def main() -> None:
     pairs_per_token = gen_pairs / corpus.num_tokens
     gen_words_per_sec = corpus.num_tokens / gen_dt
 
-    lrs = np.full(STEPS_PER_CALL, LR, np.float32)
-    import jax.numpy as jnp
-    lrs_dev = jnp.asarray(lrs)
-
-    def dispatch(i, placed):
-        key = jax.random.fold_in(app._key, i)
-        _, loss = app._fused((), placed, key, lrs_dev)
-        return loss
+    dispatch = make_dispatch(app)
 
     warm_loss = None
     for i in range(WARMUP_CALLS):
@@ -292,6 +312,15 @@ def main() -> None:
     # mid-LDA (a hang, not an exception — observed), the w2v metrics
     # survive in the log tail instead of being lost with the process
     print(json.dumps(w2v_line), flush=True)
+
+    # free the w2v working set (10 staged ~46MB placement buffers + the
+    # embedding tables) before the LDA tier allocates its own tables —
+    # the two benchmarks must not need to co-fit in HBM
+    import gc
+    from multiverso_tpu.tables import base as table_base
+    del calls, app, dispatch
+    table_base.reset_tables()
+    gc.collect()
 
     # second metric of record, carried on the SAME final JSON line:
     # LightLDA doc-tokens/sec

@@ -52,6 +52,9 @@ p = 1.0 / np.arange(1, V + 1) ** 1.1
 p /= p.sum()
 t0 = time.perf_counter()
 cache = os.environ.get("MVTPU_CORPUS_NPZ", "")
+if cache and not cache.endswith(".npz"):
+    cache += ".npz"      # np.savez appends it on write; keep the load
+    # check and the save path pointing at the same file
 if cache and os.path.exists(cache):
     with np.load(cache) as d:           # pre-generated corpus (the
         tw, td = d["tw"], d["td"]       # zipf draw is ~minutes at 300M+)

@@ -28,7 +28,6 @@ import json
 import os
 import statistics
 import sys
-import tempfile
 import time
 
 import numpy as np
@@ -37,42 +36,30 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(os.path.dirname(HERE))
 sys.path.insert(0, REPO)
 
-VOCAB, TOKENS, DIM = 10_000, 1_000_000, 100
-WINDOW, NEGATIVE, SUBSAMPLE = 5, 5, 1e-3
-BATCH, STEPS_PER_CALL = 4096, 512
+# the workload/config constants and the staging/dispatch pipeline are
+# bench.py's OWN — imported, not copied, so the probe always measures
+# the same pipeline the bench reports
+import bench  # noqa: E402
+from bench import (BATCH, DIM, LR, NEGATIVE, STEPS_PER_CALL,  # noqa: E402
+                   SUBSAMPLE, WINDOW, build_bench_corpus, make_dispatch,
+                   stage_host_calls)
+
 N_PLACE, TIMED_CALLS, REPEATS = 24, 8, 3
 
 
 def main() -> None:
     import jax
-    import jax.numpy as jnp
     from multiverso_tpu import core
     from multiverso_tpu.apps.word_embedding import W2VConfig, WordEmbedding
-    from multiverso_tpu.data.corpus import Corpus, synthetic_text
 
     mesh = core.init()
-    with tempfile.TemporaryDirectory() as tmp:
-        path = os.path.join(tmp, "corpus.txt")
-        synthetic_text(path, num_tokens=TOKENS, vocab_size=VOCAB, seed=1)
-        corpus = Corpus.from_file(path, min_count=1, subsample=SUBSAMPLE)
+    corpus = build_bench_corpus()
     cfg = W2VConfig(embedding_dim=DIM, window=WINDOW, negative=NEGATIVE,
                     batch_size=BATCH, steps_per_call=STEPS_PER_CALL,
-                    learning_rate=0.01, epochs=1, subsample=SUBSAMPLE,
+                    learning_rate=LR, epochs=1, subsample=SUBSAMPLE,
                     seed=1)
     app = WordEmbedding(corpus, cfg, mesh=mesh, name="rpc_probe")
-
-    host_calls = []
-    buf_s, buf_t = [], []
-    need = TIMED_CALLS + 1
-    for src, tgt in corpus.skipgram_batches(BATCH, window=WINDOW, seed=1,
-                                            epochs=need):
-        buf_s.append(src)
-        buf_t.append(tgt)
-        if len(buf_s) == STEPS_PER_CALL:
-            host_calls.append((np.stack(buf_s), np.stack(buf_t)))
-            buf_s, buf_t = [], []
-            if len(host_calls) >= need:
-                break
+    host_calls = stage_host_calls(corpus, TIMED_CALLS + 1)
 
     # --- tier 1: the raw placement RPC, isolated --------------------------
     placed = app._place(*host_calls[0])
@@ -87,13 +74,7 @@ def main() -> None:
     placement_ms = statistics.median(lat)
 
     # --- tier 2: engine (pre-staged) vs engine_fed, best-of-R ------------
-    lrs_dev = jnp.asarray(np.full(STEPS_PER_CALL, 0.01, np.float32))
-
-    def dispatch(i, placed):
-        key = jax.random.fold_in(app._key, i)
-        _, loss = app._fused((), placed, key, lrs_dev)
-        return loss
-
+    dispatch = make_dispatch(app)
     staged = [app._place(s, t) for s, t in host_calls]
     float(dispatch(0, staged[0]))                       # compile + warm
     eng_dt = fed_dt = float("inf")

@@ -1,0 +1,129 @@
+"""Chip-independent logic tests for bench.py's metric plumbing (the
+driver records the LAST complete JSON line bench.py prints; these pin
+the parts of that contract that don't need the real chip)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def bench_mod():
+    sys.path.insert(0, REPO)
+    sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+    import bench
+    import measure_lda
+    yield bench, measure_lda
+
+
+def test_lda_tier_reports_best_sweep_and_protocol(bench_mod, monkeypatch):
+    bench, measure_lda = bench_mod
+    calls = {}
+
+    def fake_measure_tpu(sampler, timed_sweeps=3, steps_per_call=1,
+                         time_budget_s=None, eval_loglik=True):
+        calls.update(sampler=sampler, sweeps=timed_sweeps,
+                     budget=time_budget_s, eval=eval_loglik)
+        return {"doc_tokens_per_sec": 19e6,
+                "runs_tok_per_sec": [18e6, 19.7e6, 19.2e6, 16e6],
+                "spread_pct": 18.8}
+
+    monkeypatch.setattr(measure_lda, "measure_tpu", fake_measure_tpu)
+    # hermetic: never fall through to the native-binary baseline path
+    # even if the committed artifact goes missing or changes workload
+    monkeypatch.setattr(
+        measure_lda, "pinned_cpu",
+        lambda: {"doc_tokens_per_sec": 2029587.7,
+                 "tokens": measure_lda.T, "topics": measure_lda.K_CPU,
+                 "vocab": measure_lda.V, "docs": measure_lda.D})
+    out = bench.measure_lda_tier()
+    # protocol: production sampler, budgeted, no final eval
+    assert calls == {"sampler": "tiled", "sweeps": 10, "budget": 45.0,
+                     "eval": False}
+    # best sweep is the metric (a slow sweep is an RPC stall, not
+    # sampler work); mean + spread ride along
+    assert out["lda_doc_tokens_per_sec"] == 19.7e6
+    assert out["lda_mean_doc_tokens_per_sec"] == 19e6
+    assert out["lda_spread_pct"] == 18.8
+    assert out["lda_vs_baseline"] == round(
+        19.7e6 / out["lda_baseline_cpu_doc_tokens_per_sec"], 3)
+
+
+def test_lda_tier_rejects_stale_workload_baseline(bench_mod, monkeypatch,
+                                                  tmp_path):
+    """A lda_results.json from CHANGED workload constants must not feed
+    the metric of record — the tier falls back to pinned_cpu()."""
+    bench, measure_lda = bench_mod
+    stale = {"cpu_worker": {"doc_tokens_per_sec": 1.0, "tokens": 123,
+                            "topics": measure_lda.K_CPU,
+                            "vocab": measure_lda.V,
+                            "docs": measure_lda.D}}
+    path = tmp_path / "lda_results.json"
+    path.write_text(json.dumps(stale))
+    monkeypatch.setattr(bench, "HERE", str(tmp_path.parent))
+    # redirect the artifact lookup to the stale file
+    real_open = open
+
+    def fake_open(p, *a, **k):
+        if str(p).endswith("lda_results.json"):
+            return real_open(path, *a, **k)
+        return real_open(p, *a, **k)
+
+    monkeypatch.setattr("builtins.open", fake_open)
+    pinned = {"doc_tokens_per_sec": 2e6, "tokens": measure_lda.T,
+              "topics": measure_lda.K_CPU, "vocab": measure_lda.V,
+              "docs": measure_lda.D}
+    monkeypatch.setattr(measure_lda, "pinned_cpu", lambda: pinned)
+    monkeypatch.setattr(
+        measure_lda, "measure_tpu",
+        lambda *a, **k: {"doc_tokens_per_sec": 16e6,
+                         "runs_tok_per_sec": [16e6], "spread_pct": 0.0})
+    out = bench.measure_lda_tier()
+    assert out["lda_baseline_cpu_doc_tokens_per_sec"] == 2e6  # not 1.0
+    assert out["lda_vs_baseline"] == 8.0
+
+
+def test_measure_tpu_time_budget_breaks_early(bench_mod, monkeypatch):
+    """The timed loop must stop once the budget elapses with >=2 sweeps
+    landed — an unbounded loop under a wedged tunnel blows the driver's
+    bench timeout and loses the whole capture."""
+    bench, measure_lda = bench_mod
+
+    class FakeApp:
+        config = type("C", (), {
+            "batch_tokens": 1, "sampler": "tiled", "stale_words": True,
+            "doc_blocked": True, "block_tokens": 1, "block_docs": 1})()
+        packing_fill = 1.0
+
+        def sweep(self):
+            pass
+
+        class _Summary:
+            @staticmethod
+            def raw():
+                import numpy as np
+                return np.zeros(1, np.float32)
+        summary = _Summary()
+
+        def loglik(self):
+            raise AssertionError("eval_loglik=False must skip loglik")
+
+    monkeypatch.setattr(measure_lda, "_tpu_app",
+                        lambda sampler, spc: FakeApp())
+    # each fake sweep "takes" 30s of perf_counter time
+    t = {"now": 0.0}
+
+    def fake_pc():
+        t["now"] += 15.0          # two reads per sweep iteration
+        return t["now"]
+
+    monkeypatch.setattr(measure_lda.time, "perf_counter", fake_pc)
+    out = measure_lda.measure_tpu("tiled", timed_sweeps=10,
+                                  time_budget_s=45.0, eval_loglik=False)
+    # budget 45s at ~30s/sweep -> exactly 2 timed sweeps, not 10
+    assert len(out["runs_tok_per_sec"]) == 2
+    assert out["loglik_after"] is None
