@@ -47,28 +47,14 @@ from multiverso_tpu.apps.lightlda import LightLDA, LDAConfig  # noqa: E402
 T = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000_000
 V, K = 50_000, 1024
 D = T // 100                                          # ~100 tokens/doc
-rng = np.random.default_rng(0)
-p = 1.0 / np.arange(1, V + 1) ** 1.1
-p /= p.sum()
 t0 = time.perf_counter()
-cache = os.environ.get("MVTPU_CORPUS_NPZ", "")
-if cache and not cache.endswith(".npz"):
-    cache += ".npz"      # np.savez appends it on write; keep the load
-    # check and the save path pointing at the same file
-if cache and os.path.exists(cache):
-    with np.load(cache) as d:           # pre-generated corpus (the
-        tw, td = d["tw"], d["td"]       # zipf draw is ~minutes at 300M+)
-        meta = {k: int(d[k]) for k in ("V", "D", "seed") if k in d}
-    assert len(tw) == T and len(td) == T, (len(tw), len(td), T)
-    # a cache built for different workload parameters must not silently
-    # feed the measured artifact a mismatched corpus
-    assert meta.get("V", V) == V and meta.get("D", D) == D, (meta, V, D)
-    assert int(tw.max()) < V and int(td.max()) < D, "corpus out of range"
-else:
-    tw = rng.choice(V, T, p=p).astype(np.int32)
-    td = np.sort(rng.integers(0, D, T)).astype(np.int32)
-    if cache:
-        np.savez(cache, tw=tw, td=td, V=V, D=D, seed=0)
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from measure_lda import zipf_corpus_cached  # noqa: E402  (one shared
+# cached-corpus implementation: guarded load, metadata validation,
+# atomic write — see measure_lda.py)
+tw, td = zipf_corpus_cached(
+    V, D, T, seed=0,
+    cache_path=os.environ.get("MVTPU_CORPUS_NPZ") or None)
 gen_secs = time.perf_counter() - t0
 print(f"gen: {gen_secs:.0f}s  ram_hwm={ram_hwm_gb()}GB", flush=True)
 

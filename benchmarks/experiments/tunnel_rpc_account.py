@@ -39,7 +39,6 @@ sys.path.insert(0, REPO)
 # the workload/config constants and the staging/dispatch pipeline are
 # bench.py's OWN — imported, not copied, so the probe always measures
 # the same pipeline the bench reports
-import bench  # noqa: E402
 from bench import (BATCH, DIM, LR, NEGATIVE, STEPS_PER_CALL,  # noqa: E402
                    SUBSAMPLE, WINDOW, build_bench_corpus, make_dispatch,
                    stage_host_calls)

@@ -58,16 +58,58 @@ def measure_cpu(sweeps: int = 2, curve: bool = False) -> dict:
     return json.loads(out)
 
 
-def _tpu_app(sampler: str, steps_per_call: int = 1):
+def zipf_corpus_cached(vocab: int, docs: int, tokens: int, seed: int,
+                       cache_path: str = None):
+    """(tw, td) for the zipf-1.1 synthetic workload, disk-cached.
+
+    The draw costs minutes at 100M+ tokens and ~40s even at 10M —
+    regenerating inside every bench.py run wastes the driver's time
+    budget and risks its timeout. Shared by the bench tier and the
+    out-of-core artifact script (one implementation, one validation
+    scheme). The load is fully guarded (corrupt/foreign/truncated cache
+    → regenerate, never crash: a driver kill mid-write must not poison
+    every later run) and validated against embedded workload metadata;
+    the write is atomic (tmp + os.replace)."""
     import numpy as np
+    if cache_path and not cache_path.endswith(".npz"):
+        cache_path += ".npz"             # np.savez appends it on write
+    if cache_path and os.path.exists(cache_path):
+        try:
+            with np.load(cache_path) as d:
+                tw, td = d["tw"], d["td"]
+                meta = tuple(int(d[k]) for k in ("V", "D", "seed"))
+            if meta == (vocab, docs, seed) and len(tw) == tokens \
+                    and len(td) == tokens and int(tw.max()) < vocab \
+                    and int(td.max()) < docs:
+                return tw, td
+            print(f"corpus cache {cache_path} is for another workload "
+                  f"({meta} vs {(vocab, docs, seed)}); regenerating",
+                  file=sys.stderr)
+        except Exception as e:           # truncated/foreign/unreadable
+            print(f"corpus cache {cache_path} unusable ({e!r}); "
+                  "regenerating", file=sys.stderr)
+    rng = np.random.default_rng(seed)
+    p = 1.0 / np.arange(1, vocab + 1) ** 1.1
+    p /= p.sum()
+    tw = rng.choice(vocab, tokens, p=p).astype(np.int32)
+    td = np.sort(rng.integers(0, docs, tokens)).astype(np.int32)
+    if cache_path:
+        try:
+            tmp = f"{cache_path[:-4]}.tmp{os.getpid()}.npz"
+            np.savez(tmp, tw=tw, td=td, V=vocab, D=docs, seed=seed)
+            os.replace(tmp, cache_path)
+        except OSError:
+            pass                         # cache is best-effort
+    return tw, td
+
+
+def _tpu_app(sampler: str, steps_per_call: int = 1):
     from multiverso_tpu import core
     from multiverso_tpu.apps.lightlda import LightLDA, LDAConfig
 
-    rng = np.random.default_rng(0)
-    p = 1.0 / np.arange(1, V + 1) ** 1.1
-    p /= p.sum()
-    tw = rng.choice(V, T, p=p).astype(np.int32)
-    td = np.sort(rng.integers(0, D, T)).astype(np.int32)
+    tw, td = zipf_corpus_cached(
+        V, D, T, seed=0,
+        cache_path=os.path.join("/tmp", f"mvtpu_lda_bench_{V}_{D}_{T}_s0"))
     core.init()
     tiled = sampler == "tiled"
     return LightLDA(tw, td, V, LDAConfig(
