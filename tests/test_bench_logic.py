@@ -127,3 +127,30 @@ def test_measure_tpu_time_budget_breaks_early(bench_mod, monkeypatch):
     # budget 45s at ~30s/sweep -> exactly 2 timed sweeps, not 10
     assert len(out["runs_tok_per_sec"]) == 2
     assert out["loglik_after"] is None
+
+
+def test_zipf_corpus_cache_guards(bench_mod, tmp_path):
+    """The shared corpus cache must regenerate on corrupt or
+    wrong-workload files (a driver kill mid-write must not poison every
+    later bench run) and reload validated content otherwise."""
+    import numpy as np
+    _, measure_lda = bench_mod
+    cache = str(tmp_path / "c.npz")
+    tw, td = measure_lda.zipf_corpus_cached(500, 40, 2000, seed=0,
+                                            cache_path=cache)
+    assert len(tw) == 2000 and int(tw.max()) < 500 and int(td.max()) < 40
+    tw2, td2 = measure_lda.zipf_corpus_cached(500, 40, 2000, seed=0,
+                                              cache_path=cache)
+    np.testing.assert_array_equal(tw, tw2)       # warm load, same corpus
+    np.testing.assert_array_equal(td, td2)
+    # corrupt file -> regenerate, not crash
+    with open(cache, "wb") as f:
+        f.write(b"PK\x03\x04 truncated garbage")
+    tw3, _ = measure_lda.zipf_corpus_cached(500, 40, 2000, seed=0,
+                                            cache_path=cache)
+    np.testing.assert_array_equal(tw, tw3)       # deterministic redraw
+    # wrong-workload metadata -> regenerate for the requested workload
+    tw4, td4 = measure_lda.zipf_corpus_cached(700, 40, 2000, seed=0,
+                                              cache_path=cache)
+    assert len(tw4) == 2000 and int(tw4.max()) < 700
+    assert not np.array_equal(tw4, tw)           # different vocab draw
