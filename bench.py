@@ -186,7 +186,33 @@ def load_baseline() -> float:
         return float(measure(repeats=1)["words_per_sec"])
 
 
+def _probe_chip(timeout_s: float = 180.0) -> None:
+    """Fail FAST when the chip tunnel is wedged (observed: backend init
+    hangs indefinitely). A hang burns the caller's whole timeout once;
+    a quick nonzero exit leaves room for retries after recovery. The
+    probe runs in a child so a hung init can actually be killed."""
+    import subprocess
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp;"
+             "assert jax.default_backend() != 'cpu',"
+             " 'accelerator init fell back to CPU';"
+             "print(float(jnp.ones(2).sum()))"],
+            timeout=timeout_s, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        print(f"bench: chip probe timed out after {timeout_s:.0f}s — "
+              "tunnel wedged; aborting fast so a retry can land after "
+              "recovery", file=sys.stderr)
+        raise SystemExit(2)
+    if proc.returncode != 0:
+        print(f"bench: chip probe failed rc={proc.returncode}:\n"
+              f"{proc.stderr[-2000:]}", file=sys.stderr)
+        raise SystemExit(2)
+
+
 def main() -> None:
+    _probe_chip()
     import jax
     from multiverso_tpu import core
     from multiverso_tpu.apps.word_embedding import W2VConfig, WordEmbedding

@@ -154,3 +154,29 @@ def test_zipf_corpus_cache_guards(bench_mod, tmp_path):
                                               cache_path=cache)
     assert len(tw4) == 2000 and int(tw4.max()) < 700
     assert not np.array_equal(tw4, tw)           # different vocab draw
+
+
+def test_probe_chip_fails_fast_on_wedged_tunnel(bench_mod, monkeypatch):
+    """A wedged tunnel must abort the bench quickly with a clear exit
+    code, not hang into the driver's timeout."""
+    import subprocess
+    bench, _ = bench_mod
+
+    def fake_run(*a, **k):
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=k["timeout"])
+
+    monkeypatch.setattr("subprocess.run", fake_run)
+    with pytest.raises(SystemExit) as e:
+        bench._probe_chip(timeout_s=1.0)
+    assert e.value.code == 2
+
+    def fake_run_rc(*a, **k):
+        class P:
+            returncode = 1
+            stderr = "FAILED_PRECONDITION: something"
+        return P()
+
+    monkeypatch.setattr("subprocess.run", fake_run_rc)
+    with pytest.raises(SystemExit) as e:
+        bench._probe_chip(timeout_s=1.0)
+    assert e.value.code == 2
