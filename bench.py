@@ -115,7 +115,9 @@ def measure_lda_tier() -> dict:
                 "vocab": measure_lda.V, "docs": measure_lda.D}
         if any(cpu.get(k) != v for k, v in want.items()):
             raise KeyError("cpu_worker workload mismatch")
-    except (OSError, KeyError, ValueError):
+    except (OSError, KeyError, ValueError, TypeError, AttributeError):
+        # TypeError/AttributeError: structurally corrupt artifact (top
+        # level not a dict, cpu_worker not a dict) — same fallback
         cpu = measure_lda.pinned_cpu()
     tpu = measure_lda.measure_tpu("tiled", timed_sweeps=10,
                                   time_budget_s=45.0, eval_loglik=False)

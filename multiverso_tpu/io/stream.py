@@ -52,7 +52,11 @@ class _AtomicWriteFile:
 
     def __init__(self, path: str, mode: str) -> None:
         self._final = path
-        self._tmp = f"{path}.tmp.{os.getpid()}"
+        # pid alone is NOT unique across hosts writing the same shared
+        # path (two ranks on different machines can share a pid) —
+        # include a random component
+        import uuid
+        self._tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
         self._f = open(self._tmp, mode)
 
     def write(self, b):

@@ -426,9 +426,7 @@ class KVTable:
         live = ~(ck_keys == np.uint32(0xFFFFFFFF)).all(-1)
         bb, ss = np.nonzero(live)
         k2 = ck_keys[bb, ss]                          # [n, 2]
-        u64 = (k2[:, 0].astype(np.uint64) << np.uint64(32)) \
-            | k2[:, 1].astype(np.uint64)
-        buckets = self._buckets_of(u64)
+        buckets = self._buckets_of(_join_keys(k2))
         order = np.argsort(buckets, kind="stable")
         sb = buckets[order]
         n = len(sb)

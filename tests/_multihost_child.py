@@ -198,9 +198,10 @@ def main() -> None:
     ref_dt = lda.doc_topics()
 
     # multi-process streamed store/load: store is collective (z sync +
-    # chunked allgather) but only rank 0 writes the shared state path;
-    # the barrier inside store makes it safe for every rank to load
-    # immediately — the round-trip must preserve z exactly
+    # chunked allgather); every rank writes the shared state path via
+    # the stream layer's atomic temp+rename (identical payloads — z is
+    # globally complete after the sync), so loads are safe immediately
+    # — the round-trip must preserve z exactly
     import os
     import tempfile
     ck_s = os.path.join(tempfile.gettempdir(), f"mh_ck_{port}_s")
