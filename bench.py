@@ -188,29 +188,77 @@ def load_baseline() -> float:
         return float(measure(repeats=1)["words_per_sec"])
 
 
-def _probe_chip(timeout_s: float = 180.0) -> None:
-    """Fail FAST when the chip tunnel is wedged (observed: backend init
-    hangs indefinitely). A hang burns the caller's whole timeout once;
-    a quick nonzero exit leaves room for retries after recovery. The
-    probe runs in a child so a hung init can actually be killed."""
+def _probe_chip(timeout_s: float = 180.0, deadline_s: "float | None" = None,
+                retry_wait_s: float = 60.0, max_rc_failures: int = 5) -> None:
+    """Wait out a wedged chip tunnel, up to a deadline.
+
+    Observed failure mode: backend init hangs indefinitely while the
+    tunnel is wedged — so each probe attempt runs in a child that a
+    subprocess timeout can actually kill. Observed recovery mode:
+    wedges END (round 4's lasted ~7h; shorter ones clear within
+    minutes) — so one failed attempt must NOT forfeit the round
+    (BENCH_r04 exited 2 after 180s and lost the only driver capture of
+    the window). Instead: re-probe every ``retry_wait_s`` until
+    ``deadline_s`` of the bench window is spent, then exit 2 so the
+    driver still gets a fast, clear failure rather than a hang into
+    its own timeout. Deadline overridable via MVTPU_BENCH_PROBE_DEADLINE
+    (seconds)."""
     import subprocess
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax, jax.numpy as jnp;"
-             "assert jax.default_backend() != 'cpu',"
-             " 'accelerator init fell back to CPU';"
-             "print(float(jnp.ones(2).sum()))"],
-            timeout=timeout_s, capture_output=True, text=True)
-    except subprocess.TimeoutExpired:
-        print(f"bench: chip probe timed out after {timeout_s:.0f}s — "
-              "tunnel wedged; aborting fast so a retry can land after "
-              "recovery", file=sys.stderr)
-        raise SystemExit(2)
-    if proc.returncode != 0:
-        print(f"bench: chip probe failed rc={proc.returncode}:\n"
-              f"{proc.stderr[-2000:]}", file=sys.stderr)
-        raise SystemExit(2)
+    if deadline_s is None:
+        raw = os.environ.get("MVTPU_BENCH_PROBE_DEADLINE", "1800")
+        try:
+            deadline_s = float(raw)
+        except ValueError:
+            print(f"bench: ignoring malformed MVTPU_BENCH_PROBE_DEADLINE="
+                  f"{raw!r}; using 1800s", file=sys.stderr)
+            deadline_s = 1800.0
+    t0 = time.monotonic()
+    attempt = 0
+    rc_failures = 0
+    while True:
+        attempt += 1
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax, jax.numpy as jnp;"
+                 "assert jax.default_backend() != 'cpu',"
+                 " 'accelerator init fell back to CPU';"
+                 "print(float(jnp.ones(2).sum()))"],
+                timeout=timeout_s, capture_output=True, text=True)
+            if proc.returncode == 0:
+                if attempt > 1:
+                    print(f"bench: chip recovered on probe {attempt} "
+                          f"after {time.monotonic() - t0:.0f}s",
+                          file=sys.stderr)
+                return
+            failure = f"rc={proc.returncode}: {proc.stderr[-2000:]}"
+            rc_failures += 1
+        except subprocess.TimeoutExpired:
+            failure = f"hang, killed after {timeout_s:.0f}s"
+        elapsed = time.monotonic() - t0
+        # A HANG is the documented wedge signature and worth waiting out
+        # to the full deadline; a quick nonzero exit (e.g. the
+        # fell-back-to-CPU assertion, a persistent plugin error) is
+        # usually deterministic — allow a few retries for transient
+        # blips during tunnel recovery, then surface it fast instead of
+        # burning the driver window on an error that cannot recover.
+        if rc_failures >= max_rc_failures:
+            print(f"bench: chip probe failed {rc_failures}x with a "
+                  f"nonzero exit (not a hang) — deterministic failure, "
+                  f"giving up early (last: {failure})", file=sys.stderr)
+            raise SystemExit(2)
+        if elapsed >= deadline_s:
+            print(f"bench: chip probe gave up after {elapsed:.0f}s / "
+                  f"{attempt} attempt(s) (deadline {deadline_s:.0f}s; "
+                  f"last failure: {failure}) — tunnel wedged; exiting "
+                  "fast so the remaining driver window isn't a hang",
+                  file=sys.stderr)
+            raise SystemExit(2)
+        print(f"bench: chip probe {attempt} failed ({failure}); "
+              f"retrying in {retry_wait_s:.0f}s "
+              f"({elapsed:.0f}s/{deadline_s:.0f}s of the probe window "
+              "spent)", file=sys.stderr)
+        time.sleep(min(retry_wait_s, deadline_s - elapsed))
 
 
 def main() -> None:

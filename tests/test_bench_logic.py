@@ -156,9 +156,10 @@ def test_zipf_corpus_cache_guards(bench_mod, tmp_path):
     assert not np.array_equal(tw4, tw)           # different vocab draw
 
 
-def test_probe_chip_fails_fast_on_wedged_tunnel(bench_mod, monkeypatch):
-    """A wedged tunnel must abort the bench quickly with a clear exit
-    code, not hang into the driver's timeout."""
+def test_probe_chip_gives_up_at_deadline(bench_mod, monkeypatch):
+    """A wedged tunnel must eventually abort the bench with a clear
+    exit code (2), not hang into the driver's timeout — here with a
+    zero deadline so the give-up path runs on the first failure."""
     import subprocess
     bench, _ = bench_mod
 
@@ -167,7 +168,7 @@ def test_probe_chip_fails_fast_on_wedged_tunnel(bench_mod, monkeypatch):
 
     monkeypatch.setattr("subprocess.run", fake_run)
     with pytest.raises(SystemExit) as e:
-        bench._probe_chip(timeout_s=1.0)
+        bench._probe_chip(timeout_s=1.0, deadline_s=0.0)
     assert e.value.code == 2
 
     def fake_run_rc(*a, **k):
@@ -178,5 +179,96 @@ def test_probe_chip_fails_fast_on_wedged_tunnel(bench_mod, monkeypatch):
 
     monkeypatch.setattr("subprocess.run", fake_run_rc)
     with pytest.raises(SystemExit) as e:
+        bench._probe_chip(timeout_s=1.0, deadline_s=0.0)
+    assert e.value.code == 2
+
+
+def test_probe_chip_retries_until_recovery(bench_mod, monkeypatch):
+    """A transient wedge must DELAY the capture, not forfeit it
+    (BENCH_r04 regression): the probe re-tries inside its deadline and
+    returns cleanly once the tunnel recovers."""
+    import subprocess
+    bench, _ = bench_mod
+    calls = {"n": 0}
+
+    def flaky_run(*a, **k):
+        calls["n"] += 1
+        if calls["n"] < 3:          # two wedged attempts, then recovery
+            raise subprocess.TimeoutExpired(cmd="probe",
+                                            timeout=k["timeout"])
+
+        class P:
+            returncode = 0
+            stderr = ""
+        return P()
+
+    slept = []
+    monkeypatch.setattr("subprocess.run", flaky_run)
+    monkeypatch.setattr(bench.time, "sleep", slept.append)
+    bench._probe_chip(timeout_s=1.0, deadline_s=3600.0, retry_wait_s=60.0)
+    assert calls["n"] == 3
+    assert slept == [60.0, 60.0]    # waited between attempts, capped
+
+
+def test_probe_chip_deadline_env_override(bench_mod, monkeypatch):
+    """The driver-facing deadline knob: MVTPU_BENCH_PROBE_DEADLINE."""
+    import subprocess
+    bench, _ = bench_mod
+
+    def fake_run(*a, **k):
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=k["timeout"])
+
+    monkeypatch.setattr("subprocess.run", fake_run)
+    monkeypatch.setenv("MVTPU_BENCH_PROBE_DEADLINE", "0")
+    with pytest.raises(SystemExit) as e:
         bench._probe_chip(timeout_s=1.0)
     assert e.value.code == 2
+
+    # malformed value -> the documented default and exit contract (2),
+    # not an uncaught ValueError (rc=1)
+    monkeypatch.setenv("MVTPU_BENCH_PROBE_DEADLINE", "30m")
+    slept = []
+    monkeypatch.setattr(bench.time, "sleep", slept.append)
+    calls = {"n": 0}
+
+    def fail_then_ok(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise subprocess.TimeoutExpired(cmd="probe",
+                                            timeout=k["timeout"])
+
+        class P:
+            returncode = 0
+            stderr = ""
+        return P()
+
+    monkeypatch.setattr("subprocess.run", fail_then_ok)
+    bench._probe_chip(timeout_s=1.0)      # default 1800s window: retries
+    assert calls["n"] == 2 and len(slept) == 1
+
+
+def test_probe_chip_deterministic_rc_failure_exits_early(bench_mod,
+                                                         monkeypatch):
+    """A quick nonzero probe exit (chip absent / fell back to CPU) is
+    deterministic — a few retries for recovery blips, then exit 2 well
+    inside the deadline instead of burning the whole driver window."""
+    bench, _ = bench_mod
+    calls = {"n": 0}
+
+    def fake_run_rc(*a, **k):
+        calls["n"] += 1
+
+        class P:
+            returncode = 1
+            stderr = "accelerator init fell back to CPU"
+        return P()
+
+    slept = []
+    monkeypatch.setattr("subprocess.run", fake_run_rc)
+    monkeypatch.setattr(bench.time, "sleep", slept.append)
+    with pytest.raises(SystemExit) as e:
+        bench._probe_chip(timeout_s=1.0, deadline_s=3600.0,
+                          retry_wait_s=60.0, max_rc_failures=5)
+    assert e.value.code == 2
+    assert calls["n"] == 5              # bounded, not deadline-bound
+    assert len(slept) == 4
