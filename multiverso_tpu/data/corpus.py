@@ -9,6 +9,7 @@ backend (native C++ or Python fallback) is selected automatically.
 
 from __future__ import annotations
 
+import os
 from typing import Iterator, Optional, Tuple
 
 import numpy as np
@@ -22,6 +23,39 @@ def backend():
     """The active data backend: native if loadable, else Python."""
     native = load_native()
     return native if native is not None else PyData()
+
+
+_warned_auto_threads = False
+
+
+def default_gen_threads() -> int:
+    """Worker count for native pair generation: MVTPU_GEN_THREADS, else
+    the host's core count (the reference word2vec spawns one generator
+    per core the same way). On a 1-core host this resolves to 1 — the
+    threaded path costs nothing where it can't help.
+
+    Determinism scope: the pair stream is reproducible for a given
+    (seed, thread count). When the count is auto-resolved from the host,
+    identical seeds on hosts with different core counts produce
+    different (equally valid) streams — pin ``gen_threads=`` or
+    MVTPU_GEN_THREADS for cross-host bit-reproducibility. Auto-resolving
+    to >1 logs a one-time notice so the scoping is never silent."""
+    global _warned_auto_threads
+    env = os.environ.get("MVTPU_GEN_THREADS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    threads = max(1, os.cpu_count() or 1)
+    if threads > 1 and not _warned_auto_threads:
+        _warned_auto_threads = True
+        from multiverso_tpu.utils import log
+        log.info("pair generation auto-resolved to %d threads; the pair "
+                 "stream is (seed, threads)-scoped — pin gen_threads or "
+                 "MVTPU_GEN_THREADS for cross-host reproducibility",
+                 threads)
+    return threads
 
 
 class Corpus:
@@ -118,14 +152,22 @@ class Corpus:
     def skipgram_batches(self, batch_size: int, window: int = 5,
                          seed: int = 1, epochs: int = 1,
                          block_tokens: int = 1 << 20,
-                         prefetch: int = 2
+                         prefetch: int = 2,
+                         gen_threads: Optional[int] = None
                          ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-        """Yield fixed-size (centers, contexts) int32 batches."""
+        """Yield fixed-size (centers, contexts) int32 batches.
+
+        ``gen_threads=None`` resolves via :func:`default_gen_threads`
+        (MVTPU_GEN_THREADS, else core count); >1 uses the native
+        multi-threaded fill per block."""
         be = backend()
         kp = self.keep_prob()
+        threads = default_gen_threads() if gen_threads is None \
+            else max(1, gen_threads)
 
         def examples(block, salt):
-            return be.skipgram_pairs(block, window, kp, seed=seed + salt)
+            return be.skipgram_pairs(block, window, kp, seed=seed + salt,
+                                     threads=threads)
 
         return self._block_batches(examples, batch_size, epochs,
                                    block_tokens, prefetch)
@@ -133,7 +175,8 @@ class Corpus:
     def cbow_batches(self, batch_size: int, window: int = 5,
                      seed: int = 1, epochs: int = 1,
                      block_tokens: int = 1 << 20, prefetch: int = 2,
-                     pad_id: Optional[int] = None
+                     pad_id: Optional[int] = None,
+                     gen_threads: Optional[int] = None
                      ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         """Yield fixed-size (contexts [B, 2w], targets [B]) int32 batches.
 
@@ -144,9 +187,12 @@ class Corpus:
         """
         be = backend()
         kp = self.keep_prob()
+        threads = default_gen_threads() if gen_threads is None \
+            else max(1, gen_threads)
 
         def examples(block, salt):
-            ctx, tgt = be.cbow_examples(block, window, kp, seed=seed + salt)
+            ctx, tgt = be.cbow_examples(block, window, kp,
+                                        seed=seed + salt, threads=threads)
             if pad_id is not None:
                 ctx = np.where(ctx < 0, pad_id, ctx)
             return ctx, tgt

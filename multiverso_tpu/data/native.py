@@ -24,7 +24,14 @@ import numpy as np
 
 from multiverso_tpu.utils import log
 
-ABI_VERSION = 4
+ABI_VERSION = 5
+
+# Per-chunk seed step of the multi-threaded generators (mirrors
+# chunk_seed() in native/mvtpu_data.cpp): chunk t of a threads=T call is
+# bit-identical to the single-thread call on that chunk with seed
+# ``(seed + t * CHUNK_SEED_STEP) % 2**64`` — the oracle the parity tests
+# use.
+CHUNK_SEED_STEP = 0x9E3779B97F4A7C15
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -75,6 +82,18 @@ class NativeData:
         lib.mv_cbow_examples.argtypes = [
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
             ctypes.POINTER(ctypes.c_float), ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64]
+        lib.mv_skipgram_pairs_mt.restype = ctypes.c_int64
+        lib.mv_skipgram_pairs_mt.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_uint64, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64]
+        lib.mv_cbow_examples_mt.restype = ctypes.c_int64
+        lib.mv_cbow_examples_mt.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_uint64, ctypes.c_int32,
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
             ctypes.c_int64]
         lib.mv_lda_read_docs.restype = ctypes.c_int64
@@ -132,31 +151,40 @@ class NativeData:
 
     def skipgram_pairs(self, ids: np.ndarray, window: int,
                        keep_prob: Optional[np.ndarray], seed: int,
-                       cap: Optional[int] = None
+                       cap: Optional[int] = None, threads: int = 1
                        ) -> Tuple[np.ndarray, np.ndarray]:
+        """``threads > 1`` uses the native multi-threaded fill (chunked
+        generation, the reference word2vec's worker-partitioning shape);
+        the ctypes call releases the GIL so the workers get real cores.
+        With threads > 1 the default cap grows by the per-chunk slack
+        the mt path needs to run chunked instead of falling back."""
         ids = np.ascontiguousarray(ids, np.int32)
         if cap is None:
-            cap = 2 * window * len(ids) + 16
+            cap = 2 * window * len(ids) + 16 * max(threads, 1)
         centers = np.empty(cap, np.int32)
         contexts = np.empty(cap, np.int32)
         kp = None
         if keep_prob is not None:
             keep_prob = np.ascontiguousarray(keep_prob, np.float32)
             kp = keep_prob.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
-        n = self._lib.mv_skipgram_pairs(
-            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(ids),
-            window, kp, seed,
-            centers.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            contexts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), cap)
+        ids_p = ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        c_p = centers.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        x_p = contexts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        if threads > 1:
+            n = self._lib.mv_skipgram_pairs_mt(
+                ids_p, len(ids), window, kp, seed, threads, c_p, x_p, cap)
+        else:
+            n = self._lib.mv_skipgram_pairs(
+                ids_p, len(ids), window, kp, seed, c_p, x_p, cap)
         return centers[:n].copy(), contexts[:n].copy()
 
     def cbow_examples(self, ids: np.ndarray, window: int,
                       keep_prob: Optional[np.ndarray], seed: int,
-                      cap: Optional[int] = None
+                      cap: Optional[int] = None, threads: int = 1
                       ) -> Tuple[np.ndarray, np.ndarray]:
         ids = np.ascontiguousarray(ids, np.int32)
         if cap is None:
-            cap = len(ids) + 16
+            cap = len(ids) + 16 * max(threads, 1)
         width = 2 * window
         contexts = np.empty((cap, width), np.int32)
         targets = np.empty(cap, np.int32)
@@ -164,11 +192,16 @@ class NativeData:
         if keep_prob is not None:
             keep_prob = np.ascontiguousarray(keep_prob, np.float32)
             kp = keep_prob.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
-        n = self._lib.mv_cbow_examples(
-            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(ids),
-            window, kp, seed,
-            contexts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            targets.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), cap)
+        ids_p = ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        ctx_p = contexts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        tgt_p = targets.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        if threads > 1:
+            n = self._lib.mv_cbow_examples_mt(
+                ids_p, len(ids), window, kp, seed, threads, ctx_p, tgt_p,
+                cap)
+        else:
+            n = self._lib.mv_cbow_examples(
+                ids_p, len(ids), window, kp, seed, ctx_p, tgt_p, cap)
         return contexts[:n].copy(), targets[:n].copy()
 
     # -- LDA ---------------------------------------------------------------

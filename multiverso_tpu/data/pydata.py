@@ -82,8 +82,11 @@ class PyData:
 
     def skipgram_pairs(self, ids: np.ndarray, window: int,
                        keep_prob: Optional[np.ndarray], seed: int,
-                       cap: Optional[int] = None
+                       cap: Optional[int] = None, threads: int = 1
                        ) -> Tuple[np.ndarray, np.ndarray]:
+        # `threads` accepted for backend-interface parity; the Python
+        # fallback is GIL-bound, so it always generates single-threaded
+        del threads
         rng = np.random.default_rng(seed)
         ids = np.asarray(ids, np.int32)
         if keep_prob is not None:
@@ -112,8 +115,9 @@ class PyData:
 
     def cbow_examples(self, ids: np.ndarray, window: int,
                       keep_prob: Optional[np.ndarray], seed: int,
-                      cap: Optional[int] = None
+                      cap: Optional[int] = None, threads: int = 1
                       ) -> Tuple[np.ndarray, np.ndarray]:
+        del threads                       # see skipgram_pairs
         rng = np.random.default_rng(seed)
         ids = np.asarray(ids, np.int32)
         if keep_prob is not None:

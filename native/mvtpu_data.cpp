@@ -24,6 +24,7 @@
 #include <mutex>
 #include <random>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -250,14 +251,15 @@ int32_t mv_huffman_build(const int64_t* counts, int32_t vocab,
 // Skip-gram / CBOW pair generation with word2vec subsampling.
 // ---------------------------------------------------------------------------
 
-// Generate skip-gram (center, context) pairs from ids[start, start+n):
-// dynamic window b = 1 + rand % window, subsampling by keep_prob[id]
-// (caller computes 1.0 = keep always). Fills out arrays up to cap pairs;
-// returns the number generated. Deterministic for a given seed.
-int64_t mv_skipgram_pairs(const int32_t* ids, int64_t n, int32_t window,
-                          const float* keep_prob, uint64_t seed,
-                          int32_t* out_center, int32_t* out_context,
-                          int64_t cap) {
+// Shared fill core for the single-thread entry point and each worker of
+// the multi-threaded one (identical rng consumption order, so a chunk
+// generated by a worker is bit-identical to mv_skipgram_pairs called on
+// that chunk with the worker's derived seed — the property the Python
+// parity tests pin).
+static int64_t skipgram_fill(const int32_t* ids, int64_t n, int32_t window,
+                             const float* keep_prob, uint64_t seed,
+                             int32_t* out_center, int32_t* out_context,
+                             int64_t cap) {
   std::mt19937_64 rng(seed);
   std::uniform_real_distribution<float> uni(0.0f, 1.0f);
   // subsample pass
@@ -281,9 +283,87 @@ int64_t mv_skipgram_pairs(const int32_t* ids, int64_t n, int32_t window,
   return out;
 }
 
-// CBOW variant: for each kept position, emit (context_bag[2*window],
-// target). Context bag padded with -1. Returns number of examples.
-int64_t mv_cbow_examples(const int32_t* ids, int64_t n, int32_t window,
+// Generate skip-gram (center, context) pairs from ids[start, start+n):
+// dynamic window b = 1 + rand % window, subsampling by keep_prob[id]
+// (caller computes 1.0 = keep always). Fills out arrays up to cap pairs;
+// returns the number generated. Deterministic for a given seed.
+int64_t mv_skipgram_pairs(const int32_t* ids, int64_t n, int32_t window,
+                          const float* keep_prob, uint64_t seed,
+                          int32_t* out_center, int32_t* out_context,
+                          int64_t cap) {
+  return skipgram_fill(ids, n, window, keep_prob, seed, out_center,
+                       out_context, cap);
+}
+
+// Per-chunk seed for the multi-threaded generators: thread 0 keeps the
+// caller's seed; later chunks step by the golden-ratio increment.
+// Exposed to Python (data/native.py mirrors it) so tests can oracle a
+// worker's chunk against the single-thread entry point.
+static inline uint64_t chunk_seed(uint64_t seed, int32_t t) {
+  return seed + (uint64_t)t * 0x9E3779B97F4A7C15ULL;
+}
+
+// Multi-threaded skip-gram fill: splits [0, n) into n_threads contiguous
+// chunks, each generated independently (subsample + dynamic windows stay
+// WITHIN the chunk — the reference word2vec partitions the corpus across
+// worker threads at arbitrary boundaries the same way, losing only
+// O(threads * window) cross-boundary pairs out of ~2*window*n). Output
+// is the in-order concatenation of the per-chunk outputs; deterministic
+// for a given (seed, n_threads). Falls back to the single-thread fill
+// when cap cannot hold every chunk's worst case (keeps the cap contract
+// exact without inter-thread coordination).
+int64_t mv_skipgram_pairs_mt(const int32_t* ids, int64_t n, int32_t window,
+                             const float* keep_prob, uint64_t seed,
+                             int32_t n_threads, int32_t* out_center,
+                             int32_t* out_context, int64_t cap) {
+  if (n_threads > n) n_threads = n > 0 ? (int32_t)n : 1;
+  if (n_threads <= 1)
+    return skipgram_fill(ids, n, window, keep_prob, seed, out_center,
+                         out_context, cap);
+  // per-chunk slice bounds in the output buffers (worst case per chunk)
+  std::vector<int64_t> begin(n_threads), len(n_threads), slice(n_threads);
+  int64_t need = 0;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    begin[t] = n * t / n_threads;
+    len[t] = n * (t + 1) / n_threads - begin[t];
+    slice[t] = 2 * (int64_t)window * len[t] + 16;
+    need += slice[t];
+  }
+  if (need > cap)
+    return skipgram_fill(ids, n, window, keep_prob, seed, out_center,
+                         out_context, cap);
+  std::vector<int64_t> produced(n_threads, 0);
+  std::vector<std::thread> workers;
+  workers.reserve(n_threads);
+  int64_t off = 0;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    workers.emplace_back(
+        [&, t, off] {
+          produced[t] = skipgram_fill(ids + begin[t], len[t], window,
+                                      keep_prob, chunk_seed(seed, t),
+                                      out_center + off, out_context + off,
+                                      slice[t]);
+        });
+    off += slice[t];
+  }
+  for (auto& w : workers) w.join();
+  // compact the per-chunk runs left over the slice gaps (memmove: the
+  // destination can overlap the source run's slice)
+  int64_t total = produced[0];
+  off = slice[0];
+  for (int32_t t = 1; t < n_threads; ++t) {
+    std::memmove(out_center + total, out_center + off,
+                 produced[t] * sizeof(int32_t));
+    std::memmove(out_context + total, out_context + off,
+                 produced[t] * sizeof(int32_t));
+    total += produced[t];
+    off += slice[t];
+  }
+  return total;
+}
+
+// Shared CBOW fill core (same single-thread/worker split as skip-gram).
+static int64_t cbow_fill(const int32_t* ids, int64_t n, int32_t window,
                          const float* keep_prob, uint64_t seed,
                          int32_t* out_context, int32_t* out_target,
                          int64_t cap) {
@@ -313,6 +393,66 @@ int64_t mv_cbow_examples(const int32_t* ids, int64_t n, int32_t window,
     ++out;
   }
   return out;
+}
+
+// CBOW variant: for each kept position, emit (context_bag[2*window],
+// target). Context bag padded with -1. Returns number of examples.
+int64_t mv_cbow_examples(const int32_t* ids, int64_t n, int32_t window,
+                         const float* keep_prob, uint64_t seed,
+                         int32_t* out_context, int32_t* out_target,
+                         int64_t cap) {
+  return cbow_fill(ids, n, window, keep_prob, seed, out_context,
+                   out_target, cap);
+}
+
+// Multi-threaded CBOW fill (same chunking/seeding/compaction contract as
+// mv_skipgram_pairs_mt; context rows are width=2*window each).
+int64_t mv_cbow_examples_mt(const int32_t* ids, int64_t n, int32_t window,
+                            const float* keep_prob, uint64_t seed,
+                            int32_t n_threads, int32_t* out_context,
+                            int32_t* out_target, int64_t cap) {
+  if (n_threads > n) n_threads = n > 0 ? (int32_t)n : 1;
+  if (n_threads <= 1)
+    return cbow_fill(ids, n, window, keep_prob, seed, out_context,
+                     out_target, cap);
+  int32_t width = 2 * window;
+  std::vector<int64_t> begin(n_threads), len(n_threads), slice(n_threads);
+  int64_t need = 0;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    begin[t] = n * t / n_threads;
+    len[t] = n * (t + 1) / n_threads - begin[t];
+    slice[t] = len[t] + 16;        // <=1 example per kept position
+    need += slice[t];
+  }
+  if (need > cap)
+    return cbow_fill(ids, n, window, keep_prob, seed, out_context,
+                     out_target, cap);
+  std::vector<int64_t> produced(n_threads, 0);
+  std::vector<std::thread> workers;
+  workers.reserve(n_threads);
+  int64_t off = 0;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    workers.emplace_back(
+        [&, t, off] {
+          produced[t] = cbow_fill(ids + begin[t], len[t], window,
+                                  keep_prob, chunk_seed(seed, t),
+                                  out_context + off * width,
+                                  out_target + off, slice[t]);
+        });
+    off += slice[t];
+  }
+  for (auto& w : workers) w.join();
+  int64_t total = produced[0];
+  off = slice[0];
+  for (int32_t t = 1; t < n_threads; ++t) {
+    std::memmove(out_context + total * width, out_context + off * width,
+                 produced[t] * (int64_t)width * sizeof(int32_t));
+    std::memmove(out_target + total, out_target + off,
+                 produced[t] * sizeof(int32_t));
+    total += produced[t];
+    off += slice[t];
+  }
+  return total;
 }
 
 // ---------------------------------------------------------------------------
@@ -395,6 +535,6 @@ int64_t mv_lda_read_docs(const char* path, int64_t* out_num_docs,
 // Version stamp (lets Python detect a stale .so).
 // ---------------------------------------------------------------------------
 
-int32_t mv_data_abi_version() { return 4; }
+int32_t mv_data_abi_version() { return 5; }
 
 }  // extern "C"

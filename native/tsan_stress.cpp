@@ -34,6 +34,14 @@ int64_t mv_skipgram_pairs(const int32_t* ids, int64_t n, int32_t window,
 int64_t mv_cbow_examples(const int32_t* ids, int64_t n, int32_t window,
                          const float* keep_prob, uint64_t seed,
                          int32_t* ctx, int32_t* tgt, int64_t cap);
+int64_t mv_skipgram_pairs_mt(const int32_t* ids, int64_t n, int32_t window,
+                             const float* keep_prob, uint64_t seed,
+                             int32_t n_threads, int32_t* src, int32_t* tgt,
+                             int64_t cap);
+int64_t mv_cbow_examples_mt(const int32_t* ids, int64_t n, int32_t window,
+                            const float* keep_prob, uint64_t seed,
+                            int32_t n_threads, int32_t* ctx, int32_t* tgt,
+                            int64_t cap);
 int32_t mv_data_abi_version();
 }
 
@@ -71,6 +79,24 @@ int main() {
         pairs += mv_cbow_examples(ids.data(), n, 5, nullptr,
                                   2000 * t + it, ctx.data(), tgt.data(),
                                   1 << 13);
+      }
+    });
+  }
+  // multi-threaded fill under concurrent callers: the .so's own worker
+  // threads (fill + compaction) racing with everything above, and with a
+  // second mt caller (full cap so the mt path, not the fallback, runs)
+  for (int t = 0; t < 2; t++) {
+    threads.emplace_back([&, t] {
+      int64_t cap = 2 * 5 * n + 16 * 8;
+      std::vector<int32_t> src(cap), tgt(cap);
+      std::vector<int32_t> ctx((n + 16 * 8) * 10), ctgt(n + 16 * 8);
+      for (int it = 0; it < 10; it++) {
+        pairs += mv_skipgram_pairs_mt(ids.data(), n, 5, nullptr,
+                                      3000 * t + it, 3, src.data(),
+                                      tgt.data(), cap);
+        pairs += mv_cbow_examples_mt(ids.data(), n, 5, nullptr,
+                                     4000 * t + it, 3, ctx.data(),
+                                     ctgt.data(), n + 16 * 8);
       }
     });
   }
