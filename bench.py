@@ -64,6 +64,8 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, HERE)
 
 BASELINE_PATH = os.path.join(HERE, "benchmarks", "baseline_cpu.json")
+sys.path.insert(0, os.path.join(HERE, "benchmarks"))
+import roofline  # noqa: E402  (the achieved-vs-chip accounting model)
 
 VOCAB = 10_000
 TOKENS = 1_000_000
@@ -102,7 +104,6 @@ def measure_lda_tier() -> dict:
     window. The mean and spread ride along so the dispersion is on the
     record.
     """
-    sys.path.insert(0, os.path.join(HERE, "benchmarks"))
     import measure_lda
 
     try:
@@ -128,6 +129,10 @@ def measure_lda_tier() -> dict:
         "lda_mean_doc_tokens_per_sec": round(tpu["doc_tokens_per_sec"], 1),
         "lda_spread_pct": tpu["spread_pct"],
         "lda_baseline_cpu_doc_tokens_per_sec": cpu["doc_tokens_per_sec"],
+        # achieved-vs-chip accounting (benchmarks/roofline.py model)
+        "lda_roofline": roofline.lda_utilization(
+            best, measure_lda.K_TPU, measure_lda.V, measure_lda.T,
+            tpu.get("block_tokens") or 512),
     }
 
 
@@ -382,6 +387,9 @@ def main() -> None:
         "gen_words_per_sec": round(gen_words_per_sec, 1),
         "e2e_words_per_sec": round(e2e_words, 1),
         "e2e_vs_baseline": round(e2e_words / baseline, 3),
+        # achieved-vs-chip accounting (benchmarks/roofline.py model)
+        "w2v_roofline": roofline.w2v_utilization(
+            pairs_per_sec / max(n_chips, 1), DIM, NEGATIVE),
     }
     # print the w2v capture BEFORE attempting the LDA tier: the driver
     # records the LAST complete JSON line, so if the tunnel wedges

@@ -243,10 +243,14 @@ if __name__ == "__main__":
     cpu = pinned_cpu()
     spc = int(sys.argv[2]) if len(sys.argv) > 2 else 1
     tpu = measure_tpu(sampler_arg, steps_per_call=spc)
+    import roofline
     result = {
         "metric": "LightLDA doc-tokens/sec",
         "cpu_worker": cpu,
         "tpu_chip": tpu,
+        "roofline": roofline.lda_utilization(
+            max(tpu["runs_tok_per_sec"]), K_TPU, V, T,
+            tpu.get("block_tokens") or 512),
         "vs_baseline": tpu["doc_tokens_per_sec"] / cpu["doc_tokens_per_sec"],
         "workload": {"vocab": V, "docs": D, "tokens": T},
         "notes": "TPU runs K=1024 (more work) vs CPU K=1000; TPU sampler "

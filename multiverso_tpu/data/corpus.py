@@ -46,7 +46,9 @@ def default_gen_threads() -> int:
         try:
             return max(1, int(env))
         except ValueError:
-            pass
+            from multiverso_tpu.utils import log
+            log.warn("ignoring malformed MVTPU_GEN_THREADS=%r; "
+                     "auto-resolving from the core count", env)
     threads = max(1, os.cpu_count() or 1)
     if threads > 1 and not _warned_auto_threads:
         _warned_auto_threads = True
@@ -124,6 +126,18 @@ class Corpus:
 
     # -- batch iterators ---------------------------------------------------
 
+    @staticmethod
+    def _resolve_gen_threads(be, gen_threads: Optional[int]) -> int:
+        """Thread count for the block pipeline. The Python fallback is
+        GIL-bound and ignores threads — resolve to 1 there so the
+        (seed, threads) determinism notice is never logged for a
+        backend whose stream doesn't vary with thread count."""
+        if isinstance(be, PyData):
+            return 1
+        if gen_threads is not None:
+            return max(1, gen_threads)
+        return default_gen_threads()
+
     def _block_batches(self, example_fn, batch_size: int, epochs: int,
                        block_tokens: int, prefetch: int
                        ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
@@ -162,8 +176,7 @@ class Corpus:
         multi-threaded fill per block."""
         be = backend()
         kp = self.keep_prob()
-        threads = default_gen_threads() if gen_threads is None \
-            else max(1, gen_threads)
+        threads = self._resolve_gen_threads(be, gen_threads)
 
         def examples(block, salt):
             return be.skipgram_pairs(block, window, kp, seed=seed + salt,
@@ -187,8 +200,7 @@ class Corpus:
         """
         be = backend()
         kp = self.keep_prob()
-        threads = default_gen_threads() if gen_threads is None \
-            else max(1, gen_threads)
+        threads = self._resolve_gen_threads(be, gen_threads)
 
         def examples(block, salt):
             ctx, tgt = be.cbow_examples(block, window, kp,

@@ -51,6 +51,12 @@ def test_lda_tier_reports_best_sweep_and_protocol(bench_mod, monkeypatch):
     assert out["lda_spread_pct"] == 18.8
     assert out["lda_vs_baseline"] == round(
         19.7e6 / out["lda_baseline_cpu_doc_tokens_per_sec"], 3)
+    # achieved-vs-chip accounting rides the same line, computed from the
+    # BEST sweep (and the stub lacks block_tokens -> the 512 default)
+    rl = out["lda_roofline"]
+    assert rl["achieved_hbm_gbps"] == pytest.approx(
+        19.7e6 * rl["model_hbm_bytes_per_token"] / 1e9, rel=1e-3)
+    assert rl["hbm_peak_gbps"] == 819.0
 
 
 def test_lda_tier_rejects_stale_workload_baseline(bench_mod, monkeypatch,
@@ -154,6 +160,35 @@ def test_zipf_corpus_cache_guards(bench_mod, tmp_path):
                                               cache_path=cache)
     assert len(tw4) == 2000 and int(tw4.max()) < 700
     assert not np.array_equal(tw4, tw)           # different vocab draw
+
+
+def test_roofline_models():
+    """The utilization arithmetic is chip-independent: pin the model
+    terms and the achieved/peak division at known rates."""
+    import sys as _sys
+    import os as _os
+    _sys.path.insert(0, _os.path.join(_os.path.dirname(__file__), "..",
+                                      "benchmarks"))
+    import roofline
+
+    w = roofline.w2v_utilization(10e6, dim=100, negative=5)
+    assert w["model_flops_per_pair"] == 6 * 6 * 100
+    assert w["model_hbm_bytes_per_pair"] == 3 * 7 * 4 * 100
+    assert w["achieved_tflops"] == pytest.approx(10e6 * 3600 / 1e12)
+    assert 0 < w["mxu_util_pct"] < 1          # w2v is NOT MXU-bound
+    assert w["hbm_util_pct"] == pytest.approx(
+        100 * 10e6 * 8400 / 1e9 / roofline.HBM_PEAK_GBPS, abs=0.02)
+
+    li = roofline.lda_utilization(19.6e6, num_topics=1024, vocab=50_000,
+                                  tokens=10_000_000, block_tokens=512)
+    # the dominant term is the 2KB bf16 word-row gather
+    assert li["model_hbm_bytes_per_token"] == pytest.approx(
+        2048 + 8 + 8 + 64 * 1024 / 512 + 6 * 50_000 * 1024 / 10e6,
+        rel=1e-3)
+    assert li["w_gather_gbps"] == pytest.approx(19.6e6 * 2048 / 1e9,
+                                                rel=1e-3)
+    # scored against the measured random-gather ceiling, not just peak
+    assert li["gather_ceiling_util_pct"] > li["hbm_util_pct"]
 
 
 def test_probe_chip_gives_up_at_deadline(bench_mod, monkeypatch):
