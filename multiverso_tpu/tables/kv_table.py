@@ -385,6 +385,7 @@ class KVTable:
             raise ValueError(
                 f"checkpoint updater {manifest['updater']!r} != "
                 f"{self.updater.name!r}")
+        new_buckets = self.num_buckets
         if manifest["num_buckets"] != self.num_buckets \
                 or manifest["slots"] != self.slots:
             # mesh-portable restore: num_buckets is padded to the mesh
@@ -392,7 +393,7 @@ class KVTable:
             # mp=2 has a different geometry than an mp=1/4 table.  Dense
             # tables repad (base.py); here the live triples are rehashed
             # into the current geometry instead.
-            host_keys, host_vals, host_state = \
+            new_buckets, host_keys, host_vals, host_state = \
                 self._rehash_checkpoint(manifest, data)
             state_src = {f"state_{i}": leaf
                          for i, leaf in enumerate(host_state)}
@@ -400,13 +401,28 @@ class KVTable:
             host_keys = data["keys"]
             host_vals = data["values"]
             state_src = data
-        self.keys = jax.device_put(host_keys, self._key_sharding)
-        self.values = jax.device_put(host_vals.astype(self.dtype),
-                                     self._val_sharding)
-        self.state = unpack_state(
+        keys_dev = jax.device_put(host_keys, self._key_sharding)
+        vals_dev = jax.device_put(host_vals.astype(self.dtype),
+                                  self._val_sharding)
+        state_dev = unpack_state(
             state_src, manifest["n_state_leaves"], self.state,
             lambda leaf, tmpl: jax.device_put(leaf.astype(tmpl.dtype),
                                               self._val_sharding))
+        # commit only after every new array placed: an exception above
+        # (missing state leaf, placement failure) must leave the live
+        # table consistent — geometry fields changing ahead of the
+        # arrays would make get()/add() silently address wrong slots
+        self.keys, self.values, self.state = keys_dev, vals_dev, state_dev
+        if new_buckets != self.num_buckets:
+            log.warn(
+                "kv table %r: rehash from %dx%d into %dx%d overflowed a "
+                "bucket; geometry auto-grown to %dx%d (capacity %d -> "
+                "%d) so the restore succeeds",
+                self.name, manifest["num_buckets"], manifest["slots"],
+                self.num_buckets, self.slots, new_buckets, self.slots,
+                self.capacity, new_buckets * self.slots)
+            self.num_buckets = new_buckets
+            self.capacity = new_buckets * self.slots
         # slot assignment is device-derived: nothing host-side to rebuild
         self.default_option.step = int(manifest.get("step", 0))
         # load replaces live state: outstanding add-handles read superseded
@@ -421,29 +437,47 @@ class KVTable:
         needs data-dependent bucket occupancy that a fixed-shape device
         program handles worse than numpy.  Lane order within a bucket is
         the checkpoint's bucket-major traversal order — deterministic,
-        and lookup/probe semantics don't depend on lane order."""
+        and lookup/probe semantics don't depend on lane order.
+
+        If a bucket of the requested geometry would overflow (restores
+        into a smaller mesh/geometry concentrate keys), the bucket count
+        DOUBLES until every key fits — restores succeed with a larger
+        table instead of failing (runtime probes stay one-bucket; a
+        spill-to-second-choice design would tax every get/add instead of
+        this cold path).  Doubling preserves the model-axis shard
+        divisibility established at construction.  Returns the chosen
+        bucket count WITHOUT mutating the table — load() commits the
+        geometry only after the new arrays are safely placed on device,
+        so a failure mid-restore can't leave geometry fields ahead of
+        the arrays."""
         ck_keys = data["keys"]                        # [B0, S0, 2] u32
         live = ~(ck_keys == np.uint32(0xFFFFFFFF)).all(-1)
         bb, ss = np.nonzero(live)
         k2 = ck_keys[bb, ss]                          # [n, 2]
-        buckets = self._buckets_of(_join_keys(k2))
+        hashes = _hash_u64(_join_keys(k2))
+        n = len(hashes)
+        nb = self.num_buckets
+        # occupancy-only check per doubling (O(n)); the full lane
+        # assignment runs once, for the geometry that fits
+        while n and np.bincount(
+                (hashes % np.uint64(nb)).astype(np.int64),
+                minlength=nb).max() > self.slots:
+            if nb >= 2 ** 30:
+                raise ValueError(
+                    f"kv table {self.name!r}: rehash from "
+                    f"{manifest['num_buckets']}x{manifest['slots']} "
+                    f"cannot fit every bucket even at {nb} buckets — "
+                    "pathological key collisions")
+            nb *= 2
+        buckets = (hashes % np.uint64(nb)).astype(np.int32)
         order = np.argsort(buckets, kind="stable")
         sb = buckets[order]
-        n = len(sb)
         # lane = rank within each bucket run of the sorted order
         pos = np.arange(n)
         run_start = np.concatenate([[True], sb[1:] != sb[:-1]]) \
             if n else np.zeros(0, bool)
         lane = pos - np.maximum.accumulate(np.where(run_start, pos, 0))
-        if n and lane.max() >= self.slots:
-            crowded = sb[lane >= self.slots][0]
-            raise ValueError(
-                f"kv table {self.name!r}: rehash from "
-                f"{manifest['num_buckets']}x{manifest['slots']} to "
-                f"{self.num_buckets}x{self.slots} overflows bucket "
-                f"{int(crowded)} (> {self.slots} keys); use a table with "
-                f"more slots_per_bucket or larger capacity")
-        kv_shape = (self.num_buckets, self.slots)
+        kv_shape = (nb, self.slots)
         new_keys = np.full(kv_shape + (2,), 0xFFFFFFFF, np.uint32)
         new_keys[sb, lane] = k2[order]
 
@@ -456,4 +490,4 @@ class KVTable:
         new_vals = remap(data["values"], self.default_value)
         new_state = [remap(data[f"state_{i}"], 0)
                      for i in range(manifest["n_state_leaves"])]
-        return new_keys, new_vals, new_state
+        return nb, new_keys, new_vals, new_state

