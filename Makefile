@@ -4,15 +4,21 @@
 #   make test     - full suite on the 8-virtual-CPU-device mesh
 #   make dryrun   - multi-chip sharding compile/execute check (8 devices)
 #   make bench    - driver benchmark on the default devices (metric JSON lines; last line carries both metrics)
+#   make bench-dryrun - INTEGRATED bench pipeline at toy sizes on CPU
+#                   (~16s; runs with the chip tunnel down — integration
+#                   seams real, numbers meaningless)
 #   make native   - C++ data loader + baseline binaries
 #   make ci       - everything CI runs, in order
 
 PY ?= python
 
-.PHONY: test dryrun bench native ci
+.PHONY: test dryrun bench bench-dryrun native ci
 
 test:
 	$(PY) -m pytest tests/ -q
+
+bench-dryrun:
+	MVTPU_BENCH_TINY=1 $(PY) bench.py
 
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -24,4 +30,4 @@ bench:
 native:
 	$(MAKE) -C native
 
-ci: native test dryrun
+ci: native test dryrun bench-dryrun

@@ -67,19 +67,29 @@ BASELINE_PATH = os.path.join(HERE, "benchmarks", "baseline_cpu.json")
 sys.path.insert(0, os.path.join(HERE, "benchmarks"))
 import roofline  # noqa: E402  (the achieved-vs-chip accounting model)
 
-VOCAB = 10_000
-TOKENS = 1_000_000
+# MVTPU_BENCH_TINY=1: run the WHOLE integrated pipeline (probe -> w2v
+# tiers -> table reset/GC handoff -> LDA tier -> final JSON assembly)
+# at toy sizes, accepting a CPU backend. The numbers are meaningless;
+# the point is that every integration seam the driver capture will
+# cross executes long before the one shot on the real chip (VERDICT r4
+# weak #1: the integrated LDA tier had never run end-to-end).
+TINY = os.environ.get("MVTPU_BENCH_TINY", "").lower() \
+    not in ("", "0", "false", "no")
+
+VOCAB = 2_000 if TINY else 10_000
+TOKENS = 120_000 if TINY else 1_000_000
 DIM = 100
 WINDOW = 5
 NEGATIVE = 5
 SUBSAMPLE = 1e-3     # the reference default; both benches apply it
-BATCH = 4096
+BATCH = 256 if TINY else 4096
 # 512 steps/call amortizes the fixed per-dispatch cost (~15-45ms on the
 # tunneled chip; probe-measured — at 64 steps/call it was over HALF the
 # engine wall-clock). The prefetch pipeline batches to the same depth.
-STEPS_PER_CALL = 512
+STEPS_PER_CALL = 16 if TINY else 512
 WARMUP_CALLS = 2
-TIMED_CALLS = 8
+TIMED_CALLS = 2 if TINY else 8
+E2E_CALLS = 2 if TINY else 10
 LR = 0.01
 
 
@@ -222,13 +232,15 @@ def _probe_chip(timeout_s: float = 180.0, deadline_s: "float | None" = None,
     rc_failures = 0
     while True:
         attempt += 1
+        probe_src = (
+            "import jax, jax.numpy as jnp;"
+            + ("jax.config.update('jax_platforms', 'cpu');" if TINY else
+               "assert jax.default_backend() != 'cpu',"
+               " 'accelerator init fell back to CPU';")
+            + "print(float(jnp.ones(2).sum()))")
         try:
             proc = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax, jax.numpy as jnp;"
-                 "assert jax.default_backend() != 'cpu',"
-                 " 'accelerator init fell back to CPU';"
-                 "print(float(jnp.ones(2).sum()))"],
+                [sys.executable, "-c", probe_src],
                 timeout=timeout_s, capture_output=True, text=True)
             if proc.returncode == 0:
                 if attempt > 1:
@@ -267,6 +279,17 @@ def _probe_chip(timeout_s: float = 180.0, deadline_s: "float | None" = None,
 
 
 def main() -> None:
+    if TINY:
+        # integration dry-run: tiny workloads, CPU backend accepted,
+        # runnable while the tunnel is wedged (the in-code platform pin
+        # is required — sitecustomize ignores JAX_PLATFORMS)
+        os.environ.setdefault("MVTPU_LDA_V", "2000")
+        os.environ.setdefault("MVTPU_LDA_D", "1000")
+        os.environ.setdefault("MVTPU_LDA_T", "102400")
+        os.environ.setdefault("MVTPU_LDA_K_CPU", "128")
+        os.environ.setdefault("MVTPU_LDA_K_TPU", "128")
+        import jax as _jax
+        _jax.config.update("jax_platforms", "cpu")
     _probe_chip()
     import jax
     from multiverso_tpu import core
@@ -348,7 +371,7 @@ def main() -> None:
     # One warmup call first: train() places lr arrays with the mesh
     # sharding (unlike the pre-staged engine loop above), which is a
     # separate jit cache entry — compile must stay out of the timing.
-    e2e_calls = 10
+    e2e_calls = E2E_CALLS
     app.train(total_steps=STEPS_PER_CALL)
     e2e_words, e2e_dt = 0.0, float("inf")
     for _ in range(3):          # best of 3 (same tunnel-noise rationale
@@ -379,6 +402,9 @@ def main() -> None:
 
     w2v_line = {
         "metric": "w2v_words_per_sec_per_chip",
+        # a stray MVTPU_BENCH_TINY in the driver env must be
+        # self-identifying in the capture, not a silent toy number
+        **({"bench_tiny": True} if TINY else {}),
         "value": round(per_chip, 1),
         "unit": "words/s",
         "vs_baseline": round(per_chip / baseline, 3),

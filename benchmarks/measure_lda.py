@@ -41,8 +41,24 @@ REPO = os.path.dirname(HERE)
 OUT = os.path.join(HERE, "lda_results.json")
 sys.path.insert(0, REPO)
 
-V, D, T, K_CPU, K_TPU = 50_000, 100_000, 10_000_000, 1000, 1024
-BATCH = 500_000
+def _env_int(name: str, default: int) -> int:
+    """Workload-constant override hook: bench.py's MVTPU_BENCH_TINY mode
+    shrinks the workload so the INTEGRATED pipeline can be exercised on
+    a CPU backend (the baseline workload-match guards key off the same
+    constants, so a tiny run can never be scored against the pinned
+    full-size CPU artifact)."""
+    try:
+        return int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+V = _env_int("MVTPU_LDA_V", 50_000)
+D = _env_int("MVTPU_LDA_D", 100_000)
+T = _env_int("MVTPU_LDA_T", 10_000_000)
+K_CPU = _env_int("MVTPU_LDA_K_CPU", 1000)
+K_TPU = _env_int("MVTPU_LDA_K_TPU", 1024)
+BATCH = _env_int("MVTPU_LDA_BATCH", 500_000)
 
 
 def measure_cpu(sweeps: int = 2, curve: bool = False) -> dict:
@@ -112,10 +128,12 @@ def _tpu_app(sampler: str, steps_per_call: int = 1):
         cache_path=os.path.join("/tmp", f"mvtpu_lda_bench_{V}_{D}_{T}_s0"))
     core.init()
     tiled = sampler == "tiled"
+    # doc-blocked batches must be a block_tokens (512) multiple; scale
+    # down with tiny workloads (T < the production 512k call size)
+    tiled_batch = min(512_000, max(512, (T // 4) // 512 * 512))
     return LightLDA(tw, td, V, LDAConfig(
         num_topics=K_TPU,
-        # doc-blocked batches must be a block_tokens multiple
-        batch_tokens=512_000 if tiled else BATCH,
+        batch_tokens=tiled_batch if tiled else min(BATCH, T),
         # steps_per_call=1 measured fastest on a quiet tunnel (19.6M
         # tok/s; 4 and 10 were 15.7/14.3M) — but when the tunnel's
         # per-dispatch cost degrades, more steps/call amortizes it

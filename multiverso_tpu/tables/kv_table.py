@@ -457,11 +457,13 @@ class KVTable:
         hashes = _hash_u64(_join_keys(k2))
         n = len(hashes)
         nb = self.num_buckets
-        # occupancy-only check per doubling (O(n)); the full lane
-        # assignment runs once, for the geometry that fits
-        while n and np.bincount(
-                (hashes % np.uint64(nb)).astype(np.int64),
-                minlength=nb).max() > self.slots:
+        # occupancy-only check per doubling — via unique, O(n) memory
+        # regardless of nb (a bincount(minlength=nb) would allocate
+        # gigabytes before the pathological-collision guard could
+        # fire); the full lane assignment runs once, for the geometry
+        # that fits
+        while n and np.unique(hashes % np.uint64(nb),
+                              return_counts=True)[1].max() > self.slots:
             if nb >= 2 ** 30:
                 raise ValueError(
                     f"kv table {self.name!r}: rehash from "

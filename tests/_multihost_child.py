@@ -154,7 +154,14 @@ def main() -> None:
 
     # the flagship doc-blocked LDA sampler across ALL processes: a
     # shard_map'd pallas kernel (interpret mode on CPU) with per-chip
-    # block ownership and psum'd summary deltas over the P-host mesh
+    # block ownership and psum'd summary deltas over the P-host mesh.
+    # Every LightLDA instance re-TRACES the interpret-mode kernel
+    # (~10s of uncacheable python work PER instance PER process on the
+    # 1-core CI host), so the P=4 pass keeps only the variants whose
+    # arithmetic actually varies with P (streamed z-slab sync,
+    # local_corpus ownership) and leans on the P=2 pass for the
+    # in-memory reference and the dp x mp replica-dedup variants
+    # (their logic does not depend on the data-axis process count).
     from jax.sharding import Mesh
     from multiverso_tpu.apps.lightlda import LDAConfig, LightLDA
     core.shutdown()
@@ -165,18 +172,19 @@ def main() -> None:
     n_tok = tb * n_dev * 2
     td_l = np.sort(rng.integers(0, 32, n_tok)).astype(np.int32)
     tw_l = rng.integers(0, 16, n_tok).astype(np.int32)
-    lda = LightLDA(tw_l, td_l, 16,
-                   LDAConfig(num_topics=128, batch_tokens=tb * n_dev,
-                             steps_per_call=2, seed=0, sampler="tiled",
-                             doc_blocked=True, block_tokens=tb,
-                             block_docs=16),
-                   name="mh_lda_db")
-    lda.sweep()
-    ll = lda.loglik()
-    assert np.isfinite(ll), ll
-    nwk = lda.word_topics()
-    assert nwk.sum() == lda.num_tokens, (nwk.sum(), lda.num_tokens)
-    z_ref = np.asarray(lda._z)
+    if full:
+        lda = LightLDA(tw_l, td_l, 16,
+                       LDAConfig(num_topics=128, batch_tokens=tb * n_dev,
+                                 steps_per_call=2, seed=0,
+                                 sampler="tiled", doc_blocked=True,
+                                 block_tokens=tb, block_docs=16),
+                       name="mh_lda_db")
+        lda.sweep()
+        ll = lda.loglik()
+        assert np.isfinite(ll), ll
+        nwk = lda.word_topics()
+        assert nwk.sum() == lda.num_tokens, (nwk.sum(), lda.num_tokens)
+        z_ref = np.asarray(lda._z)
 
     # OUT-OF-CORE streamed mode across all processes: process-local
     # staging (each host device_puts only its addressable lanes) and
@@ -191,11 +199,15 @@ def main() -> None:
                      name="mh_lda_dbs")
     lda_s.sweep()
     lda_s._sync_z_host()   # full-z consumers trigger this lazily
-    np.testing.assert_array_equal(lda_s._z_host, z_ref)
-    np.testing.assert_array_equal(lda_s.word_topics(), nwk)
-    np.testing.assert_array_equal(lda_s.doc_topics(), lda.doc_topics())
+    nwk_s = lda_s.word_topics()
+    assert nwk_s.sum() == lda_s.num_tokens
     assert np.isfinite(lda_s.loglik())
-    ref_dt = lda.doc_topics()
+    if full:
+        np.testing.assert_array_equal(lda_s._z_host, z_ref)
+        np.testing.assert_array_equal(nwk_s, nwk)
+        np.testing.assert_array_equal(lda_s.doc_topics(),
+                                      lda.doc_topics())
+        ref_dt = lda.doc_topics()
 
     # multi-process streamed store/load: store is collective (z sync +
     # chunked allgather); every rank writes the shared state path via
@@ -210,23 +222,27 @@ def main() -> None:
     lda_s.load(ck_s)
     np.testing.assert_array_equal(lda_s._z_host, z_before)
 
-    # and on a dp x mp mesh (P x 2): model-axis replica dedup in the z
-    # drain, per-replica staging, and the sync's uniform-ownership
-    # allgather all run with REAL replicas; still bit-identical
-    from multiverso_tpu.tables import base as table_base
-    table_base.reset_tables()
-    core.shutdown()
-    core.set_mesh(Mesh(np.array(jax.devices()).reshape(P, 2),
-                       ("data", "model")))
-    lda_m = LightLDA(tw_l, td_l, 16,
-                     LDAConfig(num_topics=128, batch_tokens=tb * n_dev,
-                               steps_per_call=2, seed=0, sampler="tiled",
-                               doc_blocked=True, block_tokens=tb,
-                               block_docs=16, stream_blocks=True),
-                     name="mh_lda_dbs_mp")
-    lda_m.sweep()
-    np.testing.assert_array_equal(lda_m.word_topics(), nwk)
-    np.testing.assert_array_equal(lda_m.doc_topics(), ref_dt)
+    if full:
+        # and on a dp x mp mesh (P x 2): model-axis replica dedup in
+        # the z drain, per-replica staging, and the sync's
+        # uniform-ownership allgather all run with REAL replicas;
+        # still bit-identical
+        from multiverso_tpu.tables import base as table_base
+        table_base.reset_tables()
+        core.shutdown()
+        core.set_mesh(Mesh(np.array(jax.devices()).reshape(P, 2),
+                           ("data", "model")))
+        lda_m = LightLDA(tw_l, td_l, 16,
+                         LDAConfig(num_topics=128,
+                                   batch_tokens=tb * n_dev,
+                                   steps_per_call=2, seed=0,
+                                   sampler="tiled", doc_blocked=True,
+                                   block_tokens=tb, block_docs=16,
+                                   stream_blocks=True),
+                         name="mh_lda_dbs_mp")
+        lda_m.sweep()
+        np.testing.assert_array_equal(lda_m.word_topics(), nwk)
+        np.testing.assert_array_equal(lda_m.doc_topics(), ref_dt)
 
     # PER-PROCESS corpus shards (local_corpus): each rank passes ONLY
     # its own docs (disjoint by doc-id mod P, global doc ids);

@@ -6,6 +6,14 @@ simulated XLA CPU devices forming a mesh in a single process.
 
 Must set the env vars before jax initialises its backends, hence the
 os.environ writes at import time (conftest imports before any test module).
+
+Wall-clock note (round 5, measured): the suite is CPU-BOUND on the
+1-core CI host (~460s quiet ≈ total CPU work), so pytest-xdist makes it
+SLOWER (621s at -n 3 vs ~470s serial: workers re-trace/re-compile every
+jit they run and split the in-process jit cache), and the persistent
+XLA cache recovers only ~8s (tracing, the dominant fixed cost, is not
+cacheable). Speedups must come from doing less work — e.g. the
+multihost child runs its P-invariant LDA variants at P=2 only.
 """
 
 import os
