@@ -96,6 +96,9 @@ class LDAConfig:
     steps_per_call: int = 16        # scan length
     num_iterations: int = 10        # full Gibbs sweeps
     eval_every: int = 1             # likelihood eval cadence (sweeps)
+    checkpoint_prefix: str = ""     # periodic mid-train checkpoints
+    checkpoint_interval: int = 0    # store every N sweeps (0 = off;
+    # SURVEY §6.4's flag-driven periodic dump trigger)
     sampler: str = "gibbs"          # "gibbs" (exact O(K)) | "mh" (O(1))
     #                               | "tiled" (pallas kernel, K%128==0)
     stale_words: bool = False       # tiled only: word counts gathered
@@ -292,6 +295,7 @@ class LightLDA:
             self._key = core.prng_key(c.seed, mesh=self.mesh)
             self._calls_done = 0
             self.ll_history = []
+            self._last_store = ()
             return
 
         ndk_shape = (self.num_docs + 1, self.K // 128, 128) if tiled \
@@ -382,6 +386,7 @@ class LightLDA:
         self._key = core.prng_key(c.seed, mesh=self.mesh)
         self._calls_done = 0
         self.ll_history: list = []
+        self._last_store = ()
 
     # -- doc-blocked stream / state ---------------------------------------
 
@@ -1556,8 +1561,14 @@ class LightLDA:
             else self.config.num_iterations
         every = max(self.config.eval_every, 1)
         t0 = time.perf_counter()
+        ck_every = self.config.checkpoint_interval
         for it in range(iters):
             self.sweep()
+            if ck_every > 0 and self.config.checkpoint_prefix \
+                    and (it + 1) % ck_every == 0:
+                # periodic full-state dump (sampler state included, so
+                # a crash resumes mid-training); collective
+                self.store(self.config.checkpoint_prefix)
             if (it + 1) % every and it != iters - 1:
                 continue
             ll = self.loglik()
@@ -1735,6 +1746,7 @@ class LightLDA:
         # like mem:// need their own copy); shared-path safety comes from
         # the stream layer's atomic rename
         savez_stream(state_path, manifest, {"z": z, "ndk": dense})
+        self._last_store = (uri_prefix, self._calls_done)
 
     def _local_shard_digest(self):
         """(crc32, local token count) identifying THIS rank's corpus
@@ -1864,6 +1876,9 @@ def main(argv=None) -> None:
     configure.define_string("sampler", "gibbs",
                             "gibbs | mh | tiled (K%128==0; TPU kernel)",
                             overwrite=True)
+    configure.define_int("checkpoint_interval", 0,
+                         "store -output_file every N sweeps (0 = only "
+                         "at end)", overwrite=True)
     core.init(argv)
     path = configure.get_flag("input_file")
     if not path:
@@ -1878,11 +1893,16 @@ def main(argv=None) -> None:
         num_iterations=configure.get_flag("num_iterations"),
         eval_every=configure.get_flag("eval_every"),
         sampler=configure.get_flag("sampler"),
+        checkpoint_prefix=configure.get_flag("output_file"),
+        checkpoint_interval=configure.get_flag("checkpoint_interval"),
     )
     app = LightLDA(tw, td, vocab, cfg)
     app.train()
     out = configure.get_flag("output_file")
-    if out:
+    # skip the end-of-train dump when the last periodic store already
+    # wrote this exact state (a second full collective dump is pure
+    # waste at scale)
+    if out and getattr(app, "_last_store", ()) != (out, app._calls_done):
         app.store(out)
     dump = configure.get_flag("dump_file")
     if dump:

@@ -84,6 +84,11 @@ class W2VConfig:
     # GLOBAL batch; processes must own disjoint data lanes (validated).
     # Call counts are agreed collectively from the shards' sizes; each
     # process cycles its local corpus to fill the agreed schedule.
+    checkpoint_prefix: str = ""     # periodic mid-train checkpoints
+    checkpoint_interval: int = 0    # store every N superstep calls
+    # (0 = end-of-training dumps only — the reference worker's [H]
+    # behavior; the periodic trigger mirrors SURVEY §6.4's flag-driven
+    # periodic server dump)
     seed: int = 0
     dtype: str = "float32"
 
@@ -198,6 +203,11 @@ class WordEmbedding:
                              f"got {c.model!r}")
         self._key = core.prng_key(c.seed, mesh=self.mesh)
         self._step_no = 0
+        self._sched_offset = 0      # set by load(): resumed-call count
+        self._sched_plan = 0        # set by load(): original planned
+        # call count (0 = fresh run; train() re-plans per call as today)
+        self._train_plan = 0        # last train()'s effective plan
+        self._last_store = ()       # (prefix, step) of the last store
         self.loss_history: list = []
         self._local_chunks = None   # local_data: [(device, b0, b1), ...]
         if c.local_data and jax.process_count() > 1:
@@ -441,6 +451,9 @@ class WordEmbedding:
             # schedule is the stop condition
             total_steps = est_calls * c.steps_per_call
 
+        # the plan a periodic store persists: the original schedule when
+        # resumed, else this run's own estimate
+        self._train_plan = self._sched_plan or est_calls
         srcs_buf, tgts_buf = [], []
         losses, call_no = [], 0
         t0 = time.perf_counter()
@@ -458,6 +471,12 @@ class WordEmbedding:
             losses.append(loss)
             srcs_buf, tgts_buf = [], []
             call_no += 1
+            if c.checkpoint_interval > 0 and c.checkpoint_prefix \
+                    and call_no % c.checkpoint_interval == 0:
+                # periodic mid-train dump (SURVEY §6.4's flag-driven
+                # trigger); collective — every process reaches the same
+                # call_no in lockstep
+                self.store(c.checkpoint_prefix)
             if total_steps is not None \
                     and call_no * c.steps_per_call >= total_steps:
                 break
@@ -501,6 +520,11 @@ class WordEmbedding:
                   call_no: int, est_calls: int) -> jax.Array:
         c = self.config
         s = srcs.shape[0]
+        if self._sched_plan:
+            # checkpoint resume: continue the ORIGINAL run's decay and
+            # key sequence (past the plan's end the LR floor holds)
+            call_no += self._sched_offset
+            est_calls = max(self._sched_plan, 1)
         frac = min(call_no / est_calls, 1.0)
         lr_hi = c.learning_rate * (1.0 - frac)
         lr_lo = c.learning_rate * (1.0 - min((call_no + 1) / est_calls, 1.0))
@@ -549,13 +573,41 @@ class WordEmbedding:
             for w, row in zip(words, emb):
                 f.write(w + " " + " ".join(f"{x:.6g}" for x in row) + "\n")
 
+    META_MAGIC = "mvtpu.w2v.meta.v1"
+
     def store(self, uri_prefix: str) -> None:
+        from multiverso_tpu.tables.base import savez_stream
         self.w_in.store(f"{uri_prefix}.in.npz")
         self.w_out.store(f"{uri_prefix}.out.npz")
+        savez_stream(f"{uri_prefix}.meta.npz",
+                     {"magic": self.META_MAGIC,
+                      "step_no": self._step_no,
+                      "sched_plan": self._sched_plan
+                      or self._train_plan}, {})
+        self._last_store = (uri_prefix, self._step_no)
 
     def load(self, uri_prefix: str) -> None:
         self.w_in.load(f"{uri_prefix}.in.npz")
         self.w_out.load(f"{uri_prefix}.out.npz")
+        from multiverso_tpu.tables.base import loadz_stream
+        try:
+            manifest, _ = loadz_stream(f"{uri_prefix}.meta.npz",
+                                       self.META_MAGIC)
+        except Exception:
+            return          # pre-meta checkpoint: tables only
+        self._step_no = int(manifest["step_no"])
+        # resume CONTINUES the stored run's schedule: the original
+        # planned call count rides the meta, so the LR decay picks up
+        # exactly where the stored run left off (training past the
+        # plan's end stays at the floor LR), and the fold_in key
+        # sequence advances instead of replaying. In-session repeated
+        # train() calls keep their restart-the-schedule behavior —
+        # only load() sets these (and only from a checkpoint whose run
+        # actually had a plan).
+        self._sched_plan = int(manifest.get("sched_plan", 0))
+        if self._sched_plan:
+            self._sched_offset = \
+                self._step_no // self.config.steps_per_call
 
 
 def main(argv=None) -> None:
@@ -573,6 +625,9 @@ def main(argv=None) -> None:
     configure.define_int("min_count", 5, "vocab min count", overwrite=True)
     configure.define_string("output_file", "", "embedding checkpoint prefix", overwrite=True)
     configure.define_string("output_text", "", "text-format embedding dump (the reference's output format)", overwrite=True)
+    configure.define_int("checkpoint_interval", 0,
+                         "store -output_file every N superstep calls "
+                         "(0 = only at end)", overwrite=True)
     core.init(argv)
     train_file = configure.get_flag("train_file")
     if not train_file:
@@ -591,11 +646,16 @@ def main(argv=None) -> None:
         learning_rate=configure.get_flag("alpha"),
         epochs=configure.get_flag("epoch"),
         subsample=configure.get_flag("sample"),
+        checkpoint_prefix=configure.get_flag("output_file"),
+        checkpoint_interval=configure.get_flag("checkpoint_interval"),
     )
     app = WordEmbedding(corpus, cfg)
     app.train()
     out = configure.get_flag("output_file")
-    if out:
+    # skip the end-of-train dump when the last periodic store already
+    # wrote this exact state (a second full collective dump is pure
+    # waste at scale)
+    if out and app._last_store != (out, app._step_no):
         app.store(out)
     out_text = configure.get_flag("output_text")
     if out_text:

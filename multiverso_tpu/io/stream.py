@@ -184,7 +184,18 @@ class _FsspecAtomicWrite:
     def close(self) -> None:
         if not self._f.closed:
             self._f.close()
-            self._fs.mv(self._tmp, self._final)
+            try:
+                self._fs.mv(self._tmp, self._final)
+            except Exception:
+                # hdfs-like backends refuse a move onto an existing
+                # destination (object stores and local overwrite
+                # silently): clear it and retry — the brief no-file
+                # window is detectable/retryable, torn bytes are not
+                try:
+                    self._fs.rm(self._final)
+                except Exception:
+                    pass
+                self._fs.mv(self._tmp, self._final)
 
     @property
     def closed(self):

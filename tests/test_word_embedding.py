@@ -208,6 +208,62 @@ def test_store_load_roundtrip(mesh_dp8, tmp_path):
     np.testing.assert_allclose(app2.embeddings(), emb, rtol=1e-6)
 
 
+def test_periodic_checkpoint_and_resume(mesh_dp8, tmp_path):
+    """SURVEY §6.4's flag-driven periodic dump + true resume: training
+    with checkpoint_interval stores mid-train; a fresh app loads the
+    dump, restores the step counter, and CONTINUES the LR decay and the
+    fold_in key sequence instead of restarting/replaying them."""
+    corpus, _ = _clustered_corpus(tmp_path, n_sents=300, seed=9)
+    prefix = f"file://{tmp_path}/w2v_per"
+    cfg = W2VConfig(embedding_dim=8, window=2, negative=2, batch_size=256,
+                    steps_per_call=2, epochs=1, subsample=0,
+                    checkpoint_prefix=prefix, checkpoint_interval=2)
+    app = WordEmbedding(corpus, cfg, mesh=mesh_dp8, name="w2v_per")
+    app.train(total_steps=8)             # 4 calls -> stores at 2 and 4
+    assert (tmp_path / "w2v_per.in.npz").exists()
+    assert (tmp_path / "w2v_per.meta.npz").exists()
+    steps_at_ck = app._step_no
+
+    app2 = WordEmbedding(corpus, cfg, mesh=mesh_dp8, name="w2v_per2")
+    app2.load(prefix)
+    assert app2._step_no == steps_at_ck          # counter restored
+    assert app2._sched_offset == steps_at_ck // cfg.steps_per_call
+    # resumed continuation trains and the embeddings move
+    before = app2.embeddings().copy()
+    app2.train(total_steps=4)
+    assert np.isfinite(app2.loss_history).all()
+    assert not np.allclose(app2.embeddings(), before)
+
+    # a pre-meta checkpoint (tables only) still loads, without resume
+    import os
+    os.remove(tmp_path / "w2v_per.meta.npz")
+    app3 = WordEmbedding(corpus, cfg, mesh=mesh_dp8, name="w2v_per3")
+    app3.load(prefix)
+    assert app3._sched_offset == 0
+
+
+def test_lda_periodic_checkpoint(mesh_dp8):
+    """LightLDA's periodic trigger stores full sampler state mid-train;
+    the dump loads into a fresh app with z preserved."""
+    from multiverso_tpu.apps.lightlda import LDAConfig, LightLDA
+    from multiverso_tpu.io.stream import mem_store_clear
+    rng = np.random.default_rng(3)
+    tw = rng.integers(0, 30, 640).astype(np.int32)
+    td = np.sort(rng.integers(0, 20, 640)).astype(np.int32)
+    cfg = LDAConfig(num_topics=8, batch_tokens=320, steps_per_call=2,
+                    seed=2, num_iterations=3, eval_every=10,
+                    checkpoint_prefix="mem://lda_per",
+                    checkpoint_interval=2)
+    app = LightLDA(tw, td, 30, cfg, mesh=mesh_dp8, name="lda_per")
+    app.train()                          # 3 sweeps -> store after sweep 2
+    app2 = LightLDA(tw, td, 30, cfg, mesh=mesh_dp8, name="lda_per2")
+    app2.load("mem://lda_per")
+    z = np.asarray(app2._z)
+    assert z.min() >= 0 and z.max() < cfg.num_topics
+    assert int(app2.word_topics().sum()) == len(tw)
+    mem_store_clear()
+
+
 def test_batch_size_must_divide_mesh(mesh_dp8, tmp_path):
     corpus, _ = _clustered_corpus(tmp_path, n_sents=100, seed=7)
     cfg = W2VConfig(embedding_dim=8, batch_size=100)  # 100 % 8 != 0
