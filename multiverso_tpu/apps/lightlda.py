@@ -1716,6 +1716,11 @@ class LightLDA:
             layout = "stream"
         manifest = {"magic": "multiverso_tpu.lda_state.v1",
                     "num_tokens": self.num_tokens,
+                    # torn-set detection: the state file is written LAST
+                    # and records the table's step — a crash between the
+                    # per-file-atomic writes is caught at load
+                    "word_topic_step":
+                        self.word_topic.default_option.step,
                     "perm_seed": self.config.seed,
                     "t_pad": int(z.shape[0]),
                     "layout": layout,
@@ -1794,6 +1799,16 @@ class LightLDA:
             raise ValueError(
                 f"checkpoint has {manifest['num_tokens']} tokens, app has "
                 f"{self.num_tokens} — same corpus required to resume")
+        if "word_topic_step" in manifest and \
+                self.word_topic.default_option.step \
+                != int(manifest["word_topic_step"]):
+            raise ValueError(
+                f"lda checkpoint {uri_prefix!r} is torn: state was "
+                f"written at word_topic step "
+                f"{manifest['word_topic_step']} but the loaded table "
+                f"is at step {self.word_topic.default_option.step} — a "
+                "crash interrupted the multi-file store; use an older "
+                "complete checkpoint")
         if manifest["perm_seed"] != self.config.seed:
             raise ValueError(
                 f"checkpoint was written with seed "

@@ -576,12 +576,19 @@ class WordEmbedding:
     META_MAGIC = "mvtpu.w2v.meta.v1"
 
     def store(self, uri_prefix: str) -> None:
+        """Checkpoint both tables + a meta manifest. The meta is
+        written LAST and records each table's step, so load() can
+        detect a torn set (crash between the three per-file-atomic
+        writes) instead of silently training mismatched tables."""
         from multiverso_tpu.tables.base import savez_stream
         self.w_in.store(f"{uri_prefix}.in.npz")
         self.w_out.store(f"{uri_prefix}.out.npz")
         savez_stream(f"{uri_prefix}.meta.npz",
                      {"magic": self.META_MAGIC,
                       "step_no": self._step_no,
+                      "steps_per_call": self.config.steps_per_call,
+                      "w_in_step": self.w_in.default_option.step,
+                      "w_out_step": self.w_out.default_option.step,
                       "sched_plan": self._sched_plan
                       or self._train_plan}, {})
         self._last_store = (uri_prefix, self._step_no)
@@ -593,8 +600,32 @@ class WordEmbedding:
         try:
             manifest, _ = loadz_stream(f"{uri_prefix}.meta.npz",
                                        self.META_MAGIC)
-        except Exception:
+        except FileNotFoundError:
             return          # pre-meta checkpoint: tables only
+        # any OTHER failure (corrupt meta, wrong magic, transient read
+        # error) must RAISE: silently skipping resume here would leave
+        # this process with a different step counter than its peers —
+        # lockstep collective training then diverges without an error
+        for table, key in ((self.w_in, "w_in_step"),
+                           (self.w_out, "w_out_step")):
+            if key in manifest and \
+                    table.default_option.step != int(manifest[key]):
+                raise ValueError(
+                    f"w2v checkpoint {uri_prefix!r} is torn: "
+                    f"{key}={manifest[key]} in the meta but the loaded "
+                    f"table is at step {table.default_option.step} — a "
+                    "crash interrupted the three-file store; use an "
+                    "older complete checkpoint")
+        spc = int(manifest.get("steps_per_call",
+                               self.config.steps_per_call))
+        if spc != self.config.steps_per_call:
+            raise ValueError(
+                f"w2v checkpoint {uri_prefix!r} was written with "
+                f"steps_per_call={spc}, this app uses "
+                f"{self.config.steps_per_call}: the resume offset and "
+                "fold_in key sequence are call-indexed, so resuming "
+                "under a different call size would replay RNG — "
+                "construct the app with the original steps_per_call")
         self._step_no = int(manifest["step_no"])
         # resume CONTINUES the stored run's schedule: the original
         # planned call count rides the meta, so the LR decay picks up

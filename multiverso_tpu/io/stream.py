@@ -189,13 +189,25 @@ class _FsspecAtomicWrite:
             except Exception:
                 # hdfs-like backends refuse a move onto an existing
                 # destination (object stores and local overwrite
-                # silently): clear it and retry — the brief no-file
-                # window is detectable/retryable, torn bytes are not
+                # silently). Only treat the failure as that conflict
+                # when the destination actually exists — a transient
+                # backend error must NOT delete the last good
+                # checkpoint.
+                if not self._fs.exists(self._final):
+                    raise
                 try:
                     self._fs.rm(self._final)
+                    self._fs.mv(self._tmp, self._final)
                 except Exception:
-                    pass
-                self._fs.mv(self._tmp, self._final)
+                    # collective same-path stores write IDENTICAL
+                    # payloads: if a concurrent rank just landed the
+                    # file, accept theirs and drop our temp
+                    if not self._fs.exists(self._final):
+                        raise
+                    try:
+                        self._fs.rm(self._tmp)
+                    except Exception:
+                        pass
 
     @property
     def closed(self):

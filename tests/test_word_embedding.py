@@ -224,7 +224,12 @@ def test_periodic_checkpoint_and_resume(mesh_dp8, tmp_path):
     assert (tmp_path / "w2v_per.meta.npz").exists()
     steps_at_ck = app._step_no
 
-    app2 = WordEmbedding(corpus, cfg, mesh=mesh_dp8, name="w2v_per2")
+    # resume WITHOUT the periodic trigger (so the stored meta stays put
+    # for the torn-set scenario below)
+    cfg_r = W2VConfig(embedding_dim=8, window=2, negative=2,
+                      batch_size=256, steps_per_call=2, epochs=1,
+                      subsample=0)
+    app2 = WordEmbedding(corpus, cfg_r, mesh=mesh_dp8, name="w2v_per2")
     app2.load(prefix)
     assert app2._step_no == steps_at_ck          # counter restored
     assert app2._sched_offset == steps_at_ck // cfg.steps_per_call
@@ -233,6 +238,30 @@ def test_periodic_checkpoint_and_resume(mesh_dp8, tmp_path):
     app2.train(total_steps=4)
     assert np.isfinite(app2.loss_history).all()
     assert not np.allclose(app2.embeddings(), before)
+
+    # a TORN set (crash between the three per-file writes: table moved
+    # on, meta stale) is detected, not silently resumed
+    app2.train(total_steps=4)
+    app2.w_in.store(f"{prefix}.in.npz")     # newer table, stale meta
+    app_t = WordEmbedding(corpus, cfg, mesh=mesh_dp8, name="w2v_torn")
+    with pytest.raises(ValueError, match="torn"):
+        app_t.load(prefix)
+
+    # refresh a complete set, then: resuming under a DIFFERENT
+    # steps_per_call is rejected (call-indexed RNG would replay)
+    app2.store(prefix)
+    cfg4 = W2VConfig(embedding_dim=8, window=2, negative=2,
+                     batch_size=256, steps_per_call=4, epochs=1,
+                     subsample=0)
+    app_s = WordEmbedding(corpus, cfg4, mesh=mesh_dp8, name="w2v_spc")
+    with pytest.raises(ValueError, match="steps_per_call"):
+        app_s.load(prefix)
+
+    # a corrupt meta RAISES (a silent skip would desync lockstep peers)
+    (tmp_path / "w2v_per.meta.npz").write_bytes(b"garbage not an npz")
+    app_c = WordEmbedding(corpus, cfg, mesh=mesh_dp8, name="w2v_corr")
+    with pytest.raises(ValueError):
+        app_c.load(prefix)
 
     # a pre-meta checkpoint (tables only) still loads, without resume
     import os
