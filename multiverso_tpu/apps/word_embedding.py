@@ -93,6 +93,21 @@ class W2VConfig:
     dtype: str = "float32"
 
 
+def _normalized_rows(emb: np.ndarray) -> np.ndarray:
+    """Rows scaled to unit norm (zero rows guarded)."""
+    return emb / np.maximum(
+        np.linalg.norm(emb, axis=1, keepdims=True), 1e-12)
+
+
+def _topk_excluding(norm: np.ndarray, q: np.ndarray,
+                    exclude, k: int) -> np.ndarray:
+    """Top-k row ids of ``norm`` by dot with ``q``, excluding ids
+    (shared by nearest() and the compute-accuracy analogy rule)."""
+    sims = norm @ q
+    sims[list(exclude)] = -np.inf
+    return np.argsort(-sims)[:k]
+
+
 def build_alias(probs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     """Vose alias-table construction, O(V).
 
@@ -547,18 +562,23 @@ class WordEmbedding:
 
     def nearest(self, word_id: int, k: int = 10) -> np.ndarray:
         """Top-k neighbor ids by cosine similarity (excluding self)."""
-        emb = self.embeddings()
-        norm = emb / np.maximum(
-            np.linalg.norm(emb, axis=1, keepdims=True), 1e-12)
-        sims = norm @ norm[word_id]
-        sims[word_id] = -np.inf
-        return np.argsort(-sims)[:k]
+        norm = _normalized_rows(self.embeddings())
+        return _topk_excluding(norm, norm[word_id], (word_id,), k)
 
     def similarity(self, a: int, b: int) -> float:
         emb = self.embeddings()
         va, vb = emb[a], emb[b]
         return float(va @ vb / max(np.linalg.norm(va) * np.linalg.norm(vb),
                                    1e-12))
+
+    def analogy(self, a: int, b: int, c: int, k: int = 1) -> np.ndarray:
+        """``a : b :: c : ?`` — top-k ids by cosine to (b - a + c), the
+        reference word2vec's compute-accuracy evaluation rule (query
+        words excluded from the candidates)."""
+        norm = _normalized_rows(self.embeddings())
+        q = norm[b] - norm[a] + norm[c]
+        q = q / max(np.linalg.norm(q), 1e-12)
+        return _topk_excluding(norm, q, (a, b, c), k)
 
     def save_text(self, path: str) -> None:
         """The reference word2vec's text output format: a header line

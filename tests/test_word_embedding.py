@@ -208,6 +208,29 @@ def test_store_load_roundtrip(mesh_dp8, tmp_path):
     np.testing.assert_allclose(app2.embeddings(), emb, rtol=1e-6)
 
 
+def test_analogy_rule():
+    """The compute-accuracy rule on a planted geometry (pure host math,
+    no app needed): with a row on the b - a + c direction, the helpers
+    nearest()/analogy() share return it, excluding the query words."""
+    from multiverso_tpu.apps.word_embedding import (_normalized_rows,
+                                                    _topk_excluding)
+    emb = np.zeros((40, 4), np.float32)
+    rng = np.random.default_rng(0)
+    emb[4:] = rng.normal(0, 0.1, (36, 4))
+    emb[0] = [1, 0, 0, 0]
+    emb[1] = [0, 1, 0, 0]
+    emb[2] = [0, 0, 1, 0]
+    emb[3] = [-0.6, 0.6, 0.6, 0]     # normalized b - a + c direction
+    norm = _normalized_rows(emb)
+    q = norm[1] - norm[0] + norm[2]
+    q = q / np.linalg.norm(q)
+    got = _topk_excluding(norm, q, (0, 1, 2), 1)
+    assert got[0] == 3, got
+    # exclusion really excludes: the raw best IS a query word
+    raw = _topk_excluding(norm, norm[1], (), 1)
+    assert raw[0] == 1
+
+
 def test_periodic_checkpoint_and_resume(mesh_dp8, tmp_path):
     """SURVEY §6.4's flag-driven periodic dump + true resume: training
     with checkpoint_interval stores mid-train; a fresh app loads the
