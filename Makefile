@@ -7,12 +7,16 @@
 #   make bench-dryrun - INTEGRATED bench pipeline at toy sizes on CPU
 #                   (~16s; runs with the chip tunnel down — integration
 #                   seams real, numbers meaningless)
+#   make fuzz     - extended differential fuzz (~10-40 min; not in ci)
 #   make native   - C++ data loader + baseline binaries
 #   make ci       - everything CI runs, in order
 
 PY ?= python
 
-.PHONY: test dryrun bench bench-dryrun native ci
+.PHONY: test dryrun bench bench-dryrun fuzz native ci
+
+fuzz:
+	$(PY) tests/deep_fuzz.py
 
 test:
 	$(PY) -m pytest tests/ -q
