@@ -468,8 +468,12 @@ class KVTable:
                 raise ValueError(
                     f"kv table {self.name!r}: rehash from "
                     f"{manifest['num_buckets']}x{manifest['slots']} "
-                    f"cannot fit every bucket even at {nb} buckets — "
-                    "pathological key collisions")
+                    f"cannot fit every bucket even at {nb} buckets of "
+                    f"{self.slots} slot(s). At small slots_per_bucket "
+                    "the bucket count needed for n keys grows like the "
+                    "birthday bound (~n^2 at 1 slot) — construct the "
+                    "restoring table with slots_per_bucket >= 4 "
+                    "instead of relying on geometry growth")
             nb *= 2
         buckets = (hashes % np.uint64(nb)).astype(np.int32)
         order = np.argsort(buckets, kind="stable")
