@@ -27,7 +27,8 @@ def create_table(option: TableOption):
     if isinstance(option, ArrayTableOption):
         return ArrayTable(option.size, option.dtype,
                           init_value=option.init_value,
-                          updater=option.updater, name=option.name)
+                          updater=option.updater, name=option.name,
+                          shard_update=option.shard_update)
     if isinstance(option, SparseMatrixTableOption):
         return SparseMatrixTable(option.num_rows, option.num_cols,
                                  option.dtype, init_value=option.init_value,
@@ -36,7 +37,8 @@ def create_table(option: TableOption):
     if isinstance(option, MatrixTableOption):
         return MatrixTable(option.num_rows, option.num_cols, option.dtype,
                            init_value=option.init_value,
-                           updater=option.updater, name=option.name)
+                           updater=option.updater, name=option.name,
+                           shard_update=option.shard_update)
     if isinstance(option, KVTableOption):
         return KVTable(option.capacity, option.value_dim, option.dtype,
                        slots_per_bucket=option.slots_per_bucket,

@@ -29,17 +29,20 @@ class ArrayTableOption:
     init_value: Any = 0
     updater: Optional[str] = None
     name: str = "array_table"
+    shard_update: bool = False   # data-axis weight-update sharding
 
 
 class ArrayTable(Table):
     def __init__(self, size: int, dtype: Any = "float32", *,
                  init_value: Any = 0, updater: Optional[str] = None,
                  mesh: Optional[Mesh] = None, name: str = "array_table",
-                 default_option: Optional[AddOption] = None) -> None:
+                 default_option: Optional[AddOption] = None,
+                 shard_update: bool = False) -> None:
         if size <= 0:
             raise ValueError(f"ArrayTable size must be positive, got {size}")
         super().__init__(name, (size,), dtype, updater=updater, mesh=mesh,
-                         init_value=init_value, default_option=default_option)
+                         init_value=init_value, default_option=default_option,
+                         shard_update=shard_update)
 
     @property
     def size(self) -> int:

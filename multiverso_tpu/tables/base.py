@@ -167,7 +167,8 @@ class Table:
                  *, updater: Optional[str] = None,
                  mesh: Optional[Mesh] = None,
                  init_value: Any = 0,
-                 default_option: Optional[AddOption] = None) -> None:
+                 default_option: Optional[AddOption] = None,
+                 shard_update: bool = False) -> None:
         self.name = name
         self.mesh = mesh if mesh is not None else core.mesh()
         self.logical_shape = tuple(shape)
@@ -182,11 +183,26 @@ class Table:
         # generation contract (bumped on every applied update/load)
         self.generation = 0
 
-        # pad leading dim to a multiple of the model-axis size
-        # (subclasses override _pad_lead to reserve scratch rows)
+        # weight-update sharding (cross-replica sharding of the weight
+        # update, arXiv:2004.13336 — the ZeRO-2-on-TPU classic): shard
+        # updater STATE (and so the state-update compute) over the data
+        # axis too, instead of every data replica holding and updating
+        # identical state. Costs ~one data-axis all-gather per add when
+        # the param update needs the state; buys state memory and
+        # update FLOPs divided by dp. Opt-in: best for whole-table adds
+        # (the DP gradient push); row-streamed adds pay the gather per
+        # call.
+        dp = dict(self.mesh.shape).get(core.DATA_AXIS, 1)
+        self.shard_update = bool(shard_update) and dp > 1
+
+        # pad leading dim to a multiple of the model-axis size — and of
+        # the model*data product under shard_update (subclasses override
+        # _pad_lead to reserve scratch rows); dense checkpoints repad
+        # across differing padded shapes, so the flag stays portable
         shards = self.mesh.shape[core.MODEL_AXIS]
         lead = self.logical_shape[0] if self.logical_shape else 1
-        padded_lead = self._pad_lead(lead, shards)
+        lead_mult = shards * dp if self.shard_update else shards
+        padded_lead = self._pad_lead(lead, lead_mult)
         self.padded_shape = (padded_lead,) + self.logical_shape[1:]
         # physical layout of the param array; subclasses may re-tile it
         # (storage_shape != padded_shape) while keeping the 2-D logical
@@ -194,15 +210,22 @@ class Table:
         self.storage_shape = self.padded_shape
         self.spec = P(core.MODEL_AXIS, *([None] * (len(shape) - 1)))
         self.sharding = NamedSharding(self.mesh, self.spec)
+        state_spec = P((core.MODEL_AXIS, core.DATA_AXIS),
+                       *([None] * (len(shape) - 1))) \
+            if self.shard_update else self.spec
+        self.state_sharding = NamedSharding(self.mesh, state_spec)
 
         init = np.full(self.padded_shape, init_value, dtype=self.dtype) \
             if np.isscalar(init_value) else self._pad(np.asarray(init_value))
         self.param = jax.device_put(init, self.sharding)
-        # state leaves are zeros_like(param) shaped -> shard like params
+        # state leaves are zeros_like(param) shaped -> param sharding,
+        # refined over the data axis under shard_update
         self.state = jax.tree.map(
-            lambda s: jax.device_put(s, self.sharding),
+            lambda s: jax.device_put(s, self.state_sharding),
             self.updater.init_state(self.param))
-        self._apply = jax.jit(self.updater.apply, donate_argnums=(0, 1))
+        state_sh = jax.tree.map(lambda _: self.state_sharding, self.state)
+        self._apply = jax.jit(self.updater.apply, donate_argnums=(0, 1),
+                              out_shardings=(self.sharding, state_sh))
 
         # whole-table snapshot: logical region, REPLICATED output (the
         # all-gather is the reference's whole-table Get; a replicated
@@ -376,7 +399,16 @@ class Table:
         atomic rename, so same-path writers never interleave."""
         payload = {"param": self._export_param()}
         manifest = self._manifest()
-        manifest["n_state_leaves"] = pack_state(self.state, payload)
+        state = self.state
+        if self.shard_update:
+            # (model, data)-sharded state spans processes on a
+            # multi-host data axis — np.asarray on such a leaf raises.
+            # Gather over the data axis first (jitted identity to the
+            # model-only sharding, per-process addressable), the state
+            # analog of the param snapshot's replicated out-sharding.
+            model_sh = jax.tree.map(lambda _: self.sharding, state)
+            state = jax.jit(lambda s: s, out_shardings=model_sh)(state)
+        manifest["n_state_leaves"] = pack_state(state, payload)
         savez_stream(uri, manifest, payload)
 
     def load(self, uri: str) -> None:
@@ -403,7 +435,7 @@ class Table:
         self.state = unpack_state(
             data, manifest["n_state_leaves"], self.state,
             lambda leaf, tmpl: jax.device_put(
-                repad(leaf, tmpl.shape, tmpl.dtype), self.sharding))
+                repad(leaf, tmpl.shape, tmpl.dtype), self.state_sharding))
         self.default_option.step = int(manifest.get("step", 0))
         # load replaces live state: outstanding add-handles must read as
         # superseded (generation contract: bumped on every applied

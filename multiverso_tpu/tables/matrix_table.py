@@ -53,19 +53,22 @@ class MatrixTableOption:
     init_value: Any = 0
     updater: Optional[str] = None
     name: str = "matrix_table"
+    shard_update: bool = False   # data-axis weight-update sharding
 
 
 class MatrixTable(Table):
     def __init__(self, num_rows: int, num_cols: int, dtype: Any = "float32",
                  *, init_value: Any = 0, updater: Optional[str] = None,
                  mesh: Optional[Mesh] = None, name: str = "matrix_table",
-                 default_option: Optional[AddOption] = None) -> None:
+                 default_option: Optional[AddOption] = None,
+                 shard_update: bool = False) -> None:
         if num_rows <= 0 or num_cols <= 0:
             raise ValueError(f"MatrixTable dims must be positive, got "
                              f"{num_rows}x{num_cols}")
         super().__init__(name, (num_rows, num_cols), dtype, updater=updater,
                          mesh=mesh, init_value=init_value,
-                         default_option=default_option)
+                         default_option=default_option,
+                         shard_update=shard_update)
         # scratch row: guaranteed > logical rows (base padding reserves it)
         self._scratch_row = self.padded_shape[0] - 1
         assert self._scratch_row >= self.logical_shape[0], \
@@ -97,7 +100,10 @@ class MatrixTable(Table):
         def scatter_add(param, ids, deltas):
             return param.at[ids].add(deltas.astype(param.dtype))
 
-        @partial(jax.jit, donate_argnums=(0, 1))
+        state_sh = jax.tree.map(lambda _: self.state_sharding, self.state)
+
+        @partial(jax.jit, donate_argnums=(0, 1),
+                 out_shardings=(self.sharding, state_sh))
         def gather_apply_scatter(param, state, ids, deltas, mask, option):
             rows = jnp.take(param, ids, axis=0)
             st_rows = jax.tree.map(lambda s: jnp.take(s, ids, axis=0), state)

@@ -72,7 +72,7 @@ class FusedSuperstep:
 
         param_sh = tuple(t.sharding for t in self.tables)
         state_sh = tuple(
-            jax.tree.map(lambda _, t=t: t.sharding, t.state)
+            jax.tree.map(lambda _, t=t: t.state_sharding, t.state)
             for t in self.tables)
 
         @partial(jax.jit, donate_argnums=(0, 1, 2),

@@ -55,6 +55,29 @@ def main() -> None:
           option=AddOption(learning_rate=0.5), sync=True)
     np.testing.assert_allclose(t.get(), -0.5 * np.arange(10), rtol=1e-6)
 
+    # weight-update sharding with the data axis REALLY cross-process:
+    # state leaves span processes, so adds, the collective store's
+    # data-axis state gather, and load must all run multi-host
+    import os as _os
+    import tempfile as _tf
+    from multiverso_tpu.updaters import AddOption as _AO
+    wus = ArrayTable(24, "float32", updater="adagrad", shard_update=True,
+                     default_option=_AO(learning_rate=0.5, lam=1e-8),
+                     name="mh_wus")
+    assert wus.shard_update, "data axis should enable shard_update"
+    wus.add(np.ones(24, np.float32), sync=True)
+    wus.add(np.ones(24, np.float32), sync=True)
+    h = np.full(24, 2.0)        # adagrad oracle after two unit adds
+    want = -0.5 * (1 / (np.sqrt(1.0) + 1e-8) + 1 / (np.sqrt(2.0) + 1e-8))
+    np.testing.assert_allclose(wus.get(), np.full(24, want), rtol=1e-5)
+    ck = _os.path.join(_tf.gettempdir(), f"mh_wus_{port}.npz")
+    wus.store(ck)               # the data-axis state gather, for real
+    wus2 = ArrayTable(24, "float32", updater="adagrad", shard_update=True,
+                      default_option=_AO(learning_rate=0.5, lam=1e-8),
+                      name="mh_wus2")
+    wus2.load(ck)
+    np.testing.assert_allclose(wus2.get(), wus.get(), rtol=1e-6)
+
     # a second update through the fused-superstep path
     from multiverso_tpu.tables import make_superstep
 
