@@ -55,6 +55,9 @@ class LogRegConfig:
     ftrl_l2: float = 0.0            # AddOption lam/rho/momentum fields
     ftrl_beta: float = 1.0          # (see updaters docstring mapping)
     objective: str = "softmax"      # "softmax" | "sigmoid"
+    shard_update: bool = False      # cross-replica weight-update
+    # sharding: updater state (adagrad/ftrl/...) + update compute / dp
+    # over the data axis (arXiv:2004.13336); no-op for stateless sgd
     seed: int = 0
 
     def __post_init__(self) -> None:
@@ -186,7 +189,8 @@ class LogisticRegression:
             else AddOption(learning_rate=c.learning_rate)
         self.table = ArrayTable(
             self.n_weights, "float32", init_value=init, updater=c.updater,
-            mesh=self.mesh, name=name, default_option=opt)
+            mesh=self.mesh, name=name, default_option=opt,
+            shard_update=c.shard_update)
         self._data_sharding = NamedSharding(self.mesh, P(core.DATA_AXIS))
         self._build_step()
 

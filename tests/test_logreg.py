@@ -186,3 +186,22 @@ def test_detect_libsvm_base_joint(tmp_path):
 def test_sigmoid_requires_two_classes():
     with pytest.raises(ValueError, match="sigmoid"):
         LogRegConfig(input_dim=4, num_classes=3, objective="sigmoid")
+
+
+def test_adagrad_shard_update_matches_replicated(mesh8):
+    """BASELINE config #1 with cross-replica weight-update sharding:
+    numerically equivalent training outcome (rtol 1e-5 — padded shapes
+    and reduction shardings differ, so bit-equality is not the
+    contract) to the replicated-state path; the app consumer of
+    Table.shard_update."""
+    X, y = synthetic_blobs(512, input_dim=8, num_classes=3, seed=5)
+    base = dict(input_dim=8, num_classes=3, minibatch_size=64,
+                epochs=3, learning_rate=0.3, updater="adagrad")
+    a = LogisticRegression(LogRegConfig(**base), mesh=mesh8, name="lr_rep")
+    b = LogisticRegression(LogRegConfig(**base, shard_update=True),
+                           mesh=mesh8, name="lr_wus")
+    assert b.table.shard_update and not a.table.shard_update
+    a.train(X, y)
+    b.train(X, y)
+    np.testing.assert_allclose(b.table.get(), a.table.get(), rtol=1e-5)
+    assert b.accuracy(X, y) > 0.85
