@@ -120,6 +120,70 @@ def _walk(rng, t, mirror, tdir, steps, seed, updater, cap, slots, dim,
             return
 
 
+def matrix_deep(seed, updater, steps=80):
+    """MatrixTable row-op walk vs a dense numpy mirror: add_rows with
+    stateful updaters (unique ids per batch — the duplicate contract
+    rejects stateful dup batches), whole-table adds, row/whole gets,
+    checkpoint round-trips, and the shard_update variant sharing every
+    op (its results must track the same mirror)."""
+    import tempfile
+    import shutil
+    from multiverso_tpu.tables import MatrixTable
+    rng = np.random.default_rng(seed)
+    rows, cols = int(rng.integers(9, 40)), int(rng.integers(2, 6))
+    lr = 0.2
+    opt = AddOption(learning_rate=lr, lam=1e-8)
+    t = MatrixTable(rows, cols, updater=updater, default_option=opt,
+                    name=f"mz_{seed}_{updater}")
+    tw = MatrixTable(rows, cols, updater=updater, default_option=opt,
+                     shard_update=True, name=f"mzw_{seed}_{updater}")
+    param = np.zeros((rows, cols), np.float32)
+    h = np.zeros((rows, cols), np.float32)       # adagrad accumulator
+    tdir = tempfile.mkdtemp()
+    try:
+        for step in range(steps):
+            op = rng.integers(0, 4)
+            try:
+                if op == 0:                      # row adds, unique ids
+                    n = int(rng.integers(1, rows + 1))
+                    ids = rng.choice(rows, n, replace=False) \
+                        .astype(np.int32)
+                    d = rng.normal(0, 1, (n, cols)).astype(np.float32)
+                    t.add_rows(ids, d, sync=bool(rng.integers(0, 2)))
+                    tw.add_rows(ids, d, sync=False)
+                    if updater == "sgd":
+                        param[ids] -= lr * d
+                    else:                        # adagrad
+                        h[ids] += d * d
+                        param[ids] -= lr * d / (np.sqrt(h[ids]) + 1e-8)
+                elif op == 1:                    # row gets (with dups)
+                    ids = rng.choice(rows, 5).astype(np.int32)
+                    np.testing.assert_allclose(t.get_rows(ids),
+                                               param[ids], rtol=2e-4,
+                                               atol=2e-4)
+                    np.testing.assert_allclose(tw.get_rows(ids),
+                                               param[ids], rtol=2e-4,
+                                               atol=2e-4)
+                elif op == 2:                    # whole-table compare
+                    np.testing.assert_allclose(t.get(), param,
+                                               rtol=2e-4, atol=2e-4)
+                    np.testing.assert_allclose(tw.get(), param,
+                                               rtol=2e-4, atol=2e-4)
+                else:                            # checkpoint round-trip
+                    uri = os.path.join(tdir, f"m_{step}.npz")
+                    t.store(uri)
+                    t.load(uri)
+                    # cross-flag: the WUS table restores the replicated
+                    # table's checkpoint (and stays on the walk)
+                    tw.load(uri)
+            except Exception:
+                failures.append((seed, updater, step, int(op),
+                                 traceback.format_exc()))
+                return
+    finally:
+        shutil.rmtree(tdir, ignore_errors=True)
+
+
 core.init(devices=jax.devices("cpu"), data_parallel=4, model_parallel=2)
 n_runs = 0
 for seed in range(20):
@@ -129,10 +193,17 @@ for seed in range(20):
         reset_tables()
         if failures:
             break
+    if not failures and seed < 10:
+        for updater in ("sgd", "adagrad"):
+            matrix_deep(2000 + seed, updater)
+            n_runs += 1
+            reset_tables()
+            if failures:
+                break
     if failures:
         break
 
-print(f"deep fuzz: {n_runs} walks x 120 ops")
+print(f"deep fuzz: {n_runs} walks x 80-120 ops")
 if failures:
     seed, upd, step, op, tb = failures[0]
     print(f"FAILURE seed={seed} updater={upd} step={step} op={op}\n{tb}")
