@@ -377,6 +377,10 @@ def main(argv=None) -> None:
     configure.define_int("train_epoch", 1, "epochs", overwrite=True)
     configure.define_float("learning_rate", 0.1, "learning rate", overwrite=True)
     configure.define_float("regular_lambda", 0.0, "L2 coefficient", overwrite=True)
+    configure.define_bool("shard_update", False,
+                          "cross-replica weight-update sharding "
+                          "(updater state + update FLOPs / dp)",
+                          overwrite=True)
     configure.define_string("output_model_file", "", "checkpoint URI", overwrite=True)
     core.init(argv)
     # the global updater_type default is "default" (plain add) — for a
@@ -392,6 +396,7 @@ def main(argv=None) -> None:
         learning_rate=configure.get_flag("learning_rate"),
         regular_lambda=configure.get_flag("regular_lambda"),
         updater=updater,
+        shard_update=configure.get_flag("shard_update"),
     )
     app = LogisticRegression(cfg)
     train_file = configure.get_flag("train_file")

@@ -44,7 +44,9 @@ def test_logreg_cli(tmp_path):
     logreg.main([f"-train_file={train}", f"-test_file={train}",
                  "-input_dimension=20", "-output_dimension=3",
                  "-minibatch_size=32", "-train_epoch=2",
-                 "-learning_rate=0.2", f"-output_model_file={out}"])
+                 "-learning_rate=0.2", "-updater_type=adagrad",
+                 "-shard_update=true",
+                 f"-output_model_file={out}"])
     assert out.exists() or any(
         p.name.startswith("lr.ckpt") for p in tmp_path.iterdir())
 
