@@ -12,12 +12,18 @@ from multiverso_tpu.tables import base as table_base
 
 @pytest.fixture(autouse=True)
 def _clean_runtime():
-    """Mains own the runtime (core.init(argv)): give each a clean one."""
+    """Mains own the runtime (core.init(argv)) AND the process-wide
+    flag store (-updater_type=... etc. persist after parse): give each
+    test a clean runtime and restore flag defaults afterwards so later
+    tests don't inherit CLI flag values (a leaked -updater_type=adagrad
+    makes unrelated SparseMatrixTable constructions raise)."""
+    from multiverso_tpu.utils import configure
     table_base.reset_tables()
     core.shutdown()
     yield
     table_base.reset_tables()
     core.shutdown()
+    configure.reset_flags()
 
 
 def _write_libsvm(path, n, dim, classes, nnz, seed, one_based=False):
