@@ -8,15 +8,21 @@
 #                   (~16s; runs with the chip tunnel down — integration
 #                   seams real, numbers meaningless)
 #   make fuzz     - extended differential fuzz (~10-40 min; not in ci)
+#   make lint     - stdlib linter (tools/lint.py: syntax + unused
+#                   imports; neither ruff nor pyflakes is vendored in
+#                   this image) over the package, tests, and bench
 #   make native   - C++ data loader + baseline binaries
 #   make ci       - everything CI runs, in order
 
 PY ?= python
 
-.PHONY: test dryrun bench bench-dryrun fuzz native ci
+.PHONY: test dryrun bench bench-dryrun fuzz lint native ci
 
 fuzz:
 	$(PY) tests/deep_fuzz.py
+
+lint:
+	$(PY) tools/lint.py multiverso_tpu tests bench.py tools
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -34,4 +40,4 @@ bench:
 native:
 	$(MAKE) -C native
 
-ci: native test dryrun bench-dryrun
+ci: lint native test dryrun bench-dryrun

@@ -203,6 +203,46 @@ def load_baseline() -> float:
         return float(measure(repeats=1)["words_per_sec"])
 
 
+# diagnostic telemetry artifact (ISSUE 1 / BENCH_r05: the round-5
+# probes hung for 30 minutes with ZERO diagnostic signal): main() binds
+# these to the repo-local snapshot/trace paths, and every probe attempt
+# + tier boundary writes a fresh registry snapshot, so a wedged run
+# still leaves `bench_telemetry.json` for
+#   python -m multiverso_tpu.telemetry.report bench_telemetry.json
+_TELEMETRY = None
+_TELE_PATH = None
+
+
+def _bind_telemetry_metrics():
+    """Load multiverso_tpu.telemetry.metrics WITHOUT importing jax: the
+    package __init__ pulls core -> jax, and pre-probe the bench parent
+    must stay off the jax import path entirely (the probe exists
+    because a wedged tunnel can hang anything touching the backend).
+    metrics.py is stdlib-only, so it is loaded by file path and
+    registered under its canonical module name — when the full package
+    imports later (post-probe), Python reuses this exact module object,
+    so probe-phase counters land in the same process registry."""
+    import importlib.util
+    name = "multiverso_tpu.telemetry.metrics"
+    if name in sys.modules:
+        return sys.modules[name]
+    path = os.path.join(HERE, "multiverso_tpu", "telemetry", "metrics.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_telemetry_snapshot() -> None:
+    if _TELEMETRY is not None:
+        try:
+            _TELEMETRY.write_snapshot(_TELE_PATH)
+        except OSError as e:     # diagnostics must never kill the bench
+            print(f"bench: telemetry snapshot failed: {e!r}",
+                  file=sys.stderr)
+
+
 def _probe_chip(timeout_s: float = 180.0, deadline_s: "float | None" = None,
                 retry_wait_s: float = 60.0, max_rc_failures: int = 5) -> None:
     """Wait out a wedged chip tunnel, up to a deadline.
@@ -247,12 +287,22 @@ def _probe_chip(timeout_s: float = 180.0, deadline_s: "float | None" = None,
                     print(f"bench: chip recovered on probe {attempt} "
                           f"after {time.monotonic() - t0:.0f}s",
                           file=sys.stderr)
+                if _TELEMETRY is not None:
+                    _TELEMETRY.counter("bench.probe.ok").inc()
+                    _write_telemetry_snapshot()
                 return
             failure = f"rc={proc.returncode}: {proc.stderr[-2000:]}"
             rc_failures += 1
+            if _TELEMETRY is not None:
+                _TELEMETRY.counter("bench.probe.rc_failures").inc()
         except subprocess.TimeoutExpired:
             failure = f"hang, killed after {timeout_s:.0f}s"
+            if _TELEMETRY is not None:
+                _TELEMETRY.counter("bench.probe.hangs").inc()
         elapsed = time.monotonic() - t0
+        if _TELEMETRY is not None:
+            _TELEMETRY.gauge("bench.probe.elapsed_s").set(elapsed)
+            _write_telemetry_snapshot()
         # A HANG is the documented wedge signature and worth waiting out
         # to the full deadline; a quick nonzero exit (e.g. the
         # fell-back-to-CPU assertion, a persistent plugin error) is
@@ -290,8 +340,22 @@ def main() -> None:
         os.environ.setdefault("MVTPU_LDA_K_TPU", "128")
         import jax as _jax
         _jax.config.update("jax_platforms", "cpu")
+    # telemetry spine: snapshot + trace artifacts live next to the
+    # BENCH_r0X captures (jax-free binding — see _bind_telemetry_metrics)
+    global _TELEMETRY, _TELE_PATH
+    import atexit
+    _TELEMETRY = _bind_telemetry_metrics()
+    _TELE_PATH = os.environ.get(
+        "MVTPU_BENCH_TELEMETRY",
+        os.path.join(HERE, "bench_telemetry.json"))
+    atexit.register(_write_telemetry_snapshot)
+    print(f"bench: telemetry -> {_TELE_PATH} (render with: python -m "
+          "multiverso_tpu.telemetry.report <path>)", file=sys.stderr)
     _probe_chip()
     import jax
+    from multiverso_tpu.telemetry import trace as telemetry_trace
+    telemetry_trace.set_trace_file(os.environ.get(
+        "MVTPU_BENCH_TRACE", os.path.join(HERE, "bench_trace.jsonl")))
     from multiverso_tpu import core
     from multiverso_tpu.apps.word_embedding import W2VConfig, WordEmbedding
 
@@ -422,6 +486,9 @@ def main() -> None:
     # mid-LDA (a hang, not an exception — observed), the w2v metrics
     # survive in the log tail instead of being lost with the process
     print(json.dumps(w2v_line), flush=True)
+    # snapshot NOW: if the LDA tier wedges the process, the w2v tier's
+    # table/op accounting is already on disk
+    _write_telemetry_snapshot()
 
     # free the w2v working set (10 staged ~46MB placement buffers + the
     # embedding tables) before the LDA tier allocates its own tables —

@@ -9,6 +9,7 @@ compiles as an on-device sharded optimizer step.
 """
 
 from multiverso_tpu.version import __version__
+from multiverso_tpu import telemetry
 from multiverso_tpu.core import (barrier, init, is_initialized, mesh,
                                  num_servers, num_workers, rank, server_id,
                                  shutdown, size, worker_id)
@@ -16,5 +17,5 @@ from multiverso_tpu.core import (barrier, init, is_initialized, mesh,
 __all__ = [
     "__version__", "barrier", "init", "is_initialized", "mesh",
     "num_servers", "num_workers", "rank", "server_id", "shutdown", "size",
-    "worker_id",
+    "telemetry", "worker_id",
 ]

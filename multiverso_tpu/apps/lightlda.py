@@ -79,11 +79,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from multiverso_tpu import core
+from multiverso_tpu import core, telemetry
 from multiverso_tpu.data.corpus import backend as data_backend
 from multiverso_tpu.tables import (ArrayTable, SparseMatrixTable,
                                    make_superstep)
-from multiverso_tpu.utils import dashboard, log
+from multiverso_tpu.utils import log
 
 
 @dataclasses.dataclass
@@ -565,7 +565,7 @@ class LightLDA:
         mp = self.mesh.shape[core.MODEL_AXIS]
         if mp == 1:
             return lambda mirror, w: jnp.take(mirror, w, axis=0)
-        from jax import shard_map
+        from multiverso_tpu.utils.jax_compat import shard_map
         d, m = core.DATA_AXIS, core.MODEL_AXIS
         vshard = self.word_topic.storage_shape[0] // mp
 
@@ -592,7 +592,7 @@ class LightLDA:
         psum'd over ICI."""
         if self.mesh.devices.size == 1:
             return fn
-        from jax import shard_map
+        from multiverso_tpu.utils.jax_compat import shard_map
         d = core.DATA_AXIS
         Pb = P(d)
         Pb3 = P(d, None, None)
@@ -612,7 +612,7 @@ class LightLDA:
         doc counts — the block layout IS the DP partition)."""
         if self.mesh.devices.size == 1:
             return fn
-        from jax import shard_map
+        from multiverso_tpu.utils.jax_compat import shard_map
         d = core.DATA_AXIS
         Pb = P(d)
 
@@ -633,7 +633,7 @@ class LightLDA:
         vocab slice, psum over the data axis. Shared by the per-sweep
         rebuild and the streamed master accumulator (one copy of the
         slice math). Returns f(z_flat, tw, msk) -> [V/mp, C, 128]."""
-        from jax import shard_map
+        from multiverso_tpu.utils.jax_compat import shard_map
         d, maxis = core.DATA_AXIS, core.MODEL_AXIS
         mp = self.mesh.shape[maxis]
         vshard = self.word_topic.storage_shape[0] // mp
@@ -846,7 +846,7 @@ class LightLDA:
         count array: z is the only sampler state)."""
         if self.mesh.devices.size == 1:
             return fn
-        from jax import shard_map
+        from multiverso_tpu.utils.jax_compat import shard_map
         d = core.DATA_AXIS
         Pb = P(d)
 
@@ -1563,7 +1563,12 @@ class LightLDA:
         t0 = time.perf_counter()
         ck_every = self.config.checkpoint_interval
         for it in range(iters):
-            self.sweep()
+            t_sweep = time.perf_counter()
+            with telemetry.span("lda.sweep"):
+                self.sweep()
+            telemetry.step_timeline(
+                "lda", it, tokens=self.num_tokens,
+                dispatch_s=time.perf_counter() - t_sweep)
             if ck_every > 0 and self.config.checkpoint_prefix \
                     and (it + 1) % ck_every == 0:
                 # periodic full-state dump (sampler state included, so
@@ -1576,8 +1581,9 @@ class LightLDA:
             log.info("lightlda iter %d: loglik/token=%.4f", it, ll)
         dt = time.perf_counter() - t0
         tokens = self.num_tokens * iters
-        dashboard.emit_metric("lda.doc_tokens_per_sec", tokens / dt,
-                              "tokens/s")
+        telemetry.counter("lda.tokens").inc(tokens)
+        telemetry.emit("lda.doc_tokens_per_sec", tokens / dt,
+                       "tokens/s")
         log.info("lightlda done: %d iters, %.0f doc-tokens/s",
                  iters, tokens / dt)
         return self.ll_history[-1] if self.ll_history else float("nan")

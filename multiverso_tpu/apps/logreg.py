@@ -25,8 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from functools import partial
-from typing import Iterator, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -34,10 +33,10 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from multiverso_tpu import core
+from multiverso_tpu import core, telemetry
 from multiverso_tpu.tables import ArrayTable, make_superstep
 from multiverso_tpu.updaters import AddOption
-from multiverso_tpu.utils import dashboard, log
+from multiverso_tpu.utils import log
 
 
 @dataclasses.dataclass
@@ -311,25 +310,37 @@ class LogisticRegression:
         full = [s for s in starts if s + c.minibatch_size <= n]
         tail = [s for s in starts if s + c.minibatch_size > n]
         S = max(c.steps_per_call, 1)
+        step_no = 0
         for g in range(0, len(full) - len(full) % S, S):
             grp = full[g:g + S]
             xs = np.stack([X[order[s:s + c.minibatch_size]] for s in grp])
             ys = np.stack([y[order[s:s + c.minibatch_size]] for s in grp])
             xd, yd = self._shard_scan(xs, ys)
-            with dashboard.profile("logreg.superstep"):
+            t_step = time.perf_counter()
+            with telemetry.span("logreg.superstep"):
                 _, lg = self._fused_scan((), xd, yd)
+            telemetry.step_timeline(
+                "logreg", step_no, samples=S * c.minibatch_size,
+                dispatch_s=time.perf_counter() - t_step)
+            step_no += 1
             losses.extend(lg)
         for s in full[len(full) - len(full) % S:] + tail:
             idx = order[s:s + c.minibatch_size]
             xs, ys = self._shard_batch(X[idx], y[idx])
-            with dashboard.profile("logreg.step"):
+            t_step = time.perf_counter()
+            with telemetry.span("logreg.step"):
                 _, loss = self._fused((), xs, ys)
+            telemetry.step_timeline(
+                "logreg", step_no, samples=len(idx),
+                dispatch_s=time.perf_counter() - t_step)
+            step_no += 1
             losses.append(loss)
         # one transfer for all loss scalars (a tunneled TPU charges
         # ~100ms per individual scalar fetch)
         mean_loss = float(np.asarray(jnp.stack(losses)).mean())
         dt = time.perf_counter() - t0
-        dashboard.emit_metric("logreg.samples_per_sec", n / dt, "samples/s")
+        telemetry.counter("logreg.samples").inc(n)
+        telemetry.emit("logreg.samples_per_sec", n / dt, "samples/s")
         log.info("logreg epoch done: loss=%.4f %.0f samples/s",
                  mean_loss, n / dt)
         return mean_loss

@@ -30,19 +30,18 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from functools import partial
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from multiverso_tpu import core
+from multiverso_tpu import core, telemetry
 from multiverso_tpu.apps.logreg import _parse_libsvm
 from multiverso_tpu.tables import KVTable
 from multiverso_tpu.tables.matrix_table import _bucket
 from multiverso_tpu.updaters import AddOption
-from multiverso_tpu.utils import dashboard, log
+from multiverso_tpu.utils import log
 
 BIAS_KEY = np.uint64(0xB1A5B1A5B1A5B1A5)
 
@@ -204,19 +203,26 @@ class SparseLogisticRegression:
         n = len(rows)
         loss = float("nan")
         t0 = time.perf_counter()
+        step_no = 0
         for e in range(c.epochs):
             order = np.random.default_rng(c.seed + e).permutation(n)
             losses = []
             for s in range(0, n, c.minibatch_size):
                 idx = order[s:s + c.minibatch_size]
-                with dashboard.profile("sparse_logreg.step"):
+                t_step = time.perf_counter()
+                with telemetry.span("sparse_logreg.step"):
                     losses.append(self.train_batch(
                         [rows[i] for i in idx], y[idx]))
+                telemetry.step_timeline(
+                    "sparse_logreg", step_no, samples=len(idx),
+                    dispatch_s=time.perf_counter() - t_step)
+                step_no += 1
             loss = float(np.mean(losses))
             log.info("sparse_logreg epoch %d: loss=%.4f", e, loss)
         dt = time.perf_counter() - t0
-        dashboard.emit_metric("sparse_logreg.samples_per_sec",
-                              n * c.epochs / dt, "samples/s")
+        telemetry.counter("sparse_logreg.samples").inc(n * c.epochs)
+        telemetry.emit("sparse_logreg.samples_per_sec",
+                       n * c.epochs / dt, "samples/s")
         return loss
 
     # -- inference ---------------------------------------------------------

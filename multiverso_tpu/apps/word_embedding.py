@@ -46,10 +46,10 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from multiverso_tpu import core
+from multiverso_tpu import core, telemetry
 from multiverso_tpu.data.corpus import Corpus
 from multiverso_tpu.tables import MatrixTable, make_superstep
-from multiverso_tpu.utils import dashboard, log
+from multiverso_tpu.utils import log
 
 
 @dataclasses.dataclass
@@ -519,7 +519,8 @@ class WordEmbedding:
         pairs_done = call_no * c.steps_per_call * c.batch_size
         est_ppt = (c.window + 1) if c.model == "skipgram" else 1.0
         words = pairs_done / est_ppt
-        dashboard.emit_metric("w2v.words_per_sec", words / dt, "words/s")
+        telemetry.counter("w2v.pairs").inc(pairs_done)
+        telemetry.emit("w2v.words_per_sec", words / dt, "words/s")
         # ONE device->host transfer for the whole loss list: per-scalar
         # fetches cost ~100ms each over a tunneled TPU (trace-measured)
         self.loss_history = [float(l) for l in
@@ -548,9 +549,12 @@ class WordEmbedding:
             .astype(np.float32)
         key = jax.random.fold_in(self._key, call_no)
         pd = self._place(srcs, tgts)
-        with dashboard.profile("w2v.superstep"):
+        t_step = time.perf_counter()
+        with telemetry.span("w2v.superstep"):
             _, loss = self._fused((), pd, key,
                                   core.place(lrs, mesh=self.mesh))
+        telemetry.step_timeline("w2v", call_no, pairs=s * c.batch_size,
+                                dispatch_s=time.perf_counter() - t_step)
         self._step_no += s
         return loss
 

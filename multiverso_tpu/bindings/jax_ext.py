@@ -19,7 +19,7 @@ sharedvar.py` and `.../lasagne_ext/param_manager.py` — SURVEY.md §3.5 /
 from __future__ import annotations
 
 import threading
-from typing import Any, List, Optional, Sequence
+from typing import Any, List, Optional
 
 import jax
 import numpy as np

@@ -29,12 +29,14 @@ from __future__ import annotations
 
 import atexit
 import threading
-from typing import Optional, Sequence, Tuple
+import time
+from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from multiverso_tpu.telemetry import metrics as telemetry
 from multiverso_tpu.utils import configure, log
 
 DATA_AXIS = "data"
@@ -140,6 +142,16 @@ def init(argv: Optional[Sequence[str]] = None, *,
             else configure.get_flag("model_parallel")
         _RT.mesh = _build_mesh(devs, dp, mp)
         _RT.initialized = True
+        # topology on the record: one registry snapshot then identifies
+        # the mesh shape a run's per-table byte counts came from
+        telemetry.counter("core.init.ops").inc()
+        telemetry.gauge("core.devices").set(len(devs))
+        telemetry.gauge("core.data_parallel").set(
+            _RT.mesh.shape[DATA_AXIS])
+        telemetry.gauge("core.model_parallel").set(
+            _RT.mesh.shape[MODEL_AXIS])
+        telemetry.gauge("core.processes").set(jax.process_count())
+        telemetry.gauge("core.process_index").set(jax.process_index())
         log.info("multiverso_tpu.init: %d devices, mesh data=%d model=%d, "
                  "process %d/%d", len(devs), _RT.mesh.shape[DATA_AXIS],
                  _RT.mesh.shape[MODEL_AXIS], jax.process_index(),
@@ -245,10 +257,16 @@ def barrier(name: Optional[str] = None) -> None:
     """
     m = mesh()
     _RT.barrier_count += 1
+    t0 = time.perf_counter()
     ones = jax.device_put(
         np.zeros((len(m.devices.flat),), np.int32),
         NamedSharding(m, P((DATA_AXIS, MODEL_AXIS))))
     _barrier_sum(ones).block_until_ready()
+    # barrier latency IS the straggler signal on a multi-host mesh: the
+    # collective completes only when the slowest host dispatches it
+    telemetry.counter("core.barrier.ops").inc()
+    telemetry.histogram("core.barrier.seconds").observe(
+        time.perf_counter() - t0)
 
 
 # -- Topology queries (reference MV_* names, SURVEY.md §3.5) ---------------

@@ -25,22 +25,15 @@ def backend():
     return native if native is not None else PyData()
 
 
-_warned_auto_threads = False
-
-
 def default_gen_threads() -> int:
     """Worker count for native pair generation: MVTPU_GEN_THREADS, else
-    the host's core count (the reference word2vec spawns one generator
-    per core the same way). On a 1-core host this resolves to 1 — the
-    threaded path costs nothing where it can't help.
-
-    Determinism scope: the pair stream is reproducible for a given
-    (seed, thread count). When the count is auto-resolved from the host,
-    identical seeds on hosts with different core counts produce
-    different (equally valid) streams — pin ``gen_threads=`` or
-    MVTPU_GEN_THREADS for cross-host bit-reproducibility. Auto-resolving
-    to >1 logs a one-time notice so the scoping is never silent."""
-    global _warned_auto_threads
+    ONE. Single-threaded is the default on purpose — the pair stream is
+    reproducible for a given (seed, thread count), so a default that
+    auto-resolved from the host core count made identical seeds on
+    different hosts produce different (equally valid) streams.
+    Multi-threaded generation is opt-in: set MVTPU_GEN_THREADS (or pass
+    ``gen_threads=``) when the host has cores to spend and cross-host
+    bit-reproducibility is pinned by the explicit count."""
     env = os.environ.get("MVTPU_GEN_THREADS")
     if env:
         try:
@@ -48,16 +41,8 @@ def default_gen_threads() -> int:
         except ValueError:
             from multiverso_tpu.utils import log
             log.warn("ignoring malformed MVTPU_GEN_THREADS=%r; "
-                     "auto-resolving from the core count", env)
-    threads = max(1, os.cpu_count() or 1)
-    if threads > 1 and not _warned_auto_threads:
-        _warned_auto_threads = True
-        from multiverso_tpu.utils import log
-        log.info("pair generation auto-resolved to %d threads; the pair "
-                 "stream is (seed, threads)-scoped — pin gen_threads or "
-                 "MVTPU_GEN_THREADS for cross-host reproducibility",
-                 threads)
-    return threads
+                     "defaulting to single-threaded generation", env)
+    return 1
 
 
 class Corpus:
@@ -129,9 +114,10 @@ class Corpus:
     @staticmethod
     def _resolve_gen_threads(be, gen_threads: Optional[int]) -> int:
         """Thread count for the block pipeline. The Python fallback is
-        GIL-bound and ignores threads — resolve to 1 there so the
-        (seed, threads) determinism notice is never logged for a
-        backend whose stream doesn't vary with thread count."""
+        GIL-bound and ignores threads — resolve to 1 there; otherwise
+        an explicit ``gen_threads`` wins, else the deterministic
+        default (:func:`default_gen_threads`: 1 unless
+        MVTPU_GEN_THREADS opts in)."""
         if isinstance(be, PyData):
             return 1
         if gen_threads is not None:

@@ -37,6 +37,31 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _SO_PATH = os.path.join(_REPO_ROOT, "native", "build", "libmvtpu_data.so")
 
+_warned_cap_fallback = set()
+
+
+def _warn_mt_cap_fallback(fn: str, n: int, threads: int, cap: int,
+                          chunk_worst) -> None:
+    """Surface the silent C-side mt→single-thread fallback: the native
+    multi-threaded fill runs chunked only when ``cap`` holds every
+    chunk's worst case (``chunk_worst(chunk_len)`` summed over the
+    C's contiguous split, mirrored here) — otherwise it silently takes
+    the single-thread path, which changes the (seed, threads)-scoped
+    pair stream the caller asked for. Logged once per entry point."""
+    if threads <= 1 or n <= 0 or fn in _warned_cap_fallback:
+        return
+    t_eff = min(threads, n)
+    if t_eff <= 1:
+        return
+    need = sum(chunk_worst(n * (t + 1) // t_eff - n * t // t_eff)
+               for t in range(t_eff))
+    if cap < need:
+        _warned_cap_fallback.add(fn)
+        log.warn("%s: cap=%d < %d (the %d-thread chunked worst case) — "
+                 "native generation falls back to the SINGLE-thread "
+                 "stream; raise cap or drop gen_threads to 1 to make "
+                 "the stream scope explicit", fn, cap, need, t_eff)
+
 
 @dataclass
 class CorpusData:
@@ -161,6 +186,9 @@ class NativeData:
         ids = np.ascontiguousarray(ids, np.int32)
         if cap is None:
             cap = 2 * window * len(ids) + 16 * max(threads, 1)
+        else:
+            _warn_mt_cap_fallback("skipgram_pairs", len(ids), threads,
+                                  cap, lambda ln: 2 * window * ln + 16)
         centers = np.empty(cap, np.int32)
         contexts = np.empty(cap, np.int32)
         kp = None
@@ -185,6 +213,9 @@ class NativeData:
         ids = np.ascontiguousarray(ids, np.int32)
         if cap is None:
             cap = len(ids) + 16 * max(threads, 1)
+        else:
+            _warn_mt_cap_fallback("cbow_examples", len(ids), threads,
+                                  cap, lambda ln: ln + 16)
         width = 2 * window
         contexts = np.empty((cap, width), np.int32)
         targets = np.empty(cap, np.int32)

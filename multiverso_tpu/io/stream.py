@@ -32,6 +32,8 @@ import io
 import os
 from typing import BinaryIO, Callable, Dict, Tuple
 
+from multiverso_tpu.telemetry import metrics as telemetry
+
 Stream = BinaryIO
 
 _OpenFn = Callable[[str, str], Stream]
@@ -182,32 +184,65 @@ class _FsspecAtomicWrite:
         return self._f.write(b)
 
     def close(self) -> None:
-        if not self._f.closed:
-            self._f.close()
-            try:
-                self._fs.mv(self._tmp, self._final)
-            except Exception:
-                # hdfs-like backends refuse a move onto an existing
-                # destination (object stores and local overwrite
-                # silently). Only treat the failure as that conflict
-                # when the destination actually exists — a transient
-                # backend error must NOT delete the last good
-                # checkpoint.
-                if not self._fs.exists(self._final):
-                    raise
+        if self._f.closed:
+            return
+        self._f.close()
+        try:
+            self._fs.mv(self._tmp, self._final)
+            return
+        except Exception:
+            # hdfs-like backends refuse a move onto an existing
+            # destination (object stores and local overwrite silently).
+            # Only treat the failure as that conflict when the
+            # destination actually exists — a transient backend error
+            # must NOT disturb the last good checkpoint. Either way the
+            # temp object must not leak on the remote store.
+            telemetry.counter("io.write.retries").inc()
+            if not self._fs.exists(self._final):
+                self._rm_quiet(self._tmp)
+                raise
+        # Overwrite path: move the existing good checkpoint ASIDE
+        # (final -> final.bak), never delete it — an rm-then-mv leaves a
+        # window where a crash or second failure loses the only copy.
+        bak = f"{self._final}.bak"
+        self._rm_quiet(bak)            # stale .bak from a prior cycle
+        try:
+            self._fs.mv(self._final, bak)
+            moved_aside = True
+        except Exception:
+            # couldn't move aside (e.g. a concurrent rank already did,
+            # or just landed a fresh final) — fall through and let the
+            # final-exists check below decide
+            moved_aside = False
+        try:
+            self._fs.mv(self._tmp, self._final)
+        except Exception:
+            restored = False
+            if moved_aside:
                 try:
-                    self._fs.rm(self._final)
-                    self._fs.mv(self._tmp, self._final)
+                    # restore the last good checkpoint
+                    self._fs.mv(bak, self._final)
+                    restored = True
                 except Exception:
-                    # collective same-path stores write IDENTICAL
-                    # payloads: if a concurrent rank just landed the
-                    # file, accept theirs and drop our temp
-                    if not self._fs.exists(self._final):
-                        raise
-                    try:
-                        self._fs.rm(self._tmp)
-                    except Exception:
-                        pass
+                    from multiverso_tpu.utils import log
+                    log.error(
+                        "checkpoint overwrite failed AND restore "
+                        "failed: last good payload is at %r", bak)
+            self._rm_quiet(self._tmp)
+            # collective same-path stores write IDENTICAL payloads: if
+            # a concurrent rank just landed the file (and we did not
+            # put the OLD one back ourselves), accept theirs
+            if restored or not self._fs.exists(self._final):
+                raise
+            return
+        if moved_aside:
+            self._rm_quiet(bak)
+
+    def _rm_quiet(self, path: str) -> None:
+        try:
+            self._fs.rm(path)
+        except Exception:
+            pass
 
     @property
     def closed(self):
@@ -238,18 +273,85 @@ def _open_fsspec(uri: str, mode: str) -> Stream:
     return fsspec.open(uri, mode).open()
 
 
+class _CountingStream:
+    """Transparent byte-accounting wrapper over any stream: read/write
+    byte counts land in the telemetry registry per scheme on close (one
+    counter update per stream, not per call), so checkpoint traffic —
+    `io.{read,write}.bytes` — is on every registry snapshot. Delegates
+    everything else (incl. close-time publication semantics: mem://
+    store commit, atomic renames) to the wrapped stream."""
+
+    def __init__(self, inner, scheme: str) -> None:
+        self._inner = inner
+        self._scheme = scheme
+        self._r = 0
+        self._w = 0
+        self._counted = False
+
+    def read(self, *args):
+        b = self._inner.read(*args)
+        self._r += len(b)
+        return b
+
+    def write(self, b):
+        n = self._inner.write(b)
+        self._w += n if isinstance(n, int) else len(b)
+        return n
+
+    def _flush_counts(self) -> None:
+        if self._counted:
+            return
+        self._counted = True
+        telemetry.counter("io.open.ops", scheme=self._scheme).inc()
+        if self._r:
+            telemetry.counter("io.read.bytes",
+                              scheme=self._scheme).inc(self._r)
+        if self._w:
+            telemetry.counter("io.write.bytes",
+                              scheme=self._scheme).inc(self._w)
+
+    def close(self) -> None:
+        self._inner.close()
+        self._flush_counts()
+
+    @property
+    def closed(self):
+        return self._inner.closed
+
+    def __enter__(self):
+        enter = getattr(self._inner, "__enter__", None)
+        if enter is not None:
+            enter()
+        return self
+
+    def __exit__(self, *exc):
+        ex = getattr(self._inner, "__exit__", None)
+        if ex is not None:
+            result = ex(*exc)
+        else:
+            self._inner.close()
+            result = False
+        self._flush_counts()
+        return result
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
 def open_stream(uri: str, mode: str = "rb") -> Stream:
     """Open a binary stream for a URI (``file://path`` or a bare path).
 
     Native schemes (``file``, ``mem``, anything passed to
     :func:`register_scheme`) take precedence; any other scheme fsspec
-    recognises falls back to ``fsspec.open`` (see module docstring)."""
+    recognises falls back to ``fsspec.open`` (see module docstring).
+    Every stream is wrapped for telemetry byte accounting
+    (:class:`_CountingStream`)."""
     scheme, path = _split_uri(uri)
     open_fn = _SCHEMES.get(scheme)
     if open_fn is not None:
-        return open_fn(path, mode)
+        return _CountingStream(open_fn(path, mode), scheme)
     if _fsspec_knows(scheme):
-        return _open_fsspec(uri, mode)
+        return _CountingStream(_open_fsspec(uri, mode), scheme)
     raise ValueError(
         f"unsupported stream scheme {scheme!r} in {uri!r}; "
         f"registered: {sorted(_SCHEMES)} (+ fsspec protocols)")

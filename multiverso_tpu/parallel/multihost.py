@@ -30,6 +30,29 @@ def allgather_i64(vals) -> np.ndarray:
         | (g[:, 1].astype(np.int64) & np.int64(0xFFFFFFFF))
 
 
+def allgather_bytes(payload: bytes) -> List[bytes]:
+    """process_allgather of an arbitrary byte string: every process
+    passes its own payload, every process receives all P payloads in
+    rank order. Lengths travel first (x64-safe via allgather_i64), then
+    the payloads padded to the max length as uint8. Single-process:
+    ``[payload]`` with no collective dispatched.
+
+    COLLECTIVE — all processes must call in lockstep. Used by
+    :func:`multiverso_tpu.telemetry.aggregate.gather_metrics` to ship
+    per-host registry snapshots."""
+    import jax
+    payload = bytes(payload)
+    if jax.process_count() == 1:
+        return [payload]
+    from jax.experimental import multihost_utils
+    lens = allgather_i64(np.array([len(payload)], np.int64))[:, 0]
+    mx = int(lens.max())
+    buf = np.zeros(max(mx, 1), np.uint8)
+    buf[:len(payload)] = np.frombuffer(payload, np.uint8)
+    g = np.asarray(multihost_utils.process_allgather(buf))  # [P, mx]
+    return [g[i, :int(n)].tobytes() for i, n in enumerate(lens)]
+
+
 def validate_single_owner(mask: np.ndarray, what: str) -> None:
     """Every lane owned by exactly one process, or raise. ``mask`` is
     this process's 0/1 ownership vector over the lane space."""

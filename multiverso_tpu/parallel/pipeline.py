@@ -24,7 +24,6 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -46,6 +45,12 @@ def pipeline_apply(stage_params: Any, x: jax.Array,
         and head layers live outside the pipelined trunk).
       x: [B, ...] global batch; B must divide by ``microbatches``.
       stage_fn: ``(params_s, h) -> h``; traced once per device.
+        CONSTRAINT: must be finite — in value and in gradient — on the
+        INPUT distribution (``x_mb`` microbatches): bubble ticks run it
+        on the current input microbatch as a safe dummy (double-where;
+        the result is discarded, but a non-finite vjp would survive the
+        output mask and poison ``jax.grad``). It need NOT be finite on
+        zeros or stale activations — those never reach it.
       microbatches: schedule depth M (default: the axis size — the
         minimum that fills the pipeline; larger M lowers the bubble
         fraction (S-1)/(S-1+M) at constant memory per tick).
@@ -88,7 +93,18 @@ def pipeline_apply(stage_params: Any, x: jax.Array,
             # consume the activation the previous tick shifted in
             inp = jnp.where(me == 0,
                             x_mb[jnp.clip(t, 0, m - 1)], act)
-            h = stage_fn(params, inp)
+            # Double-where guard (the where-grad trap): during bubble
+            # ticks ``inp`` is a zero/stale activation; if stage_fn is
+            # non-finite there (log, rsqrt, division), its NaN/Inf
+            # cotangent survives the output mask (0 * inf = nan inside
+            # the vjp) and poisons jax.grad of the whole schedule. So
+            # stage_fn only ever sees known-good data: bubble ticks feed
+            # the current input microbatch (real data — stage_fn must be
+            # finite, in value AND grad, on the input distribution; see
+            # the docstring constraint), and the discarded result is
+            # masked out below as before.
+            safe_inp = jnp.where(valid, inp, x_mb[jnp.clip(t, 0, m - 1)])
+            h = stage_fn(params, safe_inp)
             h = jnp.where(valid, h, inp)
             # the last stage deposits the finished microbatch
             out = lax.cond(
@@ -111,7 +127,7 @@ def pipeline_apply(stage_params: Any, x: jax.Array,
         lambda leaf: P(*((axis,) + (None,) * (leaf.ndim - 1))),
         stage_params)
     x_spec = P(*((None,) * x_mb.ndim))
-    from jax import shard_map
+    from multiverso_tpu.utils.jax_compat import shard_map
     return shard_map(local, mesh=mesh, in_specs=(param_specs, x_spec),
                      out_specs=P(*((None,) * x.ndim)),
                      check_vma=False)(stage_params, x_mb)

@@ -23,14 +23,13 @@ return the same global layout — drop-in for a dense attention call.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from multiverso_tpu import core
 
@@ -125,7 +124,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
     spec = P(None, axis, None, None)
-    from jax import shard_map
+    from multiverso_tpu.utils.jax_compat import shard_map
     return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec, check_vma=False)(q, k, v)
 
@@ -166,6 +165,6 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         return bwd(o)
 
     spec = P(None, axis, None, None)
-    from jax import shard_map
+    from multiverso_tpu.utils.jax_compat import shard_map
     return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec, check_vma=False)(q, k, v)

@@ -40,6 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from multiverso_tpu import core
 from multiverso_tpu.io import open_stream
+from multiverso_tpu.telemetry import metrics as telemetry
 from multiverso_tpu.updaters import (AddOption, Updater, get_updater,
                                      resolve_default_option)
 from multiverso_tpu.utils import configure, log
@@ -250,6 +251,17 @@ class Table:
 
     # -- helpers -----------------------------------------------------------
 
+    def _record_op(self, op: str, elems: int, nbytes: int) -> None:
+        """Per-table op accounting: ``table.<op>.{ops,elems,bytes}``
+        keyed by table id (the telemetry spine's hot-path
+        instrumentation — counts what the Get/Add/Store/Load contract
+        actually moved). Shared by KVTable (not a subclass) via
+        unbound-method assignment — only needs table_id + name."""
+        lbl = f"{self.table_id}:{self.name}"
+        telemetry.counter(f"table.{op}.ops", table=lbl).inc()
+        telemetry.counter(f"table.{op}.elems", table=lbl).inc(int(elems))
+        telemetry.counter(f"table.{op}.bytes", table=lbl).inc(int(nbytes))
+
     def _pad_lead(self, lead: int, shards: int) -> int:
         return -(-lead // shards) * shards
 
@@ -308,6 +320,9 @@ class Table:
         Returns a fresh buffer: ``add`` donates the param buffer, so a
         zero-copy view would be invalidated by the next update.
         """
+        elems = int(np.prod(self.logical_shape)) if self.logical_shape \
+            else 1
+        self._record_op("get", elems, elems * self.dtype.itemsize)
         return self._snapshot(self.param)
 
     def get(self) -> np.ndarray:
@@ -342,6 +357,9 @@ class Table:
             # re-tiled storage layouts (SparseMatrixTable tiled=True):
             # same elements, physical tile-aligned shape
             delta = delta.reshape(self.storage_shape)
+        elems = int(np.prod(self.logical_shape)) if self.logical_shape \
+            else 1
+        self._record_op("add", elems, elems * self.dtype.itemsize)
         opt = self._resolve_option(option)
         self.param, self.state = self._apply(self.param, self.state,
                                              delta, opt)
@@ -409,6 +427,8 @@ class Table:
             model_sh = jax.tree.map(lambda _: self.sharding, state)
             state = jax.jit(lambda s: s, out_shardings=model_sh)(state)
         manifest["n_state_leaves"] = pack_state(state, payload)
+        self._record_op("store", payload["param"].size,
+                        sum(a.nbytes for a in payload.values()))
         savez_stream(uri, manifest, payload)
 
     def load(self, uri: str) -> None:
@@ -430,10 +450,15 @@ class Table:
                 arr = np.pad(arr, pad)
             return arr.astype(want_dtype)
 
+        n_leaves = int(manifest["n_state_leaves"])
+        self._record_op("load", data["param"].size,
+                        data["param"].nbytes + sum(
+                            data[f"state_{i}"].nbytes
+                            for i in range(n_leaves)))
         self._install_param(repad(data["param"], self.padded_shape,
                                   self.dtype))
         self.state = unpack_state(
-            data, manifest["n_state_leaves"], self.state,
+            data, n_leaves, self.state,
             lambda leaf, tmpl: jax.device_put(
                 repad(leaf, tmpl.shape, tmpl.dtype), self.state_sharding))
         self.default_option.step = int(manifest.get("step", 0))

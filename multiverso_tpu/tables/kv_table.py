@@ -276,12 +276,18 @@ class KVTable:
 
     # -- API ---------------------------------------------------------------
 
+    # per-table op accounting, shared with the dense Table hierarchy
+    # (KVTable is contract-compatible, not a subclass)
+    _record_op = Table._record_op
+
     def get(self, keys) -> Tuple[np.ndarray, np.ndarray]:
         """Batched lookup → (values, found_mask). Missing keys yield
         ``default_value`` (the reference's KV semantics: absent = initial
         value)."""
         self._check_overflow()
         keys = self._check_keys(keys)
+        elems = len(keys) * max(self.value_dim, 1)
+        self._record_op("get", elems, elems * self.dtype.itemsize)
         buckets = self._buckets_of(keys)
         vals, found = self._lookup(
             self.keys, self.values,
@@ -310,6 +316,8 @@ class KVTable:
         want = (len(keys), self.value_dim) if self.value_dim else (len(keys),)
         if deltas.shape != want:
             raise ValueError(f"deltas shape {deltas.shape} != {want}")
+        self._record_op("add", deltas.size,
+                        deltas.size * self.dtype.itemsize)
 
         buckets = self._buckets_of(keys)
         opt = (option or self.default_option).as_jax(self.mesh)
@@ -365,6 +373,8 @@ class KVTable:
         # every rank writes (per-process targets need their own copy);
         # shared-path safety comes from the stream layer's atomic rename
         # — same rationale as tables/base.py store
+        self._record_op("store", payload["values"].size,
+                        sum(a.nbytes for a in payload.values()))
         savez_stream(uri, manifest, payload)
 
     def load(self, uri: str) -> None:
@@ -412,6 +422,8 @@ class KVTable:
         # (missing state leaf, placement failure) must leave the live
         # table consistent — geometry fields changing ahead of the
         # arrays would make get()/add() silently address wrong slots
+        self._record_op("load", data["values"].size,
+                        data["keys"].nbytes + data["values"].nbytes)
         self.keys, self.values, self.state = keys_dev, vals_dev, state_dev
         if new_buckets != self.num_buckets:
             log.warn(

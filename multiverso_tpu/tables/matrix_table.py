@@ -25,14 +25,13 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Any, Optional, Tuple
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from multiverso_tpu import core
 from multiverso_tpu.tables.base import Handle, Table
 from multiverso_tpu.updaters import AddOption
 
@@ -143,12 +142,16 @@ class MatrixTable(Table):
         ids = np.asarray(row_ids, dtype=np.int32)
         self._check_ids(ids)
         padded, _, n = self._pad_ids(ids)
+        self._record_op("get", n * self.num_cols,
+                        n * self.num_cols * self.dtype.itemsize)
         return np.asarray(self._gather_rows(self.param, padded))[:n]
 
     def get_rows_async(self, row_ids) -> Handle:
         ids = np.asarray(row_ids, dtype=np.int32)
         self._check_ids(ids)
         padded, _, n = self._pad_ids(ids)
+        self._record_op("get", n * self.num_cols,
+                        n * self.num_cols * self.dtype.itemsize)
         return Handle(self._gather_rows(self.param, padded)[:n])
 
     def add_rows(self, row_ids, deltas, option: Optional[AddOption] = None,
@@ -166,6 +169,8 @@ class MatrixTable(Table):
         if deltas.shape != (len(ids), self.num_cols):
             raise ValueError(f"deltas shape {deltas.shape} != "
                              f"({len(ids)}, {self.num_cols})")
+        self._record_op("add", deltas.size,
+                        deltas.size * self.dtype.itemsize)
         if self.updater.name == "default":
             padded, _, _, pd = self._pad_ids(ids, deltas)
             self.param = self._scatter_add(self.param, padded, pd)

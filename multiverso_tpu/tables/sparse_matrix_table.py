@@ -192,6 +192,7 @@ class SparseMatrixTable(MatrixTable):
             raise ValueError(f"col ids out of range [0, {self.num_cols})")
 
         n = len(rows)
+        self._record_op("add", n, n * self.dtype.itemsize)
         b = _bucket(n)
         prows = np.full(b, self._scratch_row, dtype=np.int32)
         pcols = np.zeros(b, dtype=np.int32)
@@ -240,4 +241,6 @@ class SparseMatrixTable(MatrixTable):
         ri, ci = np.nonzero(vals != 0)
         ecols = cols[ri, ci]
         order = np.lexsort((ecols, ri))
+        self._record_op("get", len(ecols),
+                        len(ecols) * self.dtype.itemsize)
         return indptr, ecols[order], vals[ri, ci][order]

@@ -103,6 +103,17 @@ class FusedSuperstep:
         for t, p, s in zip(self.tables, new_params, new_states):
             t.param = p
             t.state = s
+            # a fused dispatch IS one Get -> train -> Add round-trip per
+            # table (SURVEY §4.2/§4.3), so it lands in the same per-table
+            # accounting the plain get()/add() paths record — apps that
+            # only ever train through supersteps (all of them) still show
+            # table.get/add bytes on every registry snapshot
+            elems = 1
+            for d in t.logical_shape:
+                elems *= int(d)
+            nbytes = elems * t.dtype.itemsize
+            t._record_op("get", elems, nbytes)
+            t._record_op("add", elems, nbytes)
             gen = t._bump_step()
             if t is self.tables[0]:
                 # mint from the returned generation (racing with

@@ -1,0 +1,41 @@
+"""Telemetry spine (PAPER/SURVEY §6.1: per-step wall-clock dashboard +
+profiler hooks): typed metrics, span tracing, multihost aggregation,
+and a report CLI.
+
+- :mod:`multiverso_tpu.telemetry.metrics` — Counter/Gauge/Histogram in
+  a process-wide registry; JSONL event sink (``MVTPU_METRICS_JSONL``),
+  JSON snapshots, Prometheus text export.
+- :mod:`multiverso_tpu.telemetry.trace` — nestable :func:`span` context
+  manager + per-superstep :func:`step_timeline`, JSONL trace files
+  (``MVTPU_TRACE_JSONL`` / ``MVTPU_TRACE_DIR``), ``jax.named_scope``
+  composition.
+- :mod:`multiverso_tpu.telemetry.aggregate` — :func:`gather_metrics` /
+  :func:`fleet_snapshot` all-gather per-host snapshots through the mesh
+  (single-host fallback: local only).
+- ``python -m multiverso_tpu.telemetry.report <file>`` — render any
+  telemetry artifact as a table.
+
+The legacy ``utils.dashboard`` API (``profile`` / ``emit_metric`` /
+``report``) keeps working as a shim over this registry.
+"""
+
+from multiverso_tpu.telemetry import aggregate, metrics, trace
+from multiverso_tpu.telemetry.aggregate import (fleet_snapshot,
+                                                gather_metrics,
+                                                merge_snapshots)
+from multiverso_tpu.telemetry.metrics import (Counter, Gauge, Histogram,
+                                              MetricRegistry, counter,
+                                              emit, gauge, histogram,
+                                              registry, snapshot,
+                                              write_snapshot)
+from multiverso_tpu.telemetry.trace import (read_trace, set_trace_file,
+                                            span, step_timeline)
+
+__all__ = [
+    "aggregate", "metrics", "trace",
+    "Counter", "Gauge", "Histogram", "MetricRegistry",
+    "counter", "gauge", "histogram", "emit", "registry",
+    "snapshot", "write_snapshot",
+    "span", "step_timeline", "set_trace_file", "read_trace",
+    "gather_metrics", "merge_snapshots", "fleet_snapshot",
+]

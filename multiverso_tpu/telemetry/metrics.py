@@ -1,0 +1,284 @@
+"""Typed metrics: Counter / Gauge / Histogram in a process-wide registry.
+
+The observability spine the reference never had (its Dashboard is a
+count/total accumulator — SURVEY.md §3.7): instrumented code records
+*what happened* (ops, elements, bytes, latencies) into typed metric
+objects keyed by name + labels, and the registry exports the whole
+state three ways:
+
+- :meth:`MetricRegistry.snapshot` — a JSON-safe dict (the interchange
+  format: written to disk by :meth:`write_snapshot`, shipped across
+  hosts by :func:`multiverso_tpu.telemetry.aggregate.gather_metrics`,
+  rendered by ``python -m multiverso_tpu.telemetry.report``),
+- :meth:`MetricRegistry.to_prometheus` — a Prometheus-style text
+  exposition (scrape-friendly; no client library needed),
+- a JSONL event sink (``MVTPU_METRICS_JSONL`` or :meth:`set_jsonl`) —
+  the same record shape the Dashboard's ``emit_metric`` always wrote,
+  so existing scrapers keep working.
+
+Pure stdlib on purpose: imported by the hot paths (tables, core, io),
+so it must never drag jax/numpy into module import, and must stay
+importable in the report CLI with no accelerator present.
+
+Histogram buckets are FIXED at creation (monotone upper bounds with an
+implicit +inf overflow bucket) — snapshots merge across hosts by
+bucket-wise addition, which only works when every host agrees on the
+bounds; the defaults are latency-shaped (seconds, 100µs..100s).
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, TextIO, Tuple
+
+SNAPSHOT_KIND = "mvtpu.metrics.v1"
+
+# latency-shaped default bounds (seconds): 100µs .. 100s, half-decade
+DEFAULT_BUCKETS = (1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 1e-1, 3e-1,
+                   1.0, 3.0, 10.0, 30.0, 100.0)
+
+LabelItems = Tuple[Tuple[str, str], ...]
+
+
+def _label_items(labels: Dict[str, object]) -> LabelItems:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def metric_key(name: str, labels: LabelItems) -> str:
+    """Canonical flat key: ``name`` or ``name{k=v,k2=v2}`` (sorted)."""
+    if not labels:
+        return name
+    return name + "{" + ",".join(f"{k}={v}" for k, v in labels) + "}"
+
+
+class Counter:
+    """Monotone accumulator (ops, elements, bytes)."""
+
+    def __init__(self, name: str, labels: LabelItems = ()) -> None:
+        self.name = name
+        self.labels = labels
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name!r}: inc({n}) < 0")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins level (device counts, current throughput)."""
+
+    def __init__(self, name: str, labels: LabelItems = ()) -> None:
+        self.name = name
+        self.labels = labels
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket distribution (latencies). ``bounds`` are inclusive
+    upper edges; observations above the last bound land in the implicit
+    overflow bucket (``counts`` has ``len(bounds) + 1`` entries)."""
+
+    def __init__(self, name: str, labels: LabelItems = (),
+                 bounds: Tuple[float, ...] = DEFAULT_BUCKETS) -> None:
+        bounds = tuple(float(b) for b in bounds)
+        if not bounds or list(bounds) != sorted(set(bounds)):
+            raise ValueError(f"histogram {name!r}: bounds must be a "
+                             f"strictly increasing non-empty sequence")
+        self.name = name
+        self.labels = labels
+        self.bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        i = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self.counts[i] += 1
+            self.count += 1
+            self.sum += v
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+
+class MetricRegistry:
+    """Process-wide typed-metric registry (get-or-create by
+    name + labels; a name must keep one type for the process)."""
+
+    def __init__(self) -> None:
+        self._metrics: Dict[Tuple[str, LabelItems], object] = {}
+        self._lock = threading.Lock()
+        self._jsonl: Optional[TextIO] = None
+        self._jsonl_path: Optional[str] = None
+
+    def _get(self, cls, name: str, labels: Dict[str, object], **kw):
+        key = (name, _label_items(labels))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, key[1], **kw)
+                self._metrics[key] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}")
+            return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str,
+                  bounds: Tuple[float, ...] = DEFAULT_BUCKETS,
+                  **labels) -> Histogram:
+        return self._get(Histogram, name, labels, bounds=bounds)
+
+    # -- the JSONL event sink (Dashboard.emit_metric's record shape) -------
+
+    def set_jsonl(self, path: Optional[str]) -> None:
+        with self._lock:
+            if self._jsonl is not None:
+                self._jsonl.close()
+            self._jsonl = open(path, "a") if path else None
+            self._jsonl_path = path or None
+
+    def emit(self, name: str, value: float, unit: str = "",
+             **extra) -> dict:
+        """One structured metric event; also sets the gauge ``name`` so
+        the last emitted value rides every snapshot/aggregation."""
+        rec = {"metric": name, "value": float(value), "unit": unit,
+               "ts": time.time(), **extra}
+        self.gauge(name).set(value)
+        with self._lock:
+            if self._jsonl is not None:
+                self._jsonl.write(json.dumps(rec) + "\n")
+                self._jsonl.flush()
+        return rec
+
+    # -- exports ------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-safe state dump — the interchange format (see module
+        docstring); histograms carry bounds so merges can verify them."""
+        with self._lock:
+            items = list(self._metrics.items())
+        counters, gauges, histograms = {}, {}, {}
+        for (name, labels), m in items:
+            key = metric_key(name, labels)
+            if isinstance(m, Counter):
+                counters[key] = m.value
+            elif isinstance(m, Gauge):
+                gauges[key] = m.value
+            else:
+                histograms[key] = {"bounds": list(m.bounds),
+                                   "counts": list(m.counts),
+                                   "count": m.count, "sum": m.sum}
+        return {"kind": SNAPSHOT_KIND, "ts": time.time(),
+                "pid": os.getpid(), "counters": counters,
+                "gauges": gauges, "histograms": histograms}
+
+    def write_snapshot(self, path: str) -> dict:
+        """Write the snapshot atomically (temp + rename: a reader —
+        e.g. the report CLI on a hung bench — never sees torn JSON)."""
+        snap = self.snapshot()
+        tmp = f"{path}.tmp.{os.getpid()}"
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(snap, f, indent=1)
+        os.replace(tmp, path)
+        return snap
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition (names sanitized: ``.`` → ``_``)."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+        lines: List[str] = []
+
+        def fmt(name: str, labels: LabelItems, value, suffix: str = "",
+                extra: LabelItems = ()) -> str:
+            pname = name.replace(".", "_").replace("-", "_") + suffix
+            lab = ",".join(f'{k}="{v}"' for k, v in labels + extra)
+            return f"{pname}{{{lab}}} {value}" if lab \
+                else f"{pname} {value}"
+
+        for (name, labels), m in items:
+            if isinstance(m, Counter):
+                lines.append(fmt(name, labels, m.value, "_total"))
+            elif isinstance(m, Gauge):
+                lines.append(fmt(name, labels, m.value))
+            else:
+                acc = 0
+                for b, c in zip(m.bounds, m.counts):
+                    acc += c
+                    lines.append(fmt(name, labels, acc, "_bucket",
+                                     (("le", repr(b)),)))
+                lines.append(fmt(name, labels, m.count, "_bucket",
+                                 (("le", "+Inf"),)))
+                lines.append(fmt(name, labels, m.count, "_count"))
+                lines.append(fmt(name, labels, m.sum, "_sum"))
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def reset(self) -> None:
+        """Drop all metrics (tests); the JSONL sink stays configured."""
+        with self._lock:
+            self._metrics.clear()
+
+
+_REGISTRY = MetricRegistry()
+_env_jsonl = os.environ.get("MVTPU_METRICS_JSONL")
+if _env_jsonl:
+    _REGISTRY.set_jsonl(_env_jsonl)
+
+
+def registry() -> MetricRegistry:
+    return _REGISTRY
+
+
+def counter(name: str, **labels) -> Counter:
+    return _REGISTRY.counter(name, **labels)
+
+
+def gauge(name: str, **labels) -> Gauge:
+    return _REGISTRY.gauge(name, **labels)
+
+
+def histogram(name: str, bounds: Tuple[float, ...] = DEFAULT_BUCKETS,
+              **labels) -> Histogram:
+    return _REGISTRY.histogram(name, bounds, **labels)
+
+
+def emit(name: str, value: float, unit: str = "", **extra) -> dict:
+    return _REGISTRY.emit(name, value, unit, **extra)
+
+
+def snapshot() -> dict:
+    return _REGISTRY.snapshot()
+
+
+def write_snapshot(path: str) -> dict:
+    return _REGISTRY.write_snapshot(path)

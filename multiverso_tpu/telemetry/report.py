@@ -1,0 +1,210 @@
+"""Report CLI: render a metrics snapshot or span trace as a table.
+
+    python -m multiverso_tpu.telemetry.report <file> [--prometheus]
+
+Accepts any of the telemetry layer's on-disk artifacts and autodetects
+which it got:
+
+- a registry snapshot (``write_snapshot`` / ``fleet_snapshot`` JSON,
+  ``kind == "mvtpu.metrics.v1"``) → counters/gauges tables + histogram
+  summaries (or ``--prometheus`` text exposition),
+- a span/step trace JSONL (``trace.set_trace_file`` output) → per-name
+  span aggregates plus the step timeline tail,
+- a metric-event JSONL (``MVTPU_METRICS_JSONL`` / ``emit_metric``
+  sink) → last value per metric.
+
+Pure stdlib, never imports jax: it must run against the artifact of a
+HUNG run (the round-5 bench probes wedged with zero diagnostic signal —
+this tool is the post-mortem path) on a host whose accelerator tunnel
+is exactly what's broken.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+from multiverso_tpu.telemetry import metrics as _metrics
+from multiverso_tpu.telemetry import trace as _trace
+
+
+def _table(rows: List[List[str]], header: List[str]) -> str:
+    widths = [max(len(str(r[i])) for r in [header] + rows)
+              for i in range(len(header))]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    lines = [fmt.format(*header)]
+    lines += [fmt.format(*(str(c) for c in r)) for r in rows]
+    return "\n".join(lines)
+
+
+def _num(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return f"{v:.6g}"
+
+
+def render_snapshot(snap: dict) -> str:
+    out = []
+    hosts = snap.get("hosts")
+    if hosts:
+        out.append(f"fleet snapshot over {hosts} host(s)")
+    counters = snap.get("counters", {})
+    if counters:
+        rows = [[k, _num(v)] for k, v in sorted(counters.items())]
+        out.append("counters:\n" + _table(rows, ["name", "value"]))
+    gauges = snap.get("gauges", {})
+    if gauges:
+        rows = [[k, _num(v)] for k, v in sorted(gauges.items())]
+        out.append("gauges:\n" + _table(rows, ["name", "value"]))
+    hists = snap.get("histograms", {})
+    if hists:
+        rows = []
+        for k, h in sorted(hists.items()):
+            count, total = h["count"], h["sum"]
+            mean = total / count if count else 0.0
+            rows.append([k, _num(count), f"{total:.4f}",
+                         f"{mean * 1e3:.3f}", _p50(h)])
+        out.append("histograms:\n" + _table(
+            rows, ["name", "count", "sum", "mean_ms", "~p50"]))
+    if not out:
+        return "(empty snapshot)"
+    return "\n\n".join(out)
+
+
+def _p50(h: dict) -> str:
+    """Approximate median: the upper bound of the bucket holding the
+    midpoint observation (fixed buckets — exact values are gone)."""
+    if not h["count"]:
+        return "-"
+    half = h["count"] / 2.0
+    acc = 0
+    for bound, c in zip(h["bounds"], h["counts"]):
+        acc += c
+        if acc >= half:
+            return f"<={_num(bound)}"
+    return f">{_num(h['bounds'][-1])}"
+
+
+def render_trace(records: List[dict]) -> str:
+    spans: Dict[str, List[float]] = {}
+    steps: List[dict] = []
+    other = 0
+    for r in records:
+        kind = r.get("kind")
+        if kind == "span":
+            spans.setdefault(r["name"], []).append(float(r["dur_s"]))
+        elif kind == "step":
+            steps.append(r)
+        else:
+            other += 1
+    out = []
+    if spans:
+        rows = []
+        for name, durs in sorted(spans.items()):
+            rows.append([name, len(durs), f"{sum(durs):.4f}",
+                         f"{sum(durs) / len(durs) * 1e3:.3f}",
+                         f"{max(durs) * 1e3:.3f}"])
+        out.append("spans:\n" + _table(
+            rows, ["name", "count", "total_s", "mean_ms", "max_ms"]))
+    if steps:
+        rows = []
+        for r in steps[-20:]:
+            extra = ", ".join(
+                f"{k}={_num(v) if isinstance(v, (int, float)) else v}"
+                for k, v in sorted(r.items())
+                if k not in ("kind", "name", "step", "ts", "parent"))
+            rows.append([r["name"], r["step"], f"{r['ts']:.3f}", extra])
+        out.append(f"steps (last {len(rows)} of {len(steps)}):\n"
+                   + _table(rows, ["name", "step", "ts", "fields"]))
+    if other:
+        out.append(f"({other} unrecognized record(s) skipped)")
+    if not out:
+        return "(empty trace)"
+    return "\n\n".join(out)
+
+
+def render_metric_events(records: List[dict]) -> str:
+    last: Dict[str, dict] = {}
+    for r in records:
+        last[r["metric"]] = r
+    rows = [[k, _num(r["value"]), r.get("unit", ""), f"{r['ts']:.3f}"]
+            for k, r in sorted(last.items())]
+    return ("metric events (last value of each):\n"
+            + _table(rows, ["metric", "value", "unit", "ts"]))
+
+
+def _load(path: str):
+    """Autodetect artifact type → ("snapshot"|"trace"|"events", data)."""
+    with open(path) as f:
+        head = f.read(1 << 20)
+    stripped = head.lstrip()
+    if stripped.startswith("{"):
+        try:
+            doc = json.loads(head)
+        except ValueError:
+            doc = None
+        if isinstance(doc, dict) and doc.get("kind") == \
+                _metrics.SNAPSHOT_KIND:
+            return "snapshot", doc
+    records = _trace.read_trace(path)
+    if records and all("metric" in r for r in records):
+        return "events", records
+    return "trace", records
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m multiverso_tpu.telemetry.report",
+        description="Render a telemetry snapshot or trace as a table.")
+    p.add_argument("path", help="snapshot JSON, trace JSONL, or metric "
+                                "event JSONL")
+    p.add_argument("--prometheus", action="store_true",
+                   help="emit a snapshot in Prometheus text format")
+    args = p.parse_args(argv)
+    kind, data = _load(args.path)
+    if args.prometheus:
+        if kind != "snapshot":
+            print("--prometheus requires a registry snapshot",
+                  file=sys.stderr)
+            return 2
+        reg = _metrics.MetricRegistry()
+        for k, v in data.get("counters", {}).items():
+            _rehydrate(reg.counter, k).inc(v)
+        for k, v in data.get("gauges", {}).items():
+            _rehydrate(reg.gauge, k).set(v)
+        for k, h in data.get("histograms", {}).items():
+            m = _rehydrate(reg.histogram, k, bounds=tuple(h["bounds"]))
+            m.counts = list(h["counts"])
+            m.count, m.sum = h["count"], h["sum"]
+        print(reg.to_prometheus(), end="")
+        return 0
+    if kind == "snapshot":
+        print(render_snapshot(data))
+    elif kind == "events":
+        print(render_metric_events(data))
+    else:
+        print(render_trace(data))
+    return 0
+
+
+def _rehydrate(factory, flat_key: str, **kw):
+    """Invert metric_key(): ``name{k=v,...}`` back to factory args."""
+    if "{" in flat_key and flat_key.endswith("}"):
+        name, _, rest = flat_key.partition("{")
+        labels = dict(item.split("=", 1)
+                      for item in rest[:-1].split(",") if item)
+        return factory(name, **kw, **labels)
+    return factory(flat_key, **kw)
+
+
+if __name__ == "__main__":
+    try:
+        rc = main()
+    except BrokenPipeError:
+        # piped into head/less and the reader left — normal CLI exit
+        import os
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        rc = 0
+    raise SystemExit(rc)

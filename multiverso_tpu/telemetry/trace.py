@@ -1,0 +1,152 @@
+"""Span tracing: nestable wall-clock spans written as a JSONL trace.
+
+The host-side complement of the device profiler (PAPER/SURVEY §6.1's
+"per-step wall-clock dashboard + ``jax.profiler.trace`` hooks"): a
+:func:`span` context manager times a region, records its parent via a
+thread-local stack (ids are a process-monotonic counter — no
+randomness, no clocks beyond ``time``), and appends one JSON record per
+span to the configured trace file. Spans also enter a
+``jax.named_scope`` when jax is already importable, so a concurrent
+``jax.profiler.trace`` device capture shows the same names on the
+compiled ops — one vocabulary across host and device timelines.
+
+Record shapes (one JSON object per line):
+
+- span:  ``{"kind": "span", "name", "id", "parent", "ts", "dur_s",
+  "attrs"?}`` (``parent`` is null for roots; ``ts`` is the epoch start)
+- step:  ``{"kind": "step", "name", "step", "ts", ...metrics}`` — the
+  per-superstep heartbeat apps emit via :func:`step_timeline`; a trace
+  with step records is a per-step timeline even when nothing else is
+  instrumented (the round-5 bench hang left zero such signal).
+
+Sink configuration: :func:`set_trace_file`, or ``MVTPU_TRACE_JSONL``
+(a file path), or ``MVTPU_TRACE_DIR`` (a directory; the file becomes
+``trace-<pid>.jsonl`` inside it — per-process files, safe multi-host).
+With no sink, spans still nest and time but write nothing, so hot-path
+instrumentation costs one perf_counter pair when tracing is off.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Iterator, List, Optional, TextIO
+
+_IDS = itertools.count(1)
+_TLS = threading.local()
+_LOCK = threading.Lock()
+_FILE: Optional[TextIO] = None
+_PATH: Optional[str] = None
+
+
+def _stack() -> List[int]:
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+def set_trace_file(path: Optional[str]) -> None:
+    """Point the trace sink at ``path`` (append mode); None disables."""
+    global _FILE, _PATH
+    with _LOCK:
+        if _FILE is not None:
+            _FILE.close()
+        if path:
+            parent = os.path.dirname(os.path.abspath(path))
+            os.makedirs(parent, exist_ok=True)
+            _FILE = open(path, "a")
+        else:
+            _FILE = None
+        _PATH = path or None
+
+
+def trace_path() -> Optional[str]:
+    return _PATH
+
+
+def _emit(rec: dict) -> None:
+    with _LOCK:
+        if _FILE is not None:
+            _FILE.write(json.dumps(rec) + "\n")
+            _FILE.flush()
+
+
+def _named_scope(name: str):
+    """jax.named_scope(name) when jax is already loaded — the span name
+    then tags device ops inside a concurrent profiler capture. Never
+    IMPORTS jax (the report CLI and pure-host tools must not pay, or
+    fail, a backend init)."""
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return jax.named_scope(name)
+        except Exception:  # pragma: no cover - defensive
+            pass
+    return contextlib.nullcontext()
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs) -> Iterator[int]:
+    """Time a region as a nestable span; yields the span id."""
+    sid = next(_IDS)
+    st = _stack()
+    parent = st[-1] if st else None
+    st.append(sid)
+    ts = time.time()
+    t0 = time.perf_counter()
+    try:
+        with _named_scope(name):
+            yield sid
+    finally:
+        dur = time.perf_counter() - t0
+        st.pop()
+        rec = {"kind": "span", "name": name, "id": sid,
+               "parent": parent, "ts": ts, "dur_s": dur}
+        if attrs:
+            rec["attrs"] = attrs
+        _emit(rec)
+
+
+def step_timeline(name: str, step: int, **fields) -> dict:
+    """Per-superstep heartbeat: one JSON record carrying the step number
+    plus whatever throughput fields the app measured. Apps call this
+    once per superstep dispatch — the trace file then always shows how
+    far a run got and how fast it was moving when it stopped."""
+    st = _stack()
+    rec = {"kind": "step", "name": name, "step": int(step),
+           "ts": time.time(), **fields}
+    if st:
+        rec["parent"] = st[-1]
+    _emit(rec)
+    return rec
+
+
+def read_trace(path: str) -> List[dict]:
+    """Load a trace JSONL file (skipping torn trailing lines — the
+    writer may have been killed mid-record)."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                continue
+    return records
+
+
+_env = os.environ.get("MVTPU_TRACE_JSONL")
+if not _env:
+    _dir = os.environ.get("MVTPU_TRACE_DIR")
+    if _dir:
+        _env = os.path.join(_dir, f"trace-{os.getpid()}.jsonl")
+if _env:
+    set_trace_file(_env)

@@ -17,6 +17,15 @@ TPU profiler integration (SURVEY.md §6.1: "per-step wall-clock dashboard
 ``jax.named_scope`` (host-side begin; tags device ops traced inside it)
 and :func:`trace` captures a TensorBoard-loadable device trace of any
 code block.
+
+BACK-COMPAT SHIM over :mod:`multiverso_tpu.telemetry`: the Monitor API
+and record shapes are unchanged, but every ``profile`` region also
+observes into the process-wide metric registry (histogram
+``dashboard.seconds{region=...}``) and emits a span into the telemetry
+trace, and every ``emit_metric`` also sets the registry gauge of the
+same name and rides the registry's JSONL sink — so legacy call sites
+show up in registry snapshots, fleet aggregation, and the report CLI
+without being touched.
 """
 
 from __future__ import annotations
@@ -27,6 +36,9 @@ import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, Optional, TextIO
+
+from multiverso_tpu.telemetry import metrics as telemetry_metrics
+from multiverso_tpu.telemetry import trace as telemetry_trace
 
 
 @dataclass
@@ -70,18 +82,22 @@ class Dashboard:
     @contextlib.contextmanager
     def profile(self, name: str) -> Iterator[Monitor]:
         """Time a region AND tag any ops traced inside it: the region
-        runs under ``jax.named_scope(name)``, so a `jax.profiler` device
-        trace shows the dashboard's monitor names on the compiled ops."""
-        import jax
+        runs under a telemetry span, which enters ``jax.named_scope``
+        when jax is loaded — a `jax.profiler` device trace shows the
+        dashboard's monitor names on the compiled ops, and the span
+        lands in the telemetry trace + latency histogram."""
         mon = self.monitor(name)
         start = time.perf_counter()
         try:
-            with jax.named_scope(name):
+            with telemetry_trace.span(name):
                 yield mon
         finally:
+            dt = time.perf_counter() - start
             with self._lock:
-                mon.total_s += time.perf_counter() - start
+                mon.total_s += dt
                 mon.count += 1
+            telemetry_metrics.histogram(
+                "dashboard.seconds", region=name).observe(dt)
 
     @contextlib.contextmanager
     def trace(self, log_dir: str) -> Iterator[None]:
@@ -100,9 +116,12 @@ class Dashboard:
 
     def emit_metric(self, name: str, value: float, unit: str = "",
                     **extra) -> dict:
-        """Emit one structured metric record (stdout-friendly JSON)."""
-        rec = {"metric": name, "value": float(value), "unit": unit,
-               "ts": time.time(), **extra}
+        """Emit one structured metric record (stdout-friendly JSON).
+
+        Shim: the record also goes through the telemetry registry
+        (gauge of the same name + the registry's own JSONL sink), so
+        legacy emits ride snapshots and fleet aggregation."""
+        rec = telemetry_metrics.emit(name, value, unit, **extra)
         with self._lock:
             if self._jsonl is not None:
                 self._jsonl.write(json.dumps(rec) + "\n")

@@ -1,0 +1,19 @@
+"""Version-portable jax API shims.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` (jax < 0.6,
+``check_rep=``) to top-level ``jax.shard_map`` (``check_vma=``). Every
+in-repo user goes through this wrapper so the codebase carries the new
+spelling while still importing on the older jax this image ships."""
+
+from __future__ import annotations
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    try:
+        from jax import shard_map as _sm        # jax >= 0.6
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+        return _sm(f, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, check_rep=check_vma)
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_vma=check_vma)

@@ -1,7 +1,5 @@
 """Core runtime tests: mesh, init, barrier, topology (SURVEY.md §3.1/§4.1)."""
 
-import jax
-import numpy as np
 import pytest
 
 from multiverso_tpu import core

@@ -178,7 +178,6 @@ class TestAtomicLocalWrite:
 
     def test_failed_write_leaves_no_torn_target(self, tmp_path):
         import glob
-        import os
         from multiverso_tpu.io import open_stream
         target = str(tmp_path / "b.bin")
         with open_stream(target, "wb") as s:     # a prior good version

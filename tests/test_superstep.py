@@ -3,7 +3,6 @@ same semantics the plain Get/Add contract is tested for (round-1 review:
 the fused path the apps/benchmarks run must be the contract the tests
 validate)."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
