@@ -11,18 +11,29 @@
 #   make lint     - stdlib linter (tools/lint.py: syntax + unused
 #                   imports; neither ruff nor pyflakes is vendored in
 #                   this image) over the package, tests, and bench
+#   make bench-diff - compare two bench artifacts (OLD=... NEW=...);
+#                   nonzero exit when a watched metric regresses
 #   make native   - C++ data loader + baseline binaries
 #   make ci       - everything CI runs, in order
 
 PY ?= python
+OLD ?= BENCH_r04.json
+NEW ?= BENCH_r05.json
 
-.PHONY: test dryrun bench bench-dryrun fuzz lint native ci
+.PHONY: test dryrun bench bench-dryrun bench-diff bench-diff-selftest \
+	fuzz lint native ci
 
 fuzz:
 	$(PY) tests/deep_fuzz.py
 
 lint:
 	$(PY) tools/lint.py multiverso_tpu tests bench.py tools
+
+bench-diff:
+	$(PY) tools/bench_diff.py $(OLD) $(NEW)
+
+bench-diff-selftest:
+	$(PY) tools/bench_diff.py --selftest
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -40,4 +51,4 @@ bench:
 native:
 	$(MAKE) -C native
 
-ci: lint native test dryrun bench-dryrun
+ci: lint bench-diff-selftest native test dryrun bench-dryrun

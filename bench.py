@@ -209,29 +209,103 @@ def load_baseline() -> float:
 # + tier boundary writes a fresh registry snapshot, so a wedged run
 # still leaves `bench_telemetry.json` for
 #   python -m multiverso_tpu.telemetry.report bench_telemetry.json
+# _WATCHDOG is the flight recorder's stall side (ISSUE 2): armed for
+# the whole bench via MVTPU_BENCH_WATCHDOG seconds (default 900; "0"
+# disables), beaten at every probe attempt and tier boundary — a wedge
+# ANYWHERE in the bench now dumps stacks/metrics/trace-tail into
+# MVTPU_DUMP_DIR instead of dying silent.
 _TELEMETRY = None
 _TELE_PATH = None
+_WATCHDOG = None
 
 
-def _bind_telemetry_metrics():
-    """Load multiverso_tpu.telemetry.metrics WITHOUT importing jax: the
+def _bind_jax_free(leaf: str):
+    """Load one stdlib-only telemetry module WITHOUT importing jax: the
     package __init__ pulls core -> jax, and pre-probe the bench parent
     must stay off the jax import path entirely (the probe exists
     because a wedged tunnel can hang anything touching the backend).
-    metrics.py is stdlib-only, so it is loaded by file path and
-    registered under its canonical module name — when the full package
-    imports later (post-probe), Python reuses this exact module object,
-    so probe-phase counters land in the same process registry."""
+    The module is loaded by file path and registered under its
+    canonical name — when the full package imports later (post-probe),
+    Python reuses this exact module object, so probe-phase counters
+    (and the armed watchdog) live in the same process registry."""
     import importlib.util
-    name = "multiverso_tpu.telemetry.metrics"
+    name = f"multiverso_tpu.telemetry.{leaf}"
     if name in sys.modules:
         return sys.modules[name]
-    path = os.path.join(HERE, "multiverso_tpu", "telemetry", "metrics.py")
+    path = os.path.join(HERE, "multiverso_tpu", "telemetry", f"{leaf}.py")
     spec = importlib.util.spec_from_file_location(name, path)
     mod = importlib.util.module_from_spec(spec)
     sys.modules[name] = mod
     spec.loader.exec_module(mod)
     return mod
+
+
+def _bind_telemetry_metrics():
+    return _bind_jax_free("metrics")
+
+
+def _bind_watchdog():
+    """The stall watchdog, jax-free (watchdog.py is standalone by
+    design — see its docstring)."""
+    return _bind_jax_free("watchdog")
+
+
+WATCHDOG_PATH = os.path.join(HERE, "multiverso_tpu", "telemetry",
+                             "watchdog.py")
+
+
+def _dump_entries(dump_dir: str):
+    """(mtime, path) of every watchdog dump directory under dump_dir."""
+    try:
+        names = os.listdir(dump_dir)
+    except OSError:
+        return []
+    out = []
+    for n in names:
+        p = os.path.join(dump_dir, n)
+        if n.startswith("dump-") and os.path.isdir(p):
+            try:
+                out.append((os.path.getmtime(p), p))
+            except OSError:
+                continue
+    return sorted(out)
+
+
+def _report_dump_artifacts(dump_dir: str, since: float,
+                           max_chars: int = 2000) -> None:
+    """Print the tail of each NEW watchdog dump's artifacts to stderr,
+    so the driver's captured log tail (the BENCH json `tail`) carries
+    the child's thread stacks instead of seven identical kill lines."""
+    for mtime, path in _dump_entries(dump_dir):
+        if mtime < since:
+            continue
+        print(f"bench: post-mortem dump {path}:", file=sys.stderr)
+        for fname in ("watchdog.json", "stacks.txt"):
+            fp = os.path.join(path, fname)
+            try:
+                with open(fp) as f:
+                    body = f.read()
+            except OSError:
+                continue
+            tail = body[-max_chars:]
+            print(f"bench: --- {fname} (last {len(tail)} chars) ---\n"
+                  f"{tail}", file=sys.stderr)
+
+
+def _text_tail(data, max_chars: int = 2000) -> str:
+    """Last chars of a subprocess stream that may be bytes, str, or
+    None (TimeoutExpired hands back bytes even in text mode)."""
+    if data is None:
+        return ""
+    if isinstance(data, bytes):
+        data = data.decode("utf-8", "replace")
+    return data[-max_chars:]
+
+
+def _beat() -> None:
+    """Tier-boundary heartbeat (no-op when the watchdog is disabled)."""
+    if _WATCHDOG is not None:
+        _WATCHDOG.beat()
 
 
 def _write_telemetry_snapshot() -> None:
@@ -243,8 +317,33 @@ def _write_telemetry_snapshot() -> None:
                   file=sys.stderr)
 
 
+def _probe_src(timeout_s: float) -> str:
+    """The chip-probe child's source. The child arms its OWN watchdog
+    (watchdog.py loaded by file path — standalone by design) at half
+    the parent's kill timeout: when `import jax` wedges on the tunnel,
+    the child dumps its all-thread stacks into MVTPU_DUMP_DIR ~90s
+    before the parent kills it, so every hang leaves a post-mortem
+    naming the exact frame (r01-r05 left seven identical kill lines
+    and nothing else)."""
+    deadline = max(5.0, timeout_s / 2.0)
+    return (
+        "import importlib.util;"
+        f"_s = importlib.util.spec_from_file_location("
+        f"'mvtpu_watchdog', {WATCHDOG_PATH!r});"
+        "_wd = importlib.util.module_from_spec(_s);"
+        "_s.loader.exec_module(_wd);"
+        f"_wd.Watchdog({deadline!r}, name='bench.probe.child', "
+        "action='dump').start();"
+        "import jax, jax.numpy as jnp;"
+        + ("jax.config.update('jax_platforms', 'cpu');" if TINY else
+           "assert jax.default_backend() != 'cpu',"
+           " 'accelerator init fell back to CPU';")
+        + "print(float(jnp.ones(2).sum()))")
+
+
 def _probe_chip(timeout_s: float = 180.0, deadline_s: "float | None" = None,
-                retry_wait_s: float = 60.0, max_rc_failures: int = 5) -> None:
+                retry_wait_s: float = 60.0, max_rc_failures: int = 5,
+                max_hang_kills: int = 3) -> None:
     """Wait out a wedged chip tunnel, up to a deadline.
 
     Observed failure mode: backend init hangs indefinitely while the
@@ -257,7 +356,14 @@ def _probe_chip(timeout_s: float = 180.0, deadline_s: "float | None" = None,
     ``deadline_s`` of the bench window is spent, then exit 2 so the
     driver still gets a fast, clear failure rather than a hang into
     its own timeout. Deadline overridable via MVTPU_BENCH_PROBE_DEADLINE
-    (seconds)."""
+    (seconds).
+
+    r01-r05 each burned the WHOLE 1800s window on seven identical
+    hang-kills: ``max_hang_kills`` consecutive hangs now abort early
+    (a wedge that survives 3 kill cycles is not clearing this window),
+    and every kill ships the child's stderr tail plus any watchdog
+    dump artifacts (thread stacks!) to stderr, where the driver's
+    BENCH-json `tail` capture preserves them."""
     import subprocess
     if deadline_s is None:
         raw = os.environ.get("MVTPU_BENCH_PROBE_DEADLINE", "1800")
@@ -267,20 +373,19 @@ def _probe_chip(timeout_s: float = 180.0, deadline_s: "float | None" = None,
             print(f"bench: ignoring malformed MVTPU_BENCH_PROBE_DEADLINE="
                   f"{raw!r}; using 1800s", file=sys.stderr)
             deadline_s = 1800.0
+    dump_dir = os.environ.get("MVTPU_DUMP_DIR", "mvtpu_dump")
     t0 = time.monotonic()
     attempt = 0
     rc_failures = 0
+    hang_kills = 0
     while True:
         attempt += 1
-        probe_src = (
-            "import jax, jax.numpy as jnp;"
-            + ("jax.config.update('jax_platforms', 'cpu');" if TINY else
-               "assert jax.default_backend() != 'cpu',"
-               " 'accelerator init fell back to CPU';")
-            + "print(float(jnp.ones(2).sum()))")
+        if _WATCHDOG is not None:
+            _WATCHDOG.beat()        # each attempt is forward progress
+        attempt_t0 = time.time()
         try:
             proc = subprocess.run(
-                [sys.executable, "-c", probe_src],
+                [sys.executable, "-c", _probe_src(timeout_s)],
                 timeout=timeout_s, capture_output=True, text=True)
             if proc.returncode == 0:
                 if attempt > 1:
@@ -293,10 +398,19 @@ def _probe_chip(timeout_s: float = 180.0, deadline_s: "float | None" = None,
                 return
             failure = f"rc={proc.returncode}: {proc.stderr[-2000:]}"
             rc_failures += 1
+            hang_kills = 0
             if _TELEMETRY is not None:
                 _TELEMETRY.counter("bench.probe.rc_failures").inc()
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as e:
             failure = f"hang, killed after {timeout_s:.0f}s"
+            hang_kills += 1
+            stderr_tail = _text_tail(e.stderr)
+            if stderr_tail:
+                print(f"bench: probe child stderr tail:\n{stderr_tail}",
+                      file=sys.stderr)
+            # the child's watchdog dumped ~timeout/2 in: surface its
+            # thread stacks in the driver-captured log tail
+            _report_dump_artifacts(dump_dir, since=attempt_t0)
             if _TELEMETRY is not None:
                 _TELEMETRY.counter("bench.probe.hangs").inc()
         elapsed = time.monotonic() - t0
@@ -304,11 +418,20 @@ def _probe_chip(timeout_s: float = 180.0, deadline_s: "float | None" = None,
             _TELEMETRY.gauge("bench.probe.elapsed_s").set(elapsed)
             _write_telemetry_snapshot()
         # A HANG is the documented wedge signature and worth waiting out
-        # to the full deadline; a quick nonzero exit (e.g. the
-        # fell-back-to-CPU assertion, a persistent plugin error) is
-        # usually deterministic — allow a few retries for transient
-        # blips during tunnel recovery, then surface it fast instead of
-        # burning the driver window on an error that cannot recover.
+        # — but not forever: after max_hang_kills identical kill cycles
+        # the wedge is not clearing inside this window; exit fast with
+        # the post-mortems already on stderr instead of burning the
+        # remaining driver window on more of the same (r01-r05 failure
+        # mode). A quick nonzero exit (e.g. the fell-back-to-CPU
+        # assertion, a persistent plugin error) is usually
+        # deterministic — allow a few retries for transient blips
+        # during tunnel recovery, then surface it fast too.
+        if hang_kills >= max_hang_kills:
+            print(f"bench: chip probe hung {hang_kills}x consecutively "
+                  f"({elapsed:.0f}s spent) — tunnel wedged; giving up "
+                  f"early with post-mortems in {dump_dir} instead of "
+                  "burning the rest of the window", file=sys.stderr)
+            raise SystemExit(2)
         if rc_failures >= max_rc_failures:
             print(f"bench: chip probe failed {rc_failures}x with a "
                   f"nonzero exit (not a hang) — deterministic failure, "
@@ -342,7 +465,7 @@ def main() -> None:
         _jax.config.update("jax_platforms", "cpu")
     # telemetry spine: snapshot + trace artifacts live next to the
     # BENCH_r0X captures (jax-free binding — see _bind_telemetry_metrics)
-    global _TELEMETRY, _TELE_PATH
+    global _TELEMETRY, _TELE_PATH, _WATCHDOG
     import atexit
     _TELEMETRY = _bind_telemetry_metrics()
     _TELE_PATH = os.environ.get(
@@ -351,6 +474,26 @@ def main() -> None:
     atexit.register(_write_telemetry_snapshot)
     print(f"bench: telemetry -> {_TELE_PATH} (render with: python -m "
           "multiverso_tpu.telemetry.report <path>)", file=sys.stderr)
+    # flight recorder: dump artifacts land next to the BENCH captures;
+    # the probe children inherit the env var and dump there too
+    os.environ.setdefault("MVTPU_DUMP_DIR",
+                          os.path.join(HERE, "mvtpu_dump"))
+    raw_wd = os.environ.get("MVTPU_BENCH_WATCHDOG", "900")
+    try:
+        wd_deadline = float(raw_wd)
+    except ValueError:
+        print(f"bench: ignoring malformed MVTPU_BENCH_WATCHDOG="
+              f"{raw_wd!r}; using 900s", file=sys.stderr)
+        wd_deadline = 900.0
+    if wd_deadline > 0:
+        wd_mod = _bind_watchdog()
+        # action "dump", never "kill": the driver's own timeout is the
+        # executioner — the watchdog's job is to leave the post-mortem
+        _WATCHDOG = wd_mod.Watchdog(wd_deadline, name="bench",
+                                    action="dump").start()
+        print(f"bench: watchdog armed ({wd_deadline:.0f}s deadline; "
+              f"dumps -> {os.environ['MVTPU_DUMP_DIR']})",
+              file=sys.stderr)
     _probe_chip()
     import jax
     from multiverso_tpu.telemetry import trace as telemetry_trace
@@ -362,8 +505,10 @@ def main() -> None:
     baseline = load_baseline()
     n_chips = len(jax.devices())
     mesh = core.init()
+    _beat()                      # backend up + mesh built: progress
 
     corpus = build_bench_corpus()
+    _beat()                      # corpus staged
     cfg = W2VConfig(embedding_dim=DIM, window=WINDOW, negative=NEGATIVE,
                     batch_size=BATCH, steps_per_call=STEPS_PER_CALL,
                     learning_rate=LR, epochs=1, subsample=SUBSAMPLE, seed=1)
@@ -396,13 +541,19 @@ def main() -> None:
     # on this platform (block_until_ready on donated-alias buffers can
     # return early), so the timed window starts truly idle
     float(warm_loss)
+    _beat()                      # warmup (compile) done
 
-    t0 = time.perf_counter()
-    loss = None
-    for i in range(WARMUP_CALLS, need_calls):
-        loss = dispatch(i, calls[i])
-    loss = float(loss)
-    dt = time.perf_counter() - t0
+    # optional device capture of the engine tier (MVTPU_PROFILE_DIR)
+    from multiverso_tpu.telemetry.profiling import (profile_window,
+                                                    record_device_memory)
+    with profile_window("bench_w2v_engine"):
+        t0 = time.perf_counter()
+        loss = None
+        for i in range(WARMUP_CALLS, need_calls):
+            loss = dispatch(i, calls[i])
+        loss = float(loss)
+        dt = time.perf_counter() - t0
+    _beat()                      # engine tier done
 
     pairs_done = TIMED_CALLS * BATCH * STEPS_PER_CALL
     pairs_per_sec = pairs_done / dt
@@ -428,6 +579,7 @@ def main() -> None:
             ef_loss = dispatch(i, app._place(s, t))
         float(ef_loss)
         ef_dt = min(ef_dt, time.perf_counter() - t0)
+        _beat()                  # one engine-fed pass landed
     ef_pairs = TIMED_CALLS * BATCH * STEPS_PER_CALL
     ef_words = ef_pairs / ef_dt / pairs_per_token / max(n_chips, 1)
 
@@ -452,6 +604,7 @@ def main() -> None:
         words = e2e_pairs / pairs_per_token / dt_pass / max(n_chips, 1)
         if words > e2e_words:          # keep rate and clock of the SAME
             e2e_words, e2e_dt = words, dt_pass       # best pass
+        _beat()                  # one e2e pass landed
 
 
     print(json.dumps({
@@ -487,8 +640,11 @@ def main() -> None:
     # survive in the log tail instead of being lost with the process
     print(json.dumps(w2v_line), flush=True)
     # snapshot NOW: if the LDA tier wedges the process, the w2v tier's
-    # table/op accounting is already on disk
+    # table/op accounting is already on disk — with the w2v working
+    # set's device-memory gauges on it
+    record_device_memory()
     _write_telemetry_snapshot()
+    _beat()                      # w2v capture safe on stdout
 
     # free the w2v working set (10 staged ~46MB placement buffers + the
     # embedding tables) before the LDA tier allocates its own tables —
@@ -506,6 +662,8 @@ def main() -> None:
     except Exception as e:             # never lose the w2v capture
         print(f"lda tier failed: {e!r}", file=sys.stderr)
         lda = {}
+    record_device_memory()
+    _beat()                      # lda tier resolved either way
     if lda:
         print(json.dumps({**w2v_line, **lda}))
 

@@ -1569,6 +1569,7 @@ class LightLDA:
             telemetry.step_timeline(
                 "lda", it, tokens=self.num_tokens,
                 dispatch_s=time.perf_counter() - t_sweep)
+            telemetry.beat()    # flight recorder: a heartbeat per sweep
             if ck_every > 0 and self.config.checkpoint_prefix \
                     and (it + 1) % ck_every == 0:
                 # periodic full-state dump (sampler state included, so
@@ -1918,7 +1919,11 @@ def main(argv=None) -> None:
         checkpoint_interval=configure.get_flag("checkpoint_interval"),
     )
     app = LightLDA(tw, td, vocab, cfg)
-    app.train()
+    # flight recorder: env-gated stall watchdog + device capture (the
+    # per-sweep beat is in train)
+    with telemetry.maybe_watchdog("lda"), telemetry.profile_window("lda"):
+        app.train()
+    telemetry.record_device_memory()
     out = configure.get_flag("output_file")
     # skip the end-of-train dump when the last periodic store already
     # wrote this exact state (a second full collective dump is pure

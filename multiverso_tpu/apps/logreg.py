@@ -322,6 +322,7 @@ class LogisticRegression:
             telemetry.step_timeline(
                 "logreg", step_no, samples=S * c.minibatch_size,
                 dispatch_s=time.perf_counter() - t_step)
+            telemetry.beat()
             step_no += 1
             losses.extend(lg)
         for s in full[len(full) - len(full) % S:] + tail:
@@ -333,6 +334,7 @@ class LogisticRegression:
             telemetry.step_timeline(
                 "logreg", step_no, samples=len(idx),
                 dispatch_s=time.perf_counter() - t_step)
+            telemetry.beat()
             step_no += 1
             losses.append(loss)
         # one transfer for all loss scalars (a tunneled TPU charges
@@ -431,7 +433,13 @@ def main(argv=None) -> None:
                         np.float32)
     else:
         X, y = synthetic_blobs(20000, cfg.input_dim, cfg.num_classes)
-    app.train(X, y)
+    # flight recorder: MVTPU_WATCHDOG=<s> arms a stall watchdog (the
+    # per-step beat is in train_epoch); MVTPU_PROFILE_DIR captures a
+    # device profile of the whole training run
+    with telemetry.maybe_watchdog("logreg"), \
+            telemetry.profile_window("logreg"):
+        app.train(X, y)
+    telemetry.record_device_memory()
     log.info("train accuracy: %.4f", app.accuracy(X, y))
     if test_file:
         Xt, yt = _densify(*parsed[test_file], cfg.input_dim, base,

@@ -216,6 +216,7 @@ class SparseLogisticRegression:
                 telemetry.step_timeline(
                     "sparse_logreg", step_no, samples=len(idx),
                     dispatch_s=time.perf_counter() - t_step)
+                telemetry.beat()
                 step_no += 1
             loss = float(np.mean(losses))
             log.info("sparse_logreg epoch %d: loss=%.4f", e, loss)
@@ -285,7 +286,12 @@ def main(argv=None) -> None:
         regular_lambda=configure.get_flag("regular_lambda"),
         epochs=configure.get_flag("epoch"))
     app = SparseLogisticRegression(cfg)
-    app.train(rows, y)
+    # flight recorder: env-gated stall watchdog + device capture (the
+    # per-step beat is in train)
+    with telemetry.maybe_watchdog("sparse_logreg"), \
+            telemetry.profile_window("sparse_logreg"):
+        app.train(rows, y)
+    telemetry.record_device_memory()
     log.info("train accuracy: %.4f", app.accuracy(rows, y))
     test = configure.get_flag("test_file")
     if test:

@@ -555,6 +555,7 @@ class WordEmbedding:
                                   core.place(lrs, mesh=self.mesh))
         telemetry.step_timeline("w2v", call_no, pairs=s * c.batch_size,
                                 dispatch_s=time.perf_counter() - t_step)
+        telemetry.beat()    # flight recorder: one heartbeat per dispatch
         self._step_no += s
         return loss
 
@@ -705,7 +706,11 @@ def main(argv=None) -> None:
         checkpoint_interval=configure.get_flag("checkpoint_interval"),
     )
     app = WordEmbedding(corpus, cfg)
-    app.train()
+    # flight recorder: env-gated stall watchdog + device capture (the
+    # per-dispatch beat is in _dispatch)
+    with telemetry.maybe_watchdog("w2v"), telemetry.profile_window("w2v"):
+        app.train()
+    telemetry.record_device_memory()
     out = configure.get_flag("output_file")
     # skip the end-of-train dump when the last periodic store already
     # wrote this exact state (a second full collective dump is pure

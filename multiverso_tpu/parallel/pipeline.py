@@ -121,16 +121,37 @@ def pipeline_apply(stage_params: Any, x: jax.Array,
         # only the last stage holds real outputs: masked psum replicates
         out = jnp.where(me == n - 1, out, jnp.zeros_like(out))
         out = lax.psum(out, axis)
-        return out.reshape(x.shape)
+        # flatten [M, B/M, ...] back to the caller's [B, ...]; derived
+        # from the ARGUMENT, not the enclosing x — the compiled closure
+        # is cached across calls and must not pin the first call's shape
+        return out.reshape((out.shape[0] * out.shape[1],)
+                           + out.shape[2:])
 
     param_specs = jax.tree.map(
         lambda leaf: P(*((axis,) + (None,) * (leaf.ndim - 1))),
         stage_params)
     x_spec = P(*((None,) * x_mb.ndim))
     from multiverso_tpu.utils.jax_compat import shard_map
-    return shard_map(local, mesh=mesh, in_specs=(param_specs, x_spec),
-                     out_specs=P(*((None,) * x.ndim)),
-                     check_vma=False)(stage_params, x_mb)
+
+    def build():
+        return shard_map(local, mesh=mesh,
+                         in_specs=(param_specs, x_spec),
+                         out_specs=P(*((None,) * x.ndim)),
+                         check_vma=False)
+
+    # cached profiled wrapper, not a bare eager shard_map call: `local`
+    # is rebuilt per call, so without the key-cache every step would be
+    # a fresh function to jax (retrace + recompile) and the flight
+    # recorder could never attribute compile time to the schedule. The
+    # key is exactly what the closure + specs capture; jit's own cache
+    # handles shape changes under the same key.
+    from multiverso_tpu.telemetry.profiling import cached_profiled_jit
+    fn = cached_profiled_jit(
+        ("pipeline_apply", stage_fn, mesh, axis, n, m,
+         jax.tree.structure(stage_params),
+         tuple(leaf.ndim for leaf in leaves), x.ndim),
+        "parallel.pipeline_apply", build)
+    return fn(stage_params, x_mb)
 
 
 def sequential_oracle(stage_params: Any, x: jax.Array,

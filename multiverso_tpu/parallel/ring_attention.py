@@ -125,8 +125,16 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
     spec = P(None, axis, None, None)
     from multiverso_tpu.utils.jax_compat import shard_map
-    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=False)(q, k, v)
+    from multiverso_tpu.telemetry.profiling import cached_profiled_jit
+    # keyed on everything `local` closes over (+ mesh for shard_map):
+    # same ring program → same profiled wrapper → one compile, one
+    # profile.* series (see cached_profiled_jit)
+    fn = cached_profiled_jit(
+        ("ring_attention", mesh, axis, causal, n, s_blk, scale),
+        "parallel.ring_attention",
+        lambda: shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec, check_vma=False))
+    return fn(q, k, v)
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
@@ -166,5 +174,10 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
     spec = P(None, axis, None, None)
     from multiverso_tpu.utils.jax_compat import shard_map
-    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=False)(q, k, v)
+    from multiverso_tpu.telemetry.profiling import cached_profiled_jit
+    fn = cached_profiled_jit(
+        ("ulysses_attention", mesh, axis, causal, n, scale),
+        "parallel.ulysses_attention",
+        lambda: shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec, check_vma=False))
+    return fn(q, k, v)

@@ -42,12 +42,12 @@ semantics.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
 
 from multiverso_tpu.tables.base import Handle, Table
+from multiverso_tpu.telemetry.profiling import profiled_jit
 from multiverso_tpu.updaters import AddOption
 
 
@@ -75,12 +75,16 @@ class FusedSuperstep:
             jax.tree.map(lambda _, t=t: t.state_sharding, t.state)
             for t in self.tables)
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2),
-                 out_shardings=(param_sh, state_sh, local_shardings, None))
+        # profiled_jit, not bare jax.jit: every app trains through a
+        # superstep, so this is THE place the flight recorder learns
+        # each program's lowering/compile wall time and HLO cost
+        # (profile.* metrics keyed fn=superstep.<name>)
         def run(params, states, locals_, options, *inputs):
             return body(params, states, locals_, options, *inputs)
 
-        self._run = run
+        self._run = profiled_jit(
+            run, name=f"superstep.{name}", donate_argnums=(0, 1, 2),
+            out_shardings=(param_sh, state_sh, local_shardings, None))
 
     def __call__(self, locals_: Any = (), *inputs: Any,
                  options: Optional[Sequence[Optional[AddOption]]] = None

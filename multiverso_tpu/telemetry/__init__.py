@@ -12,30 +12,49 @@ and a report CLI.
 - :mod:`multiverso_tpu.telemetry.aggregate` — :func:`gather_metrics` /
   :func:`fleet_snapshot` all-gather per-host snapshots through the mesh
   (single-host fallback: local only).
+- :mod:`multiverso_tpu.telemetry.watchdog` — the flight recorder's
+  stall side: heartbeat :class:`Watchdog` (+ module-level :func:`beat`)
+  that dumps all-thread stacks, a metrics snapshot, and the trace tail
+  into ``MVTPU_DUMP_DIR`` on a missed deadline, then optionally
+  self-terminates (``MVTPU_WATCHDOG_ACTION``).
+- :mod:`multiverso_tpu.telemetry.profiling` — the compile side:
+  :func:`profiled_jit` (lowering/compile wall time + XLA cost/memory
+  analysis per jitted function), :func:`record_device_memory`
+  (live-buffer and allocator gauges), :func:`profile_window`
+  (``MVTPU_PROFILE_DIR``-gated ``jax.profiler`` capture).
 - ``python -m multiverso_tpu.telemetry.report <file>`` — render any
-  telemetry artifact as a table.
+  telemetry artifact as a table, Perfetto-loadable Chrome trace
+  (``--chrome-trace``), or hot list (``--top N``).
 
 The legacy ``utils.dashboard`` API (``profile`` / ``emit_metric`` /
 ``report``) keeps working as a shim over this registry.
 """
 
-from multiverso_tpu.telemetry import aggregate, metrics, trace
+from multiverso_tpu.telemetry import (aggregate, metrics, profiling,
+                                      trace, watchdog)
 from multiverso_tpu.telemetry.aggregate import (fleet_snapshot,
                                                 gather_metrics,
                                                 merge_snapshots)
 from multiverso_tpu.telemetry.metrics import (Counter, Gauge, Histogram,
                                               MetricRegistry, counter,
                                               emit, gauge, histogram,
-                                              registry, snapshot,
-                                              write_snapshot)
+                                              host_index, registry,
+                                              snapshot, write_snapshot)
+from multiverso_tpu.telemetry.profiling import (profile_window,
+                                                profiled_jit,
+                                                record_device_memory)
 from multiverso_tpu.telemetry.trace import (read_trace, set_trace_file,
                                             span, step_timeline)
+from multiverso_tpu.telemetry.watchdog import (Watchdog, beat,
+                                               maybe_watchdog)
 
 __all__ = [
-    "aggregate", "metrics", "trace",
+    "aggregate", "metrics", "profiling", "trace", "watchdog",
     "Counter", "Gauge", "Histogram", "MetricRegistry",
-    "counter", "gauge", "histogram", "emit", "registry",
+    "counter", "gauge", "histogram", "emit", "host_index", "registry",
     "snapshot", "write_snapshot",
     "span", "step_timeline", "set_trace_file", "read_trace",
     "gather_metrics", "merge_snapshots", "fleet_snapshot",
+    "Watchdog", "beat", "maybe_watchdog",
+    "profiled_jit", "profile_window", "record_device_memory",
 ]

@@ -44,6 +44,27 @@ DEFAULT_BUCKETS = (1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 1e-1, 3e-1,
 LabelItems = Tuple[Tuple[str, str], ...]
 
 
+def host_index() -> int:
+    """This process's host index — THE identity field (with pid) that
+    snapshots, traces, log lines, and watchdog dumps all stamp, so
+    multihost artifacts correlate. ``jax.process_index()`` when a jax
+    runtime is already up (never IMPORTS jax — this module must stay
+    loadable with no backend), else ``MVTPU_HOST_ID``, else 0.
+    utils.log duplicates this lookup to stay import-free; keep them in
+    agreement."""
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return int(jax.process_index())
+        except Exception:  # pragma: no cover - uninitialised backend
+            pass
+    try:
+        return int(os.environ.get("MVTPU_HOST_ID", "0"))
+    except ValueError:
+        return 0
+
+
 def _label_items(labels: Dict[str, object]) -> LabelItems:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
 
@@ -163,7 +184,10 @@ class MetricRegistry:
         with self._lock:
             if self._jsonl is not None:
                 self._jsonl.close()
-            self._jsonl = open(path, "a") if path else None
+            # line-buffered + flush per record (emit): a SIGKILL'd or
+            # watchdog-terminated process keeps every event written up
+            # to the kill point
+            self._jsonl = open(path, "a", buffering=1) if path else None
             self._jsonl_path = path or None
 
     def emit(self, name: str, value: float, unit: str = "",
@@ -171,7 +195,8 @@ class MetricRegistry:
         """One structured metric event; also sets the gauge ``name`` so
         the last emitted value rides every snapshot/aggregation."""
         rec = {"metric": name, "value": float(value), "unit": unit,
-               "ts": time.time(), **extra}
+               "ts": time.time(), "host": host_index(),
+               "pid": os.getpid(), **extra}
         self.gauge(name).set(value)
         with self._lock:
             if self._jsonl is not None:
@@ -198,8 +223,9 @@ class MetricRegistry:
                                    "counts": list(m.counts),
                                    "count": m.count, "sum": m.sum}
         return {"kind": SNAPSHOT_KIND, "ts": time.time(),
-                "pid": os.getpid(), "counters": counters,
-                "gauges": gauges, "histograms": histograms}
+                "pid": os.getpid(), "host": host_index(),
+                "counters": counters, "gauges": gauges,
+                "histograms": histograms}
 
     def write_snapshot(self, path: str) -> dict:
         """Write the snapshot atomically (temp + rename: a reader —

@@ -1,0 +1,263 @@
+"""Compile/runtime introspection: where did the wall-clock go BEFORE
+the first step ran, and what does the compiled program cost?
+
+The r01-r05 hangs had no compile timeline — a run wedged during
+``jax.jit`` tracing, XLA compilation, or backend init looks identical
+to one wedged in a collective. :func:`profiled_jit` splits that out:
+
+- a drop-in ``jax.jit`` replacement that, on each NEW input signature,
+  runs the explicit AOT pipeline (``lower()`` then ``compile()``),
+  timing both phases into the metric registry and emitting trace spans
+  (so a watchdog dump's trace tail shows "in compile" vs "in step"):
+
+  - ``profile.lower.seconds{fn=...}`` / ``profile.compile.seconds{...}``
+    histograms + last-value gauges,
+  - ``profile.compiles{fn=...}`` counter (signature-cache misses —
+    retrace storms show up as a climbing counter),
+  - ``profile.flops{fn=...}`` / ``profile.bytes_accessed{fn=...}``
+    gauges from XLA cost analysis where the backend reports them,
+  - ``profile.memory.*{fn=...}`` gauges from XLA memory analysis
+    (argument/output/temp/generated-code bytes) where available.
+
+  The compiled executable is cached per signature and called directly
+  (jit's own cache never sees a second compile). Tracer inputs (the
+  wrapper invoked inside an outer jit/grad trace) and any AOT-call
+  mismatch fall back to the plain jitted path — profiling must never
+  change program semantics, only observe them.
+
+- :func:`record_device_memory` — live-buffer count/bytes
+  (``jax.live_arrays``) and per-device allocator stats
+  (``Device.memory_stats``) as gauges; cheap enough to call at every
+  tier boundary.
+
+- :func:`profile_window` — an optional ``jax.profiler`` device capture
+  gated by ``MVTPU_PROFILE_DIR``: set the env var and any region wrapped
+  in this context writes a TensorBoard/Perfetto-loadable device trace;
+  unset, the context is free.
+
+jax is imported lazily (call time, never module import): the report CLI
+and the bench's jax-free pre-probe phase import the telemetry package,
+and must not pay — or hang on — a backend init.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import time
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+from multiverso_tpu.telemetry import metrics as _metrics
+from multiverso_tpu.telemetry import trace as _trace
+
+
+def _leaf_sig(leaf: Any) -> Any:
+    """A hashable signature for one argument leaf: aval for arrays and
+    scalars (shape/dtype/weak_type — what jit keys on), repr for
+    anything else (static config objects)."""
+    import jax
+
+    try:
+        from jax.api_util import shaped_abstractify
+        return shaped_abstractify(leaf)
+    except Exception:
+        try:
+            return (jax.numpy.shape(leaf), jax.numpy.result_type(leaf))
+        except Exception:
+            return ("static", repr(leaf))
+
+
+class _ProfiledJit:
+    """The wrapper :func:`profiled_jit` returns. Not a public type —
+    hold it wherever a jitted callable was held before."""
+
+    def __init__(self, fn: Callable, name: str, **jit_kw: Any) -> None:
+        import jax
+
+        self._fn = fn
+        self.name = name
+        self._jit = jax.jit(fn, **jit_kw)
+        self._compiled: Dict[Tuple, Any] = {}
+        self._fallback = False
+
+    def _sig(self, args, kwargs) -> Tuple:
+        import jax
+
+        leaves, treedef = jax.tree.flatten((args, kwargs))
+        return (treedef, tuple(_leaf_sig(l) for l in leaves))
+
+    def _compile(self, sig: Tuple, args, kwargs) -> Any:
+        """AOT lower+compile for a new signature, timing both phases
+        into the registry (and as trace spans)."""
+        reg = _metrics.registry()
+        with _trace.span("profile.lower", fn=self.name):
+            t0 = time.perf_counter()
+            lowered = self._jit.lower(*args, **kwargs)
+            lower_s = time.perf_counter() - t0
+        with _trace.span("profile.compile", fn=self.name):
+            t0 = time.perf_counter()
+            compiled = lowered.compile()
+            compile_s = time.perf_counter() - t0
+        reg.counter("profile.compiles", fn=self.name).inc()
+        reg.histogram("profile.lower.seconds", fn=self.name) \
+            .observe(lower_s)
+        reg.histogram("profile.compile.seconds", fn=self.name) \
+            .observe(compile_s)
+        reg.gauge("profile.lower.last_s", fn=self.name).set(lower_s)
+        reg.gauge("profile.compile.last_s", fn=self.name).set(compile_s)
+        self._record_cost(reg, compiled)
+        self._compiled[sig] = compiled
+        return compiled
+
+    def _record_cost(self, reg, compiled) -> None:
+        """XLA cost/memory analysis where the backend reports it (the
+        shapes differ across jax versions: dict or [dict])."""
+        try:
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            if cost.get("flops"):
+                reg.gauge("profile.flops", fn=self.name) \
+                    .set(float(cost["flops"]))
+            if cost.get("bytes accessed"):
+                reg.gauge("profile.bytes_accessed", fn=self.name) \
+                    .set(float(cost["bytes accessed"]))
+        except Exception:
+            pass
+        try:
+            ma = compiled.memory_analysis()
+            for attr, key in (("argument_size_in_bytes", "args"),
+                              ("output_size_in_bytes", "out"),
+                              ("temp_size_in_bytes", "temp"),
+                              ("generated_code_size_in_bytes", "code")):
+                v = getattr(ma, attr, None)
+                if v:
+                    reg.gauge(f"profile.memory.{key}_bytes",
+                              fn=self.name).set(float(v))
+        except Exception:
+            pass
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        import jax
+
+        if self._fallback or any(
+                isinstance(l, jax.core.Tracer)
+                for l in jax.tree.leaves((args, kwargs))):
+            # inside an outer trace (grad/jit-of-jit) or after an AOT
+            # mismatch: the plain path, zero observational interference
+            return self._jit(*args, **kwargs)
+        try:
+            sig = self._sig(args, kwargs)
+            compiled = self._compiled.get(sig)
+            if compiled is None:
+                compiled = self._compile(sig, args, kwargs)
+            return compiled(*args, **kwargs)
+        except Exception:
+            # an AOT corner this wrapper didn't anticipate (committed-
+            # sharding mismatch, exotic static args): permanently hand
+            # this wrapper back to plain jit — correctness over metrics
+            self._fallback = True
+            return self._jit(*args, **kwargs)
+
+    # AOT introspection passthroughs, so holders of the wrapper keep
+    # the jitted function's surface for debugging
+    def lower(self, *args: Any, **kwargs: Any):
+        return self._jit.lower(*args, **kwargs)
+
+
+def profiled_jit(fn: Callable, *, name: Optional[str] = None,
+                 **jit_kw: Any) -> Callable:
+    """``jax.jit`` with a flight recorder (see module docstring).
+
+    ``name`` labels every metric/span (default: the function's
+    ``__name__``); remaining keywords pass through to ``jax.jit``
+    (``donate_argnums``, ``out_shardings``, ``static_argnums``, ...).
+    """
+    return _ProfiledJit(fn, name or getattr(fn, "__name__", "jit"),
+                        **jit_kw)
+
+
+_CACHE: Dict[Any, Any] = {}
+_CACHE_CAP = 64
+
+
+def cached_profiled_jit(key: Any, name: str, build: Callable[[], Callable],
+                        **jit_kw: Any) -> Callable:
+    """Keyed cache of :func:`profiled_jit` wrappers for call-site-BUILT
+    functions (the shard_map closures in ``parallel/`` are rebuilt on
+    every call): the caller hashes whatever its closure captures into
+    ``key``, and the same key returns the same wrapper — so XLA's
+    compile cache and the ``profile.*`` metrics see ONE function per
+    distinct program instead of a fresh one per call. ``build`` runs
+    only on a miss. The cache is cleared (not LRU-evicted) past
+    ``_CACHE_CAP`` keys — churny keys (e.g. lambdas rebuilt per call)
+    must not pin arbitrary meshes/closures forever."""
+    fn = _CACHE.get(key)
+    if fn is None:
+        if len(_CACHE) >= _CACHE_CAP:
+            _CACHE.clear()
+        fn = _CACHE[key] = profiled_jit(build(), name=name, **jit_kw)
+    return fn
+
+
+def record_device_memory(prefix: str = "device") -> dict:
+    """Gauge the live-buffer population and per-device allocator stats;
+    returns the recorded values (also useful in assertions). No-op dict
+    when jax has no initialized backend."""
+    reg = _metrics.registry()
+    out: dict = {}
+    try:
+        import jax
+
+        live = jax.live_arrays()
+        out["live_buffers"] = len(live)
+        out["live_bytes"] = int(sum(
+            getattr(a, "nbytes", 0) or 0 for a in live))
+        reg.gauge(f"{prefix}.live_buffers").set(out["live_buffers"])
+        reg.gauge(f"{prefix}.live_bytes").set(out["live_bytes"])
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if not stats:
+                continue          # CPU backends report nothing
+            lbl = f"{d.platform}:{d.id}"
+            for key in ("bytes_in_use", "peak_bytes_in_use",
+                        "bytes_limit"):
+                if key in stats:
+                    reg.gauge(f"{prefix}.{key}", device=lbl) \
+                        .set(float(stats[key]))
+                    out[f"{lbl}.{key}"] = int(stats[key])
+    except Exception:
+        pass
+    return out
+
+
+@contextlib.contextmanager
+def profile_window(name: str = "capture") -> Iterator[Optional[str]]:
+    """Device-profiler capture window, gated by ``MVTPU_PROFILE_DIR``:
+    when set, the wrapped region is captured with ``jax.profiler`` into
+    ``$MVTPU_PROFILE_DIR/<name>`` (TensorBoard / Perfetto loadable) and
+    the path is yielded; when unset, yields None and costs nothing.
+    Windows must not nest (jax allows one active capture)."""
+    base = os.environ.get("MVTPU_PROFILE_DIR")
+    if not base:
+        yield None
+        return
+    out = os.path.join(base, name)
+    import jax
+
+    try:
+        jax.profiler.start_trace(out)
+    except Exception as e:          # an already-active capture, etc.
+        print(f"profile_window({name!r}): start_trace failed: {e!r}",
+              file=sys.stderr)
+        yield None
+        return
+    try:
+        with _trace.span("profile.window", capture=name, dir=out):
+            yield out
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass

@@ -1,6 +1,8 @@
-"""Report CLI: render a metrics snapshot or span trace as a table.
+"""Report CLI: render a metrics snapshot or span trace as a table,
+Chrome/Perfetto trace, or top-N hot list.
 
     python -m multiverso_tpu.telemetry.report <file> [--prometheus]
+        [--chrome-trace [OUT]] [--top N]
 
 Accepts any of the telemetry layer's on-disk artifacts and autodetects
 which it got:
@@ -12,6 +14,17 @@ which it got:
   span aggregates plus the step timeline tail,
 - a metric-event JSONL (``MVTPU_METRICS_JSONL`` / ``emit_metric``
   sink) → last value per metric.
+
+``--chrome-trace [OUT]`` converts a span/step/metric JSONL into Chrome
+trace-event JSON (default OUT ``-`` = stdout) loadable in Perfetto
+(ui.perfetto.dev) or chrome://tracing: one process track per
+(host, pid), one thread lane per host thread, spans as nested complete
+events, step heartbeats as instants, metric events as counter series.
+
+``--top N`` prints the N slowest individual spans of a trace (with
+their timestamps — "what was in flight when it died"), or a snapshot's
+N largest counters (hottest tables by bytes/ops) and histograms by
+total time.
 
 Pure stdlib, never imports jax: it must run against the artifact of a
 HUNG run (the round-5 bench probes wedged with zero diagnostic signal —
@@ -114,7 +127,8 @@ def render_trace(records: List[dict]) -> str:
             extra = ", ".join(
                 f"{k}={_num(v) if isinstance(v, (int, float)) else v}"
                 for k, v in sorted(r.items())
-                if k not in ("kind", "name", "step", "ts", "parent"))
+                if k not in ("kind", "name", "step", "ts", "parent",
+                             "host", "pid", "tid"))
             rows.append([r["name"], r["step"], f"{r['ts']:.3f}", extra])
         out.append(f"steps (last {len(rows)} of {len(steps)}):\n"
                    + _table(rows, ["name", "step", "ts", "fields"]))
@@ -122,6 +136,103 @@ def render_trace(records: List[dict]) -> str:
         out.append(f"({other} unrecognized record(s) skipped)")
     if not out:
         return "(empty trace)"
+    return "\n\n".join(out)
+
+
+def to_chrome_trace(records: List[dict]) -> dict:
+    """Span/step/metric JSONL records → Chrome trace-event JSON
+    (Perfetto / chrome://tracing loadable).
+
+    Tracks: each distinct (host, pid) becomes one chrome "process"
+    (renamed ``host<h>/pid<p>`` via metadata events) and each distinct
+    host thread one lane inside it — chrome pids/tids are small
+    synthetic ints so two hosts reusing an OS pid can't merge tracks.
+    Spans map to "X" complete events (ts/dur in µs; same-thread nesting
+    renders as stacked slices), step heartbeats to "i" instants, and
+    metric events to "C" counter series."""
+    events: List[dict] = []
+    procs: Dict[tuple, int] = {}
+    threads: Dict[tuple, int] = {}
+
+    def track(r: dict) -> tuple:
+        host, pid = r.get("host", 0), r.get("pid", 0)
+        cpid = procs.get((host, pid))
+        if cpid is None:
+            cpid = procs[(host, pid)] = len(procs) + 1
+            events.append({"ph": "M", "name": "process_name",
+                           "pid": cpid, "tid": 0,
+                           "args": {"name": f"host{host}/pid{pid}"}})
+        tkey = (host, pid, r.get("tid", 0))
+        ctid = threads.get(tkey)
+        if ctid is None:
+            ctid = threads[tkey] = \
+                sum(1 for k in threads if k[:2] == (host, pid)) + 1
+            events.append({"ph": "M", "name": "thread_name",
+                           "pid": cpid, "tid": ctid,
+                           "args": {"name": f"thread-{tkey[2]}"}})
+        return cpid, ctid
+
+    for r in records:
+        kind = r.get("kind")
+        if kind == "span":
+            cpid, ctid = track(r)
+            args = dict(r.get("attrs") or {})
+            args["span_id"] = r.get("id")
+            if r.get("parent") is not None:
+                args["parent"] = r["parent"]
+            events.append({"name": r["name"], "ph": "X", "cat": "span",
+                           "ts": float(r["ts"]) * 1e6,
+                           "dur": max(float(r.get("dur_s", 0)), 0) * 1e6,
+                           "pid": cpid, "tid": ctid, "args": args})
+        elif kind == "step":
+            cpid, ctid = track(r)
+            args = {k: v for k, v in r.items()
+                    if k not in ("kind", "ts", "host", "pid", "tid",
+                                 "parent")}
+            events.append({"name": f"{r['name']} step {r['step']}",
+                           "ph": "i", "cat": "step", "s": "t",
+                           "ts": float(r["ts"]) * 1e6,
+                           "pid": cpid, "tid": ctid, "args": args})
+        elif "metric" in r:
+            cpid, _ = track(r)
+            events.append({"name": r["metric"], "ph": "C",
+                           "ts": float(r.get("ts", 0)) * 1e6,
+                           "pid": cpid,
+                           "args": {"value": r.get("value", 0)}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def render_top(kind: str, data, n: int) -> str:
+    """The N hottest items of any artifact (see module docstring)."""
+    out: List[str] = []
+    if kind == "snapshot":
+        counters = sorted(data.get("counters", {}).items(),
+                          key=lambda kv: -kv[1])[:n]
+        if counters:
+            rows = [[k, _num(v)] for k, v in counters]
+            out.append(f"top {len(rows)} counters:\n"
+                       + _table(rows, ["name", "value"]))
+        hists = sorted(data.get("histograms", {}).items(),
+                       key=lambda kv: -kv[1]["sum"])[:n]
+        if hists:
+            rows = [[k, _num(h["count"]), f"{h['sum']:.4f}",
+                     f"{(h['sum'] / h['count'] if h['count'] else 0) * 1e3:.3f}"]
+                    for k, h in hists]
+            out.append(f"top {len(rows)} histograms by total time:\n"
+                       + _table(rows, ["name", "count", "sum_s",
+                                       "mean_ms"]))
+    else:
+        spans = sorted((r for r in data if r.get("kind") == "span"),
+                       key=lambda r: -float(r.get("dur_s", 0)))[:n]
+        if spans:
+            rows = [[r["name"], f"{float(r['dur_s']) * 1e3:.3f}",
+                     f"{r['ts']:.3f}",
+                     f"h{r.get('host', 0)}:{r.get('pid', 0)}"]
+                    for r in spans]
+            out.append(f"top {len(rows)} slowest spans:\n"
+                       + _table(rows, ["name", "dur_ms", "ts", "who"]))
+    if not out:
+        return "(nothing to rank)"
     return "\n\n".join(out)
 
 
@@ -162,8 +273,35 @@ def main(argv=None) -> int:
                                 "event JSONL")
     p.add_argument("--prometheus", action="store_true",
                    help="emit a snapshot in Prometheus text format")
+    p.add_argument("--chrome-trace", nargs="?", const="-", default=None,
+                   metavar="OUT",
+                   help="convert a trace/event JSONL to Chrome "
+                        "trace-event JSON (Perfetto/chrome://tracing "
+                        "loadable); OUT defaults to stdout")
+    p.add_argument("--top", type=int, default=0, metavar="N",
+                   help="print the N slowest spans (trace) or largest "
+                        "counters/histograms (snapshot)")
     args = p.parse_args(argv)
     kind, data = _load(args.path)
+    if args.chrome_trace is not None:
+        if kind == "snapshot":
+            print("--chrome-trace requires a trace or metric-event "
+                  "JSONL, not a snapshot", file=sys.stderr)
+            return 2
+        doc = to_chrome_trace(data)
+        if args.chrome_trace == "-":
+            json.dump(doc, sys.stdout)
+            print()
+        else:
+            with open(args.chrome_trace, "w") as f:
+                json.dump(doc, f)
+            print(f"wrote {len(doc['traceEvents'])} events to "
+                  f"{args.chrome_trace} (load at ui.perfetto.dev or "
+                  "chrome://tracing)", file=sys.stderr)
+        return 0
+    if args.top:
+        print(render_top(kind, data, args.top))
+        return 0
     if args.prometheus:
         if kind != "snapshot":
             print("--prometheus requires a registry snapshot",

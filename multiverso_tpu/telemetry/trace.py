@@ -59,7 +59,10 @@ def set_trace_file(path: Optional[str]) -> None:
         if path:
             parent = os.path.dirname(os.path.abspath(path))
             os.makedirs(parent, exist_ok=True)
-            _FILE = open(path, "a")
+            # line-buffered + flush per record (_emit): a SIGKILL'd or
+            # watchdog-terminated process keeps every span written up
+            # to the kill point
+            _FILE = open(path, "a", buffering=1)
         else:
             _FILE = None
         _PATH = path or None
@@ -70,6 +73,13 @@ def trace_path() -> Optional[str]:
 
 
 def _emit(rec: dict) -> None:
+    # identity stamps: host/pid pick the Perfetto process track (and
+    # correlate with snapshots, log lines, and watchdog dumps); tid
+    # separates concurrent host threads so span nesting stays true
+    from multiverso_tpu.telemetry.metrics import host_index
+    rec.setdefault("host", host_index())
+    rec.setdefault("pid", os.getpid())
+    rec.setdefault("tid", threading.get_ident())
     with _LOCK:
         if _FILE is not None:
             _FILE.write(json.dumps(rec) + "\n")

@@ -1,0 +1,308 @@
+"""Stall watchdog: a daemon-thread heartbeat that turns a hung run into
+a post-mortem instead of an empty log.
+
+Every bench round so far (BENCH_r01-r05) died ``rc=124`` with "hang,
+killed after 180s" and NO stack, NO device state, NO compile timeline —
+the telemetry spine records what healthy runs do, but nothing diagnosed
+a wedged one. This module closes that gap:
+
+- :class:`Watchdog` — a daemon thread armed with ``deadline_s``;
+  instrumented code calls :meth:`Watchdog.beat` (or the module-level
+  :func:`beat`, which beats every active watchdog) once per step/probe.
+  A missed deadline triggers the escalation ladder:
+
+  1. **warn**  — one loud stderr line (always),
+  2. **dump**  — write a post-mortem directory under ``MVTPU_DUMP_DIR``:
+     all-thread stacks (``faulthandler``), the metrics registry
+     snapshot, the tail of the active span trace, and a manifest,
+  3. **kill** — after dumping, ``os._exit(SELF_TERMINATE_RC)`` so a
+     wedged process dies fast with its diagnostics on disk instead of
+     hanging into a driver timeout that leaves nothing.
+
+  The configured ``action`` is the HIGHEST rung taken (default
+  ``dump``; override per-watchdog or via ``MVTPU_WATCHDOG_ACTION``).
+  A beat after a stall re-arms the ladder (transient stalls — e.g. a
+  slow compile — dump once, then recover).
+
+- :func:`watchdog` — ``with watchdog(60) as w: ... w.beat()`` context
+  manager (start/stop tied to the block).
+- :func:`maybe_watchdog` — the env-gated variant apps use: arms only
+  when ``MVTPU_WATCHDOG`` (seconds) is set, else a no-op context.
+
+STANDALONE BY DESIGN: this file imports ONLY stdlib at module level and
+resolves the sibling metrics/trace modules through ``sys.modules`` at
+dump time. That lets ``bench.py`` load it by file path in the jax-free
+pre-probe phase (same trick as its metrics binding), and lets the chip
+probe CHILD — whose whole job is surviving a wedged ``import jax`` —
+arm a watchdog with nothing else importable. A dump with no metrics or
+trace module loaded still writes thread stacks + manifest.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import faulthandler
+import os
+import sys
+import threading
+import time
+from typing import Iterator, List, Optional
+
+DUMP_KIND = "mvtpu.watchdog.dump.v1"
+# EX_SOFTWARE, distinct from the driver's timeout rc=124 and the bench
+# probe's rc=2 — a capture showing 70 means "the watchdog shot a wedged
+# process AFTER writing its post-mortem"
+SELF_TERMINATE_RC = 70
+ACTIONS = ("warn", "dump", "kill")
+
+_ACTIVE_LOCK = threading.Lock()
+_ACTIVE: List["Watchdog"] = []
+
+
+def _now() -> float:
+    return time.monotonic()
+
+
+def _warn(msg: str) -> None:
+    """Stderr, not utils.log: the logger lives behind the package
+    __init__ (which imports jax) and a watchdog must stay loadable —
+    and audible — in a process where jax is exactly what's wedged."""
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime())
+    print(f"[WARN] [{stamp}] [{os.getpid()}] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def _sibling(name: str):
+    """The telemetry sibling module IF already loaded (never imports:
+    pulling multiverso_tpu.__init__ would drag jax into a process that
+    may be jax-free on purpose)."""
+    return sys.modules.get(f"multiverso_tpu.telemetry.{name}")
+
+
+def _host_index() -> int:
+    """Same identity the aggregation layer stamps on snapshots."""
+    m = _sibling("metrics")
+    if m is not None and hasattr(m, "host_index"):
+        return m.host_index()
+    try:
+        return int(os.environ.get("MVTPU_HOST_ID", "0"))
+    except ValueError:
+        return 0
+
+
+def default_dump_dir() -> str:
+    return os.environ.get("MVTPU_DUMP_DIR", "mvtpu_dump")
+
+
+def _resolve_action(action: Optional[str]) -> str:
+    a = action or os.environ.get("MVTPU_WATCHDOG_ACTION") or "dump"
+    a = a.strip().lower()
+    if a not in ACTIONS:
+        _warn(f"watchdog: unknown action {a!r}; using 'dump' "
+              f"(valid: {ACTIONS})")
+        a = "dump"
+    return a
+
+
+class Watchdog:
+    """Heartbeat watchdog (see module docstring for the ladder)."""
+
+    def __init__(self, deadline_s: float, *, name: str = "watchdog",
+                 action: Optional[str] = None,
+                 dump_dir: Optional[str] = None,
+                 poll_s: Optional[float] = None) -> None:
+        if deadline_s <= 0:
+            raise ValueError(f"watchdog {name!r}: deadline_s must be "
+                             f"> 0, got {deadline_s}")
+        self.name = name
+        self.deadline_s = float(deadline_s)
+        self.action = _resolve_action(action)
+        self.dump_dir = dump_dir or default_dump_dir()
+        self.stalls = 0
+        self.last_dump_path: Optional[str] = None
+        self._poll_s = poll_s if poll_s is not None else \
+            min(max(self.deadline_s / 4.0, 0.01), 1.0)
+        self._beats = 0
+        self._last_beat = _now()
+        self._tripped = False     # dumped for the CURRENT stall already
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Watchdog":
+        if self._thread is not None:
+            return self
+        self._last_beat = _now()
+        self._thread = threading.Thread(
+            target=self._run, name=f"mvtpu-watchdog-{self.name}",
+            daemon=True)
+        self._thread.start()
+        with _ACTIVE_LOCK:
+            _ACTIVE.append(self)
+        return self
+
+    def stop(self) -> None:
+        with _ACTIVE_LOCK:
+            if self in _ACTIVE:
+                _ACTIVE.remove(self)
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def beat(self) -> None:
+        """One heartbeat; resets the deadline and re-arms the ladder."""
+        with self._lock:
+            self._beats += 1
+            self._last_beat = _now()
+            self._tripped = False
+
+    # -- the watcher thread ------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            with self._lock:
+                silent = _now() - self._last_beat
+                tripped = self._tripped
+            if silent <= self.deadline_s or tripped:
+                continue
+            with self._lock:
+                self._tripped = True
+            self._on_stall(silent)
+
+    def _on_stall(self, silent_s: float) -> None:
+        self.stalls += 1
+        _warn(f"watchdog {self.name!r}: no beat for {silent_s:.1f}s "
+              f"(deadline {self.deadline_s:.1f}s, beats={self._beats}) "
+              f"— escalation: {self.action}")
+        m = _sibling("metrics")
+        if m is not None:
+            try:
+                m.counter("watchdog.stalls", watchdog=self.name).inc()
+            except Exception:  # diagnostics must never raise
+                pass
+        if self.action == "warn":
+            return
+        try:
+            self.last_dump_path = self.dump(silent_s=silent_s)
+            _warn(f"watchdog {self.name!r}: post-mortem dumped to "
+                  f"{self.last_dump_path}")
+        except Exception as e:  # pragma: no cover - defensive
+            _warn(f"watchdog {self.name!r}: dump failed: {e!r}")
+        if self.action == "kill":
+            _warn(f"watchdog {self.name!r}: self-terminating "
+                  f"(rc={SELF_TERMINATE_RC})")
+            sys.stderr.flush()
+            sys.stdout.flush()
+            os._exit(SELF_TERMINATE_RC)
+
+    # -- the post-mortem dump ----------------------------------------------
+
+    def dump(self, silent_s: Optional[float] = None) -> str:
+        """Write the post-mortem directory; returns its path. Callable
+        directly (e.g. from a signal handler) — the watchdog thread uses
+        it on a missed deadline."""
+        safe = "".join(c if c.isalnum() or c in "-_" else "-"
+                       for c in self.name)
+        base = os.path.join(
+            self.dump_dir,
+            f"dump-{safe}-h{_host_index()}-p{os.getpid()}-{self.stalls}")
+        path = base
+        n = 1
+        while os.path.exists(path):            # never clobber a prior dump
+            n += 1
+            path = f"{base}.{n}"
+        os.makedirs(path, exist_ok=True)
+
+        # 1. all-thread stacks — the one artifact every hung-run theory
+        # needs first; written before anything that could itself block
+        with open(os.path.join(path, "stacks.txt"), "w") as f:
+            f.write(f"# watchdog {self.name!r}: all-thread stacks, "
+                    f"pid={os.getpid()}\n")
+            f.flush()
+            faulthandler.dump_traceback(file=f, all_threads=True)
+
+        # 2. metrics registry snapshot (when the module is loaded)
+        metrics = _sibling("metrics")
+        if metrics is not None:
+            try:
+                metrics.write_snapshot(os.path.join(path, "metrics.json"))
+            except Exception as e:
+                _warn(f"watchdog: metrics snapshot failed: {e!r}")
+
+        # 3. tail of the active span trace (how far did the run get?)
+        trace = _sibling("trace")
+        trace_file = trace.trace_path() if trace is not None else None
+        if trace_file and os.path.exists(trace_file):
+            try:
+                with open(trace_file, "rb") as src:
+                    src.seek(0, os.SEEK_END)
+                    start = max(src.tell() - (1 << 16), 0)
+                    src.seek(start)
+                    tail = src.read()
+                if start and b"\n" in tail:
+                    # drop the torn leading line from the mid-file seek
+                    tail = tail[tail.find(b"\n") + 1:]
+                with open(os.path.join(path, "trace_tail.jsonl"),
+                          "wb") as dst:
+                    dst.write(tail)
+            except OSError as e:
+                _warn(f"watchdog: trace tail failed: {e!r}")
+
+        # 4. manifest — ties the artifacts to who/when/why
+        import json
+        with open(os.path.join(path, "watchdog.json"), "w") as f:
+            json.dump({
+                "kind": DUMP_KIND, "name": self.name,
+                "deadline_s": self.deadline_s,
+                "silent_s": silent_s, "beats": self._beats,
+                "stalls": self.stalls, "action": self.action,
+                "ts": time.time(), "pid": os.getpid(),
+                "host": _host_index(), "argv": sys.argv,
+            }, f, indent=1)
+        return path
+
+
+def beat() -> None:
+    """Beat every active watchdog (no-op when none is armed) — the one
+    line apps put in their step loops."""
+    with _ACTIVE_LOCK:
+        active = list(_ACTIVE)
+    for w in active:
+        w.beat()
+
+
+@contextlib.contextmanager
+def watchdog(deadline_s: float, *, name: str = "watchdog",
+             action: Optional[str] = None,
+             dump_dir: Optional[str] = None) -> Iterator[Watchdog]:
+    """Arm a watchdog for the block: ``with watchdog(60) as w: ...``."""
+    w = Watchdog(deadline_s, name=name, action=action,
+                 dump_dir=dump_dir).start()
+    try:
+        yield w
+    finally:
+        w.stop()
+
+
+@contextlib.contextmanager
+def maybe_watchdog(name: str, *, default_s: float = 0.0,
+                   action: Optional[str] = None
+                   ) -> Iterator[Optional[Watchdog]]:
+    """Env-gated watchdog: armed with ``MVTPU_WATCHDOG`` seconds when
+    set (> 0), else a no-op context yielding None. Apps wrap their
+    train loops in this so one env var turns any run into a
+    flight-recorded one."""
+    raw = os.environ.get("MVTPU_WATCHDOG", "")
+    try:
+        deadline = float(raw) if raw else default_s
+    except ValueError:
+        _warn(f"watchdog: malformed MVTPU_WATCHDOG={raw!r}; disabled")
+        deadline = 0.0
+    if deadline <= 0:
+        yield None
+        return
+    with watchdog(deadline, name=name, action=action) as w:
+        yield w

@@ -25,6 +25,24 @@ _NAME_LEVELS = {v.lower(): k for k, v in _LEVEL_NAMES.items()}
 _NAME_LEVELS["warning"] = WARN
 
 
+def _host_index() -> int:
+    """Host identity stamp — the SAME fields the telemetry aggregation
+    layer puts on snapshots (metrics.host_index duplicates this lookup;
+    keep them in agreement), so multihost logs, traces, and watchdog
+    dumps correlate by (host, pid). Never imports jax: the logger must
+    work in jax-free processes (report CLI, bench pre-probe)."""
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return int(jax.process_index())
+        except Exception:
+            pass
+    try:
+        return int(os.environ.get("MVTPU_HOST_ID", "0"))
+    except ValueError:
+        return 0
+
+
 class Logger:
     def __init__(self, level: int = INFO, file: Optional[str] = None) -> None:
         self._level = level
@@ -57,8 +75,8 @@ class Logger:
             return
         msg = (fmt % args) if args else fmt
         stamp = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime())
-        pid = os.getpid()
-        line = f"[{_LEVEL_NAMES[level]}] [{stamp}] [{pid}] {msg}"
+        ident = f"h{_host_index()}:{os.getpid()}"
+        line = f"[{_LEVEL_NAMES[level]}] [{stamp}] [{ident}] {msg}"
         with self._lock:
             print(line, file=sys.stderr, flush=True)
             if self._file is not None:

@@ -282,6 +282,101 @@ def test_probe_chip_deadline_env_override(bench_mod, monkeypatch):
     assert calls["n"] == 2 and len(slept) == 1
 
 
+def test_probe_chip_aborts_after_consecutive_hang_kills(bench_mod,
+                                                        monkeypatch):
+    """The r01-r05 failure mode: seven identical 180s hang-kills burned
+    the whole 1800s window. Three consecutive hangs now abort with
+    rc=2 (the wedge is not clearing this window) well inside the
+    deadline."""
+    import subprocess
+    bench, _ = bench_mod
+    calls = {"n": 0}
+
+    def always_hang(*a, **k):
+        calls["n"] += 1
+        raise subprocess.TimeoutExpired(
+            cmd="probe", timeout=k["timeout"],
+            stderr=b"[WARN] watchdog 'bench.probe.child': no beat")
+
+    slept = []
+    monkeypatch.setattr("subprocess.run", always_hang)
+    monkeypatch.setattr(bench.time, "sleep", slept.append)
+    with pytest.raises(SystemExit) as e:
+        bench._probe_chip(timeout_s=1.0, deadline_s=3600.0,
+                          retry_wait_s=60.0)
+    assert e.value.code == 2
+    assert calls["n"] == 3               # bounded, not deadline-bound
+    assert len(slept) == 2
+
+
+def test_probe_chip_rc_failure_resets_hang_streak(bench_mod, monkeypatch):
+    """The abort is for CONSECUTIVE hangs: an interleaved quick rc
+    failure (a different signature) resets the streak."""
+    import subprocess
+    bench, _ = bench_mod
+    calls = {"n": 0}
+
+    def alternate(*a, **k):
+        calls["n"] += 1
+        if calls["n"] % 3 == 0:          # every third probe exits fast
+
+            class P:
+                returncode = 1
+                stderr = "transient plugin error"
+            return P()
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=k["timeout"])
+
+    monkeypatch.setattr("subprocess.run", alternate)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    with pytest.raises(SystemExit) as e:
+        bench._probe_chip(timeout_s=1.0, deadline_s=3600.0,
+                          retry_wait_s=1.0, max_rc_failures=5)
+    assert e.value.code == 2
+    # the rc-failure cap fired (5 rc failures = 15 probes), never the
+    # 3-hang abort — the streak reset each time
+    assert calls["n"] == 15
+
+
+def test_probe_child_arms_standalone_watchdog(bench_mod):
+    """The probe child's source must arm the file-path-loaded watchdog
+    BEFORE `import jax` — the half-timeout deadline is what turns a
+    wedged backend init into on-disk thread stacks."""
+    bench, _ = bench_mod
+    src = bench._probe_src(timeout_s=180.0)
+    assert os.path.exists(bench.WATCHDOG_PATH)
+    assert src.index("watchdog") < src.index("import jax")
+    assert "90.0" in src                  # half the parent kill timeout
+    assert "action='dump'" in src
+    # and it must at least compile as the -c payload it becomes
+    compile(src, "<probe>", "exec")
+
+
+def test_report_dump_artifacts_prints_new_dumps(bench_mod, tmp_path,
+                                                capsys):
+    """Hang-kill diagnostics: only dumps newer than the attempt start
+    are surfaced, with stacks inlined for the driver's tail capture."""
+    bench, _ = bench_mod
+    old = tmp_path / "dump-old-h0-p1-1"
+    old.mkdir()
+    (old / "stacks.txt").write_text("OLD STACK")
+    os.utime(old, (1.0, 1.0))
+    new = tmp_path / "dump-probe-h0-p2-1"
+    new.mkdir()
+    (new / "stacks.txt").write_text("File \"jax/x.py\" line 1 in init")
+    (new / "watchdog.json").write_text('{"kind": "x"}')
+    bench._report_dump_artifacts(str(tmp_path), since=100.0)
+    err = capsys.readouterr().err
+    assert "dump-probe" in err and "jax/x.py" in err
+    assert "OLD STACK" not in err
+
+
+def test_text_tail_handles_bytes_str_none(bench_mod):
+    bench, _ = bench_mod
+    assert bench._text_tail(None) == ""
+    assert bench._text_tail(b"abc\xff", 10) == "abc�"
+    assert bench._text_tail("x" * 50, 10) == "x" * 10
+
+
 def test_probe_chip_deterministic_rc_failure_exits_early(bench_mod,
                                                          monkeypatch):
     """A quick nonzero probe exit (chip absent / fell back to CPU) is

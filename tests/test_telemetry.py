@@ -150,12 +150,17 @@ class TestSpans:
         assert outer["attrs"] == {"phase": "x"}
 
     def test_step_timeline_records(self, tmp_path):
+        import os
         path = str(tmp_path / "trace.jsonl")
         trace.set_trace_file(path)
         telemetry.step_timeline("app", 7, tokens=128, dispatch_s=0.5)
         (rec,) = trace.read_trace(path)
+        # identity stamps (host/pid/tid) ride every record so multihost
+        # traces correlate with snapshots/logs/dumps
         assert rec == {"kind": "step", "name": "app", "step": 7,
-                       "ts": rec["ts"], "tokens": 128, "dispatch_s": 0.5}
+                       "ts": rec["ts"], "tokens": 128, "dispatch_s": 0.5,
+                       "host": rec["host"], "pid": os.getpid(),
+                       "tid": rec["tid"]}
 
     def test_no_sink_is_silent(self):
         with telemetry.span("untraced"):

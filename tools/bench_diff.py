@@ -1,0 +1,224 @@
+"""Compare two bench artifacts and flag regressions.
+
+    python tools/bench_diff.py OLD NEW [--threshold PCT] [--watch KEY]...
+    python tools/bench_diff.py --selftest
+
+Accepts any pairing of the bench pipeline's JSON artifacts and
+autodetects each side:
+
+- a driver trajectory capture (``BENCH_rXX.json``: ``{"rc", "tail",
+  "parsed"}`` — the metric line rides ``parsed``),
+- a raw bench metric line (the last stdout line of ``bench.py``),
+- a telemetry registry snapshot (``bench_telemetry.json``,
+  ``kind == "mvtpu.metrics.v1"`` — counters/gauges become
+  ``counter:...`` / ``gauge:...`` keys; step-time histograms become
+  ``hist_mean_s:...``).
+
+Prints every shared numeric key with old/new/delta%, plus keys present
+on only one side. Exit status is the CI contract: 0 when every watched
+key holds, 1 when a watched key REGRESSED (dropped) by more than
+``--threshold`` percent (watched metrics are throughputs — higher is
+better; improvements never fail), 2 on unusable input. Default watch
+list: the two metrics of record plus the e2e tier (applied when
+present; ``--watch`` replaces it).
+
+Pure stdlib, no jax — it must run on the same wedged-tunnel hosts the
+report CLI serves, and in CI (``make bench-diff`` /
+``make ci``'s selftest hook).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Tuple
+
+SNAPSHOT_KIND = "mvtpu.metrics.v1"
+DEFAULT_WATCH = ("value", "e2e_words_per_sec", "lda_doc_tokens_per_sec")
+
+
+def _flatten(prefix: str, obj, out: Dict[str, float]) -> None:
+    if isinstance(obj, bool):
+        return
+    if isinstance(obj, (int, float)):
+        out[prefix] = float(obj)
+    elif isinstance(obj, dict):
+        for k, v in obj.items():
+            _flatten(f"{prefix}.{k}" if prefix else str(k), v, out)
+
+
+def load_metrics(path: str) -> Dict[str, float]:
+    """One artifact → flat {key: number} (see module docstring)."""
+    with open(path) as f:
+        try:
+            doc = json.load(f)
+        except ValueError as e:
+            raise SystemExit(f"bench_diff: {path}: not JSON ({e})")
+    if not isinstance(doc, dict):
+        raise SystemExit(f"bench_diff: {path}: expected a JSON object")
+    if doc.get("kind") == SNAPSHOT_KIND:
+        out: Dict[str, float] = {}
+        for k, v in doc.get("counters", {}).items():
+            out[f"counter:{k}"] = float(v)
+        for k, v in doc.get("gauges", {}).items():
+            out[f"gauge:{k}"] = float(v)
+        for k, h in doc.get("histograms", {}).items():
+            if h.get("count"):
+                out[f"hist_mean_s:{k}"] = h["sum"] / h["count"]
+                out[f"hist_count:{k}"] = float(h["count"])
+        return out
+    if "parsed" in doc:                       # driver trajectory capture
+        parsed = doc.get("parsed")
+        if not isinstance(parsed, dict):
+            raise SystemExit(
+                f"bench_diff: {path}: capture has no parsed metric line "
+                f"(rc={doc.get('rc')}) — nothing to compare")
+        doc = parsed
+    out = {}
+    _flatten("", doc, out)
+    out.pop("ts", None)
+    return out
+
+
+def diff(old: Dict[str, float], new: Dict[str, float],
+         watch: Tuple[str, ...], threshold_pct: float
+         ) -> Tuple[List[List[str]], List[str], List[str]]:
+    """(table rows, regressions, only-one-side notes)."""
+    rows: List[List[str]] = []
+    regressions: List[str] = []
+    for k in sorted(set(old) | set(new)):
+        if k not in old or k not in new:
+            continue
+        o, n = old[k], new[k]
+        pct = (n - o) / abs(o) * 100.0 if o else (0.0 if n == o
+                                                  else float("inf"))
+        mark = ""
+        if k in watch and pct < -threshold_pct:
+            mark = "REGRESSED"
+            regressions.append(
+                f"{k}: {o:g} -> {n:g} ({pct:+.1f}% < -{threshold_pct:g}%)")
+        elif k in watch:
+            mark = "watched"
+        rows.append([k, f"{o:g}", f"{n:g}",
+                     f"{pct:+.1f}%" if pct == pct else "?", mark])
+    notes = [f"only in old: {k} = {old[k]:g}"
+             for k in sorted(set(old) - set(new))]
+    notes += [f"only in new: {k} = {new[k]:g}"
+              for k in sorted(set(new) - set(old))]
+    return rows, regressions, notes
+
+
+def _render(rows: List[List[str]]) -> str:
+    header = ["key", "old", "new", "delta", ""]
+    widths = [max(len(r[i]) for r in [header] + rows)
+              for i in range(len(header))]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    return "\n".join([fmt.format(*header).rstrip()]
+                     + [fmt.format(*r).rstrip() for r in rows])
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python tools/bench_diff.py",
+        description="Diff two bench artifacts; nonzero exit on a "
+                    "watched-metric regression past the threshold.")
+    p.add_argument("old", nargs="?", help="baseline artifact (JSON)")
+    p.add_argument("new", nargs="?", help="candidate artifact (JSON)")
+    p.add_argument("--threshold", type=float, default=10.0,
+                   metavar="PCT", help="regression tolerance in percent "
+                                       "(default 10)")
+    p.add_argument("--watch", action="append", default=[], metavar="KEY",
+                   help="metric key that must not regress (repeatable; "
+                        "replaces the default watch list)")
+    p.add_argument("--selftest", action="store_true",
+                   help="run the built-in self-check and exit")
+    args = p.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    if not args.old or not args.new:
+        p.error("OLD and NEW artifacts are required (or --selftest)")
+    try:
+        old = load_metrics(args.old)
+        new = load_metrics(args.new)
+    except SystemExit as e:
+        print(e.code if isinstance(e.code, str) else e, file=sys.stderr)
+        return 2
+    watch = tuple(args.watch) if args.watch else DEFAULT_WATCH
+    rows, regressions, notes = diff(old, new, watch, args.threshold)
+    if rows:
+        print(_render(rows))
+    for n in notes:
+        print(n)
+    if regressions:
+        print("\nREGRESSIONS past threshold "
+              f"{args.threshold:g}%:", file=sys.stderr)
+        for r in regressions:
+            print(f"  {r}", file=sys.stderr)
+        return 1
+    if not rows:
+        print("no shared numeric keys — nothing compared",
+              file=sys.stderr)
+    return 0
+
+
+def selftest() -> int:
+    """Hermetic check of the load/diff/exit contract (the `make ci`
+    hook): builds artifacts of each accepted shape in a temp dir and
+    asserts the comparisons and exit codes."""
+    import os
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        def put(name, doc):
+            path = os.path.join(tmp, name)
+            with open(path, "w") as f:
+                json.dump(doc, f)
+            return path
+
+        line_old = {"metric": "w2v_words_per_sec_per_chip",
+                    "value": 1000.0, "unit": "words/s",
+                    "e2e_words_per_sec": 500.0,
+                    "lda_doc_tokens_per_sec": 2e6,
+                    "w2v_roofline": {"mxu_util_pct": 0.5}}
+        line_ok = dict(line_old, value=980.0,
+                       e2e_words_per_sec=505.0)         # -2%: inside
+        line_bad = dict(line_old, value=500.0)          # -50%: regressed
+        cap_old = put("cap_old.json", {"rc": 0, "tail": "",
+                                       "parsed": line_old})
+        raw_ok = put("ok.json", line_ok)
+        raw_bad = put("bad.json", line_bad)
+        assert main([cap_old, raw_ok]) == 0, "within-threshold must pass"
+        assert main([cap_old, raw_bad]) == 1, "regression must fail"
+        assert main([cap_old, raw_bad, "--threshold", "60"]) == 0, \
+            "a loose threshold must pass"
+        assert main([cap_old, raw_bad, "--watch",
+                     "lda_doc_tokens_per_sec"]) == 0, \
+            "--watch replaces the default list"
+        # nested keys flatten (roofline rides along, unwatched)
+        assert "w2v_roofline.mxu_util_pct" in load_metrics(raw_ok)
+        # snapshot shape: counters/gauges/histograms flatten + compare
+        snap = {"kind": SNAPSHOT_KIND,
+                "counters": {"table.add.bytes{table=0:t}": 100.0},
+                "gauges": {"w2v.words_per_sec": 10.0},
+                "histograms": {"dispatch.seconds": {
+                    "bounds": [1.0], "counts": [2, 0], "count": 2,
+                    "sum": 0.5}}}
+        snap2 = json.loads(json.dumps(snap))
+        snap2["gauges"]["w2v.words_per_sec"] = 5.0
+        s_old, s_new = put("s_old.json", snap), put("s_new.json", snap2)
+        assert main([s_old, s_new]) == 0, "unwatched gauge drop passes"
+        assert main([s_old, s_new, "--watch",
+                     "gauge:w2v.words_per_sec"]) == 1, \
+            "watched snapshot gauge regression must fail"
+        m = load_metrics(s_old)
+        assert m["hist_mean_s:dispatch.seconds"] == 0.25
+        # unusable inputs exit 2, not a traceback
+        hung = put("hung.json", {"rc": 124, "tail": "...", "parsed": None})
+        assert main([hung, raw_ok]) == 2, "no parsed line -> exit 2"
+    print("bench_diff selftest: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
