@@ -13,6 +13,9 @@
 #                   this image) over the package, tests, and bench
 #   make bench-diff - compare two bench artifacts (OLD=... NEW=...);
 #                   nonzero exit when a watched metric regresses
+#   make client-bench - worker-side client pipeline micro-bench
+#                   (coalescing / cache / staging) at tiny sizes on CPU;
+#                   drop MVTPU_CLIENT_BENCH_TINY for real sizes
 #   make native   - C++ data loader + baseline binaries
 #   make ci       - everything CI runs, in order
 
@@ -21,7 +24,7 @@ OLD ?= BENCH_r04.json
 NEW ?= BENCH_r05.json
 
 .PHONY: test dryrun bench bench-dryrun bench-diff bench-diff-selftest \
-	fuzz lint native ci
+	client-bench fuzz lint native ci
 
 fuzz:
 	$(PY) tests/deep_fuzz.py
@@ -41,6 +44,9 @@ test:
 bench-dryrun:
 	MVTPU_BENCH_TINY=1 $(PY) bench.py
 
+client-bench:
+	MVTPU_CLIENT_BENCH_TINY=1 $(PY) benchmarks/client_pipeline.py
+
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	  $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
@@ -51,4 +57,4 @@ bench:
 native:
 	$(MAKE) -C native
 
-ci: lint bench-diff-selftest native test dryrun bench-dryrun
+ci: lint bench-diff-selftest native test dryrun bench-dryrun client-bench

@@ -79,7 +79,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from multiverso_tpu import core, telemetry
+from multiverso_tpu import client, core, telemetry
 from multiverso_tpu.data.corpus import backend as data_backend
 from multiverso_tpu.tables import (ArrayTable, SparseMatrixTable,
                                    make_superstep)
@@ -266,6 +266,11 @@ class LightLDA:
         self.summary = ArrayTable(self.K, "int32", updater="default",
                                   mesh=self.mesh, name=f"{name}_summary")
         self._scratch_word = self.word_topic.padded_shape[0] - 1
+        # MVTPU_STALENESS: serve logging/eval reads of the word-topic
+        # model (word_topics/top_words) from a bounded-staleness cached
+        # view instead of a blocking whole-table fetch per call;
+        # dump_model/store stay exact
+        self._wt_view = client.maybe_cached_view(self.word_topic)
 
         # worker-local doc-topic counts (+1 scratch doc for padded lanes);
         # placed on the mesh, NOT the default device (platform may differ)
@@ -1648,7 +1653,11 @@ class LightLDA:
             self.num_docs, self.K)
 
     def word_topics(self) -> np.ndarray:
-        """[V, K] word-topic counts from the table."""
+        """[V, K] word-topic counts from the table (a bounded-staleness
+        cached view under ``MVTPU_STALENESS`` — logging/eval reads skip
+        the per-call blocking fetch)."""
+        if self._wt_view is not None:
+            return self._wt_view.get()
         return self.word_topic.get()
 
     def top_words(self, topic: int, k: int = 10) -> np.ndarray:

@@ -33,7 +33,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from multiverso_tpu import core, telemetry
+from multiverso_tpu import client, core, telemetry
 from multiverso_tpu.tables import ArrayTable, make_superstep
 from multiverso_tpu.updaters import AddOption
 from multiverso_tpu.utils import log
@@ -190,6 +190,10 @@ class LogisticRegression:
             self.n_weights, "float32", init_value=init, updater=c.updater,
             mesh=self.mesh, name=name, default_option=opt,
             shard_update=c.shard_update)
+        # MVTPU_STALENESS: weights() (a logging/inspection read — the
+        # train step never feeds it back) serves from a bounded-staleness
+        # cached view instead of a blocking whole-table fetch per call
+        self._view = client.maybe_cached_view(self.table)
         self._data_sharding = NamedSharding(self.mesh, P(core.DATA_AXIS))
         self._build_step()
 
@@ -343,6 +347,11 @@ class LogisticRegression:
         dt = time.perf_counter() - t0
         telemetry.counter("logreg.samples").inc(n)
         telemetry.emit("logreg.samples_per_sec", n / dt, "samples/s")
+        if self._view is not None:
+            # logging-only read off the cached view: within the
+            # staleness bound, zero extra device dispatches
+            telemetry.gauge("logreg.weight_norm").set(
+                float(np.linalg.norm(self._view.get())))
         log.info("logreg epoch done: loss=%.4f %.0f samples/s",
                  mean_loss, n / dt)
         return mean_loss
@@ -363,7 +372,8 @@ class LogisticRegression:
         return float(np.mean(self.predict(X) == y))
 
     def weights(self) -> Tuple[np.ndarray, np.ndarray]:
-        w_flat = self.table.get()
+        w_flat = self._view.get() if self._view is not None \
+            else self.table.get()
         c = self.config
         w = w_flat[: c.input_dim * c.num_classes].reshape(
             c.input_dim, c.num_classes)

@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from multiverso_tpu import core, telemetry
+from multiverso_tpu import client, core, telemetry
 from multiverso_tpu.apps.logreg import _parse_libsvm
 from multiverso_tpu.tables import KVTable
 from multiverso_tpu.tables.matrix_table import _bucket
@@ -111,6 +111,12 @@ class SparseLogisticRegression:
             slots_per_bucket=c.slots_per_bucket,
             updater=c.updater, mesh=self.mesh, name=name,
             default_option=opt)
+        # MVTPU_COALESCE=K: the per-minibatch kv.add coalesces — K
+        # minibatch gradients pre-sum by key host-side and flush as ONE
+        # fused probe+updater dispatch (the reference's client-side
+        # Aggregator). Gets then serve weights up to K minibatches
+        # stale, the reference worker's own bounded-staleness semantics.
+        self._coalescer = client.maybe_coalescing(self.table)
         self._step_jits: Dict[Tuple[int, int], object] = {}
 
     # -- batch packing -----------------------------------------------------
@@ -195,7 +201,10 @@ class SparseLogisticRegression:
                         put(vals), put(y.astype(np.int32)))
         dw = np.asarray(dw)[:len(uniq)]                  # drop pad+sentinel
         if len(uniq):           # all-zero minibatch has nothing to update
-            self.table.add(uniq, dw)
+            if self._coalescer is not None:
+                self._coalescer.add_kv(uniq, dw)
+            else:
+                self.table.add(uniq, dw)
         return float(loss)
 
     def train(self, rows, y: np.ndarray) -> float:
@@ -220,6 +229,9 @@ class SparseLogisticRegression:
                 step_no += 1
             loss = float(np.mean(losses))
             log.info("sparse_logreg epoch %d: loss=%.4f", e, loss)
+        if self._coalescer is not None:
+            # the tail partial group must land before eval/checkpoint
+            self._coalescer.flush()
         dt = time.perf_counter() - t0
         telemetry.counter("sparse_logreg.samples").inc(n * c.epochs)
         telemetry.emit("sparse_logreg.samples_per_sec",
@@ -229,6 +241,8 @@ class SparseLogisticRegression:
     # -- inference ---------------------------------------------------------
 
     def predict(self, rows) -> np.ndarray:
+        if self._coalescer is not None:
+            self._coalescer.flush()     # eval reads are exact
         keys, vals, uniq = self._pack(rows)
         upad = _bucket(len(uniq))
         uniq_pad = np.zeros(upad, np.uint64)

@@ -46,7 +46,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from multiverso_tpu import core, telemetry
+from multiverso_tpu import client, core, telemetry
 from multiverso_tpu.data.corpus import Corpus
 from multiverso_tpu.tables import MatrixTable, make_superstep
 from multiverso_tpu.utils import log
@@ -183,6 +183,10 @@ class WordEmbedding:
                                  updater="default", mesh=self.mesh,
                                  name=f"{name}_out")
         self._scratch = self.w_in.padded_shape[0] - 1  # masked-lane row
+        # MVTPU_STALENESS: embeddings() (logging/eval — nearest,
+        # similarity, analogy; never fed back into training) serves from
+        # a bounded-staleness cached view; save_text stays exact
+        self._emb_view = client.maybe_cached_view(self.w_in)
 
         # negative-sampling alias table: device-resident constants, placed
         # replicated ON THE MESH (a bare jnp.asarray would land them on the
@@ -562,7 +566,12 @@ class WordEmbedding:
     # -- embeddings out / eval --------------------------------------------
 
     def embeddings(self) -> np.ndarray:
-        """The trained input embeddings [V, D] (the reference saves W_in)."""
+        """The trained input embeddings [V, D] (the reference saves
+        W_in). Under ``MVTPU_STALENESS`` this is a bounded-staleness
+        cached read — mid-train eval (nearest/similarity/analogy) stops
+        paying a blocking whole-table fetch per call."""
+        if self._emb_view is not None:
+            return self._emb_view.get()
         return self.w_in.get()
 
     def nearest(self, word_id: int, k: int = 10) -> np.ndarray:
@@ -589,7 +598,8 @@ class WordEmbedding:
         """The reference word2vec's text output format: a header line
         ``vocab_size dim`` then one ``word v1 .. vD`` line per word.
         Collective (the embedding fetch is); only process 0 writes."""
-        emb = self.embeddings()
+        emb = self.w_in.get()   # exact — the persisted artifact never
+        # serves from the staleness-bounded view
         if core.rank() != 0:
             return
         words = self.corpus.words

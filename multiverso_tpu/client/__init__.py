@@ -1,0 +1,81 @@
+"""Worker-side client pipeline (PAPER.md §3.7/§4.2-4.3).
+
+The reference parameter server's worker perf model, rebuilt over the
+table contract: deltas coalesce locally and flush as ONE fused dispatch
+(:class:`CoalescingBuffer`), reads come from a bounded-staleness local
+cache refreshed in the background (:class:`CachedView` — the SSP-style
+bound), and KV Add batches double-buffer their host prep + H2D against
+the device apply (:class:`KVStagingWriter`). Everything is layered ON
+the tables — no table semantics change unless a buffer/view is attached.
+
+Opt-in env knobs, honored by the apps:
+
+- ``MVTPU_COALESCE=<K>`` — coalesce K adds per flush (0/unset: off),
+- ``MVTPU_STALENESS=<S>`` — serve logging-only reads from a CachedView
+  within S generations (unset: off; ``0`` is a valid bound — it dedupes
+  reads of an unchanged table).
+
+Telemetry: ``client.coalesce.{flushes,deltas,bytes}``,
+``client.cache.{hits,misses,staleness}``, ``client.stage.{batches,
+inflight}`` — and the per-dispatch proof lives in
+``profile.calls{fn=table.apply.*/kv.apply.*}`` (every table kernel is a
+``profiled_jit``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from multiverso_tpu.client.cache import CachedView
+from multiverso_tpu.client.coalesce import CoalescingBuffer, PendingHandle
+from multiverso_tpu.client.staging import KVStagingWriter, stage_kv_adds
+
+COALESCE_ENV = "MVTPU_COALESCE"
+STALENESS_ENV = "MVTPU_STALENESS"
+
+
+def coalesce_from_env() -> int:
+    """``MVTPU_COALESCE`` as an int (0 = coalescing off)."""
+    try:
+        return max(int(os.environ.get(COALESCE_ENV, "0") or "0"), 0)
+    except ValueError:
+        return 0
+
+
+def staleness_from_env() -> Optional[int]:
+    """``MVTPU_STALENESS`` as an int bound, or None when unset/invalid
+    (0 is a VALID bound — dedupe-only caching)."""
+    raw = os.environ.get(STALENESS_ENV)
+    if raw is None or raw == "":
+        return None
+    try:
+        return max(int(raw), 0)
+    except ValueError:
+        return None
+
+
+def maybe_coalescing(table: Any, **kwargs) -> Optional[CoalescingBuffer]:
+    """A CoalescingBuffer over ``table`` when ``MVTPU_COALESCE`` asks
+    for one, else None (the app wiring shape: buffer or passthrough)."""
+    k = coalesce_from_env()
+    if k <= 1:
+        return None
+    return CoalescingBuffer(table, max_deltas=k, **kwargs)
+
+
+def maybe_cached_view(table: Any, **kwargs) -> Optional[CachedView]:
+    """A CachedView over ``table`` when ``MVTPU_STALENESS`` asks for
+    one, else None."""
+    s = staleness_from_env()
+    if s is None:
+        return None
+    return CachedView(table, max_staleness=s, **kwargs)
+
+
+__all__ = [
+    "CachedView", "CoalescingBuffer", "KVStagingWriter", "PendingHandle",
+    "COALESCE_ENV", "STALENESS_ENV", "coalesce_from_env",
+    "maybe_cached_view", "maybe_coalescing", "staleness_from_env",
+    "stage_kv_adds",
+]

@@ -1,0 +1,177 @@
+"""Staleness-bounded get cache: reads that never block on the server.
+
+The reference serves worker ``Get``s from a local cache kept within a
+bounded number of versions of the server copy (PAPER.md §4.2-4.3 — the
+SSP-style bound), so the hot loop never pays the round-trip. Our
+``Table.get()`` is the opposite: a jitted snapshot dispatch plus a
+blocking ``np.asarray`` D2H per call. :class:`CachedView` restores the
+cached read:
+
+- it serves the last host snapshot as long as that snapshot is within
+  ``max_staleness`` GENERATIONS of the table (the table's monotone
+  update counter — one generation per applied add/superstep/load),
+- refresh is split along the thread-safety line: the snapshot PROGRAM
+  is dispatched on the table's own dispatch thread (tables notify
+  attached views from their generation bump; dispatch is async and
+  cheap, and multi-device collective programs MUST all launch from one
+  thread — two threads dispatching concurrently interleave the
+  per-device rendezvous and deadlock the backend), while the blocking
+  D2H readback of the result rides a persistent worker thread
+  (:class:`multiverso_tpu.utils.async_buffer.ASyncBuffer`) — so the
+  hot loop never waits on the transfer,
+- a read that WOULD exceed the bound blocks until a fresh-enough
+  snapshot lands: the bound is a guarantee, not a hint.
+
+At most one refresh is in flight at a time (a generation bump while one
+is pending is picked up by the next bump or read), and
+``max_staleness=0`` still dedupes: repeated reads of an unchanged table
+cost zero dispatches (the common "log the weights every step" shape).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from multiverso_tpu.telemetry import metrics as telemetry
+from multiverso_tpu.utils.async_buffer import ASyncBuffer
+
+
+class CachedView:
+    """Bounded-staleness host view of one dense table's logical value.
+
+    Works on any :class:`multiverso_tpu.tables.base.Table` (ArrayTable /
+    MatrixTable / SparseMatrixTable — anything with ``get_jax()`` and a
+    ``generation`` counter). KVTables are keyed, not whole-value; their
+    cached-read analog is :meth:`KVTable.get_async` + coalescing.
+
+    Reads (``get``) may come from any thread; table UPDATES must come
+    from the table's single dispatch thread — the same SPMD contract
+    every table op already has.
+    """
+
+    def __init__(self, table: Any, max_staleness: int = 0, *,
+                 background: bool = True) -> None:
+        if max_staleness < 0:
+            raise ValueError("max_staleness must be >= 0")
+        self._table = table
+        self.max_staleness = int(max_staleness)
+        self._lock = threading.Lock()
+        self._closed = False
+        lbl = f"{table.table_id}:{table.name}"
+        self._m_hits = telemetry.counter("client.cache.hits", table=lbl)
+        self._m_misses = telemetry.counter("client.cache.misses",
+                                           table=lbl)
+        self._m_staleness = telemetry.gauge("client.cache.staleness",
+                                            table=lbl)
+        # a view never serves nothing: first snapshot is synchronous
+        self._gen, self._val = self._sync_snapshot()
+        # refresh pipeline: (generation, device future) handed to the
+        # worker, which only WAITS and copies (no program dispatch)
+        self._req: "queue.Queue[Optional[Tuple[int, Any]]]" = queue.Queue()
+        self._inflight = False
+        self._buf: Optional[ASyncBuffer] = (
+            ASyncBuffer(self._fill) if background else None)
+        table._attach_view(self)
+
+    # -- snapshot machinery -----------------------------------------------
+
+    def _sync_snapshot(self) -> Tuple[int, np.ndarray]:
+        """(generation, host value), dispatched AND read on the calling
+        thread. The generation is read BEFORE the snapshot dispatch:
+        updates apply in program order, so the snapshot reflects at
+        least that generation (it may be fresher)."""
+        gen = self._table.generation
+        return gen, np.asarray(self._table.get_jax())
+
+    def _fill(self, _idx: int) -> Optional[Tuple[int, np.ndarray]]:
+        """Worker-thread body: wait for a dispatched snapshot future and
+        pull it to host. No jax program is ever DISPATCHED here — only
+        the D2H wait/copy happens off-thread (see module docstring)."""
+        item = self._req.get()
+        if item is None:                # close() sentinel
+            return None
+        gen, fut = item
+        return gen, np.asarray(fut)
+
+    def _on_table_update(self) -> None:
+        """Table hook, invoked on the table's dispatch thread right
+        after a generation bump: launch one async snapshot (cheap — the
+        D2H wait happens on the worker) unless one is already in
+        flight."""
+        if self._buf is None or self._closed or self._inflight:
+            return
+        gen = self._table.generation
+        if gen == self._gen:
+            return
+        fut = self._table.get_jax()     # async dispatch, this thread
+        self._inflight = True
+        self._req.put((gen, fut))
+
+    def _absorb(self, snap: Optional[Tuple[int, np.ndarray]]) -> None:
+        self._inflight = False
+        if snap is not None:
+            gen, val = snap
+            if gen > self._gen:
+                self._gen, self._val = gen, val
+
+    # -- reads -------------------------------------------------------------
+
+    @property
+    def generation(self) -> int:
+        """Generation of the snapshot currently served."""
+        return self._gen
+
+    def staleness(self) -> int:
+        """Current gap (generations) between the table and the served
+        snapshot."""
+        return self._table.generation - self._gen
+
+    def get(self) -> np.ndarray:
+        """The cached host value, guaranteed within ``max_staleness``
+        generations of the table. Non-blocking on the hit path; a read
+        past the bound blocks on the in-flight refresh (or snapshots
+        synchronously)."""
+        with self._lock:
+            cur = self._table.generation
+            if self._inflight and self._buf is not None:
+                snap = self._buf.poll()     # absorb a finished refresh
+                if snap is not None:
+                    self._absorb(snap)
+            stale = cur - self._gen
+            self._m_staleness.set(max(stale, 0))
+            if stale <= self.max_staleness:
+                self._m_hits.inc()
+                return self._val
+            self._m_misses.inc()
+            if self._inflight and self._buf is not None:
+                self._absorb(self._buf.get())   # blocking D2H wait
+            if cur - self._gen > self.max_staleness:
+                # in-flight refresh was older than needed (or none was
+                # running): snapshot here, on the reading thread — for
+                # single-dispatcher apps this IS the dispatch thread
+                self._absorb(self._sync_snapshot())
+            return self._val
+
+    def refresh(self) -> np.ndarray:
+        """Force an up-to-date snapshot (staleness 0 as of the call)."""
+        with self._lock:
+            self._absorb(self._sync_snapshot())
+            return self._val
+
+    def close(self) -> None:
+        """Stop the background reader (idempotent)."""
+        self._closed = True
+        if self._buf is not None:
+            self._req.put(None)         # release a fill blocked on _req
+            self._buf.stop()
+            self._buf = None
+
+    def __enter__(self) -> "CachedView":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()

@@ -1,0 +1,318 @@
+"""Delta coalescing: the reference's worker-side Aggregator as a buffer.
+
+The reference parameter server's headline perf trick (PAPER.md
+§3.7/§4.2-4.3) is that workers do NOT ship every local delta: deltas
+accumulate in a client-side Aggregator and reach the server as one
+summed update. On the TPU port every ``add`` is its own jitted dispatch
+(program launch + option placement + buffer swap), so K small adds pay
+K dispatches — the per-op-vs-fused gap arXiv:2004.13336 / 2204.06514
+measure. :class:`CoalescingBuffer` restores the aggregation: it absorbs
+up to ``max_deltas`` adds (or a byte / age budget) per table host-side
+and flushes them through ONE fused ``updater.apply`` dispatch.
+
+Semantics (the SSP-style contract coalescing opts into):
+
+- Buffered deltas are INVISIBLE to reads until their flush; fused
+  supersteps and ``store``/``load`` force a flush first (the table
+  attaches the buffer via ``_attach_coalescer``), so ops that must
+  observe every prior add still do.
+- Summation before a single updater step is EXACT for the linear
+  updaters (``default``, ``sgd``) and the standard mini-batch
+  approximation for stateful ones (adagrad/adam/...: one state update
+  for K deltas instead of K — the same semantics the reference's
+  Aggregator always had).
+- Deltas are cast to the table dtype at buffer time, matching what a
+  direct ``add`` would have done per delta.
+- KV / row / COO adds coalesce BY KEY: duplicate keys across the
+  buffered batches are pre-summed host-side before upload, which also
+  satisfies the table layer's unique-keys-per-add requirement.
+
+Every buffered add returns a :class:`PendingHandle` — Handle-compatible
+(``wait``/``done``/``result``); ``wait()`` forces the flush carrying the
+delta and then blocks on the table, so ``flush()`` + ``Handle.wait()``
+observe all buffered deltas exactly like plain add-handles.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, List, Optional
+
+import numpy as np
+
+from multiverso_tpu.telemetry import metrics as telemetry
+from multiverso_tpu.updaters import AddOption
+
+
+class PendingHandle:
+    """Async handle for a BUFFERED delta (Handle-compatible surface).
+
+    Carries the flush ticket its delta will ride: ``wait()`` forces that
+    flush (if it has not happened) and then blocks on the table — the
+    same generation contract as :class:`multiverso_tpu.tables.base
+    .Handle`: by program order, the table's buffers being ready implies
+    this delta's flush has been applied.
+    """
+
+    def __init__(self, buffer: "CoalescingBuffer", ticket: int) -> None:
+        self._buffer = buffer
+        self._ticket = ticket
+
+    def flushed(self) -> bool:
+        """True once the flush carrying this delta has been dispatched."""
+        return self._buffer.flush_generation > self._ticket
+
+    def done(self) -> bool:
+        """Non-blocking: False while buffered; after the flush, the
+        underlying table handle's (non-monotonic) readiness."""
+        if not self.flushed():
+            return False
+        h = self._buffer._last_handle
+        return h is not None and h.done()
+
+    def wait(self) -> Any:
+        self._buffer.flush_through(self._ticket)
+        h = self._buffer._last_handle
+        assert h is not None
+        return h.wait()
+
+    def result(self) -> Any:
+        return self.wait()
+
+
+class CoalescingBuffer:
+    """Accumulate adds against one table; flush as ONE fused dispatch.
+
+    One buffer holds ONE pending group at a time: a group is (op kind,
+    AddOption) — an add of a different kind (dense / kv / rows / coo) or
+    with a different explicit option forces the current group out first,
+    preserving update order. Thread-safe.
+
+    Flush triggers (checked on every buffered add, whichever fires
+    first): ``max_deltas`` buffered adds, ``max_bytes`` of buffered
+    payload, ``max_age_s`` since the group's first add (age is only
+    observed at add/:meth:`maybe_flush` time — there is no timer
+    thread). ``flush()`` forces; supersteps and store/load force through
+    the table's ``flush_coalesced`` hook.
+    """
+
+    def __init__(self, table: Any, max_deltas: int = 8, *,
+                 max_bytes: Optional[int] = None,
+                 max_age_s: Optional[float] = None,
+                 option: Optional[AddOption] = None) -> None:
+        if max_deltas < 1:
+            raise ValueError("max_deltas must be >= 1")
+        self._table = table
+        self.max_deltas = int(max_deltas)
+        self.max_bytes = max_bytes
+        self.max_age_s = max_age_s
+        self._default_option = option
+        self._lock = threading.RLock()
+        self._kind: Optional[str] = None
+        self._option: Optional[AddOption] = None
+        self._count = 0
+        self._bytes = 0
+        self._first_ts: Optional[float] = None
+        # dense accumulator / batched-op part lists
+        self._acc: Optional[np.ndarray] = None
+        self._ids: List[np.ndarray] = []       # kv keys / row ids / coo keys
+        self._deltas: List[np.ndarray] = []
+        self._flush_gen = 0
+        self._last_handle = None
+        lbl = f"{table.table_id}:{table.name}"
+        self._m_flushes = telemetry.counter("client.coalesce.flushes",
+                                            table=lbl)
+        self._m_deltas = telemetry.counter("client.coalesce.deltas",
+                                           table=lbl)
+        self._m_bytes = telemetry.counter("client.coalesce.bytes",
+                                          table=lbl)
+        table._attach_coalescer(self)
+
+    # -- state -------------------------------------------------------------
+
+    @property
+    def flush_generation(self) -> int:
+        """Number of flushes dispatched so far (PendingHandle tickets
+        compare against it)."""
+        return self._flush_gen
+
+    @property
+    def pending_deltas(self) -> int:
+        return self._count
+
+    @property
+    def pending_bytes(self) -> int:
+        return self._bytes
+
+    def _start_group(self, kind: str, option: Optional[AddOption]) -> None:
+        """Flush-on-boundary: a kind or option change closes the open
+        group (update order across groups is preserved)."""
+        opt = option if option is not None else self._default_option
+        if self._count and (self._kind != kind or self._option != opt):
+            self._flush_locked()
+        self._kind = kind
+        self._option = opt
+        if self._first_ts is None:
+            self._first_ts = time.monotonic()
+
+    def _buffered(self, nbytes: int) -> int:
+        """Account one buffered add; returns its PendingHandle ticket."""
+        self._count += 1
+        self._bytes += int(nbytes)
+        self._m_deltas.inc()
+        self._m_bytes.inc(int(nbytes))
+        return self._flush_gen
+
+    def _maybe_flush_locked(self) -> None:
+        if (self._count >= self.max_deltas
+                or (self.max_bytes is not None
+                    and self._bytes >= self.max_bytes)
+                or (self.max_age_s is not None
+                    and self._first_ts is not None
+                    and time.monotonic() - self._first_ts
+                    >= self.max_age_s)):
+            self._flush_locked()
+
+    # -- buffered add variants --------------------------------------------
+
+    def add(self, delta: Any,
+            option: Optional[AddOption] = None) -> PendingHandle:
+        """Buffer a whole-table dense delta (``Table.add`` shape rules:
+        logical or padded)."""
+        arr = np.asarray(delta, dtype=self._table.dtype)
+        with self._lock:
+            self._start_group("dense", option)
+            if self._acc is None:
+                self._acc = arr.copy()
+            else:
+                if arr.shape != self._acc.shape:
+                    raise ValueError(
+                        f"coalesced delta shape {arr.shape} != buffered "
+                        f"{self._acc.shape} (flush between shapes)")
+                self._acc += arr
+            ticket = self._buffered(arr.nbytes)
+            self._maybe_flush_locked()
+            return PendingHandle(self, ticket)
+
+    def add_kv(self, keys: Any, deltas: Any,
+               option: Optional[AddOption] = None) -> PendingHandle:
+        """Buffer a KV batch; duplicate keys WITHIN and ACROSS buffered
+        batches pre-sum host-side at flush (the Aggregator role)."""
+        keys = np.asarray(keys, dtype=np.uint64)
+        deltas = np.asarray(deltas, dtype=self._table.dtype)
+        if len(deltas) != len(keys):
+            raise ValueError(f"deltas length {len(deltas)} != keys "
+                             f"length {len(keys)}")
+        with self._lock:
+            self._start_group("kv", option)
+            self._ids.append(keys)
+            self._deltas.append(deltas)
+            ticket = self._buffered(deltas.nbytes)
+            self._maybe_flush_locked()
+            return PendingHandle(self, ticket)
+
+    def add_rows(self, row_ids: Any, deltas: Any,
+                 option: Optional[AddOption] = None) -> PendingHandle:
+        """Buffer a MatrixTable row batch; duplicate row ids pre-sum at
+        flush (which also satisfies the stateful-updater unique-ids
+        rule)."""
+        ids = np.asarray(row_ids, dtype=np.int32)
+        deltas = np.asarray(deltas, dtype=self._table.dtype)
+        if deltas.shape != (len(ids), self._table.num_cols):
+            raise ValueError(f"deltas shape {deltas.shape} != "
+                             f"({len(ids)}, {self._table.num_cols})")
+        with self._lock:
+            self._start_group("rows", option)
+            self._ids.append(ids)
+            self._deltas.append(deltas)
+            ticket = self._buffered(deltas.nbytes)
+            self._maybe_flush_locked()
+            return PendingHandle(self, ticket)
+
+    def add_sparse(self, rows: Any, cols: Any, values: Any,
+                   option: Optional[AddOption] = None) -> PendingHandle:
+        """Buffer a COO batch; duplicate (row, col) pairs pre-sum at
+        flush."""
+        rows = np.asarray(rows, dtype=np.int64)
+        cols = np.asarray(cols, dtype=np.int64)
+        values = np.asarray(values, dtype=self._table.dtype)
+        if not (rows.shape == cols.shape == values.shape) \
+                or rows.ndim != 1:
+            raise ValueError("COO arrays must be same-length 1-D")
+        with self._lock:
+            self._start_group("coo", option)
+            # flat (row, col) key — split back at flush
+            self._ids.append(rows * self._table.num_cols + cols)
+            self._deltas.append(values)
+            ticket = self._buffered(values.nbytes)
+            self._maybe_flush_locked()
+            return PendingHandle(self, ticket)
+
+    # -- flush -------------------------------------------------------------
+
+    def _summed_unique(self):
+        """Concatenate the buffered (ids, deltas) parts and pre-sum
+        duplicates host-side: the ONE upload the flush dispatches."""
+        ids = np.concatenate(self._ids)
+        deltas = np.concatenate(self._deltas, axis=0)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        summed = np.zeros((len(uniq),) + deltas.shape[1:], deltas.dtype)
+        np.add.at(summed, inv, deltas)
+        return uniq, summed
+
+    def _flush_locked(self):
+        if self._count == 0:
+            return None
+        kind, opt = self._kind, self._option
+        if kind == "dense":
+            handle = self._table.add(self._acc, opt)
+        elif kind == "kv":
+            uniq, summed = self._summed_unique()
+            handle = self._table.add(uniq, summed, opt)
+        elif kind == "rows":
+            uniq, summed = self._summed_unique()
+            handle = self._table.add_rows(uniq.astype(np.int32), summed,
+                                          opt)
+        else:   # coo
+            uniq, summed = self._summed_unique()
+            ncols = self._table.num_cols
+            handle = self._table.add_sparse(
+                (uniq // ncols).astype(np.int32),
+                (uniq % ncols).astype(np.int32), summed, opt)
+        self._acc = None
+        self._ids, self._deltas = [], []
+        self._count = 0
+        self._bytes = 0
+        self._first_ts = None
+        self._flush_gen += 1
+        self._last_handle = handle
+        self._m_flushes.inc()
+        return handle
+
+    def flush(self):
+        """Dispatch the buffered group as one fused add. Returns that
+        add's table Handle (None when nothing was buffered)."""
+        with self._lock:
+            return self._flush_locked()
+
+    def maybe_flush(self):
+        """Apply the byte/age/count budgets without buffering anything —
+        for callers that want the age trigger honored between adds."""
+        with self._lock:
+            self._maybe_flush_locked()
+
+    def flush_through(self, ticket: int) -> None:
+        """Ensure the flush carrying ``ticket`` has been dispatched
+        (PendingHandle.wait's entry point)."""
+        with self._lock:
+            if self._flush_gen <= ticket:
+                self._flush_locked()
+
+    # flush-on-exit context manager
+    def __enter__(self) -> "CoalescingBuffer":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self.flush()

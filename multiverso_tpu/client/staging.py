@@ -1,0 +1,141 @@
+"""Async H2D staging: overlap host batch prep with device apply.
+
+A ``KVTable.add`` is two halves: a host half (key validation, splitmix
+hash, uint64→2×uint32 split, delta conversion, the H2D ``device_put``s)
+and a device half (the fused probe+updater dispatch). Issued serially,
+the host half of batch k+1 waits for nothing but still sits on the
+critical path between dispatches. :class:`KVStagingWriter` double-
+buffers them: a persistent worker thread runs ``KVTable.prepare_add``
+(the host half, safe off-thread — it touches no table state) up to
+``depth`` batches ahead, while the caller's thread dispatches
+``KVTable.add_prepared`` (the device half, which swaps live buffers and
+must stay on the owning thread). Host conversion of batch k+1 overlaps
+device apply of batch k — the reference's ParameterLoader/ASyncBuffer
+pipelining role (SURVEY.md §4.5), applied to the Add path.
+
+Update order is submission order: one worker + FIFO queues means
+prepared batches come back in the order they went in, and dispatches
+happen on the caller's thread in that order.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterable, Optional, Tuple
+
+from multiverso_tpu.telemetry import metrics as telemetry
+from multiverso_tpu.updaters import AddOption
+
+
+class KVStagingWriter:
+    """Double-buffered Add writer for one :class:`KVTable`.
+
+    ``add(keys, deltas)`` submits the batch for background prep and
+    dispatches any batches whose prep (H2D) already landed; when
+    ``depth`` batches are in flight it blocks until one drains — the
+    pipeline is bounded, not unbounded. ``flush()`` drains everything
+    and returns the last table Handle. The caller must not mutate
+    ``keys``/``deltas`` until the writer flushes (zero-copy hand-off).
+
+    AddOptions resolve at PREPARE time (see ``KVTable.prepare_add``) —
+    an lr schedule advanced mid-pipeline applies from the next batch.
+    """
+
+    def __init__(self, table: Any, depth: int = 2, *,
+                 option: Optional[AddOption] = None) -> None:
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self._table = table
+        self._depth = int(depth)
+        self._option = option
+        self._req: "queue.Queue[Optional[Tuple]]" = queue.Queue()
+        self._ready: "queue.Queue[Tuple]" = queue.Queue()
+        self._inflight = 0
+        self._last_handle = None
+        self._closed = False
+        lbl = f"{table.table_id}:{table.name}"
+        self._m_batches = telemetry.counter("client.stage.batches",
+                                            table=lbl)
+        self._m_inflight = telemetry.gauge("client.stage.inflight",
+                                           table=lbl)
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+
+    def _work(self) -> None:
+        while True:
+            item = self._req.get()
+            if item is None:
+                return
+            keys, deltas, option = item
+            try:
+                prepared = self._table.prepare_add(keys, deltas, option)
+                self._ready.put((prepared, None))
+            except BaseException as exc:    # surfaces on the caller side
+                self._ready.put((None, exc))
+
+    def _land(self, item: Tuple) -> None:
+        """Dispatch one prepared batch on the caller's thread."""
+        prepared, exc = item
+        self._inflight -= 1
+        self._m_inflight.set(self._inflight)
+        if exc is not None:
+            raise exc
+        self._last_handle = self._table.add_prepared(prepared)
+
+    def add(self, keys: Any, deltas: Any,
+            option: Optional[AddOption] = None) -> None:
+        """Submit one Add batch into the pipeline (prep off-thread,
+        dispatch on the next add/flush once its H2D lands)."""
+        if self._closed:
+            raise RuntimeError("KVStagingWriter already closed")
+        self._req.put((keys, deltas,
+                       option if option is not None else self._option))
+        self._inflight += 1
+        self._m_batches.inc()
+        self._m_inflight.set(self._inflight)
+        # dispatch whatever prep already finished (non-blocking) ...
+        while True:
+            try:
+                self._land(self._ready.get_nowait())
+            except queue.Empty:
+                break
+        # ... then apply the depth bound (blocking)
+        while self._inflight > self._depth:
+            self._land(self._ready.get())
+
+    def flush(self):
+        """Drain the pipeline; returns the last dispatched batch's table
+        Handle (None when nothing was ever added)."""
+        while self._inflight:
+            self._land(self._ready.get())
+        return self._last_handle
+
+    def close(self):
+        """Flush, then stop the worker thread. Returns the last Handle."""
+        handle = self.flush() if not self._closed else self._last_handle
+        if not self._closed:
+            self._closed = True
+            self._req.put(None)
+            self._thread.join(timeout=5.0)
+        return handle
+
+    def __enter__(self) -> "KVStagingWriter":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self.close()
+        else:   # don't mask the in-flight error with a flush error
+            self._closed = True
+            self._req.put(None)
+
+
+def stage_kv_adds(table: Any, batches: Iterable[Tuple[Any, Any]], *,
+                  depth: int = 2, option: Optional[AddOption] = None):
+    """Drive an iterable of ``(keys, deltas)`` batches through a
+    :class:`KVStagingWriter`; returns the last batch's table Handle."""
+    with KVStagingWriter(table, depth, option=option) as writer:
+        for keys, deltas in batches:
+            writer.add(keys, deltas)
+        return writer.flush()

@@ -30,7 +30,7 @@ from __future__ import annotations
 import io
 import json
 import threading
-from functools import partial
+import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -41,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from multiverso_tpu import core
 from multiverso_tpu.io import open_stream
 from multiverso_tpu.telemetry import metrics as telemetry
+from multiverso_tpu.telemetry.profiling import profiled_jit
 from multiverso_tpu.updaters import (AddOption, Updater, get_updater,
                                      resolve_default_option)
 from multiverso_tpu.utils import configure, log
@@ -183,6 +184,14 @@ class Table:
         # monotonically increasing update counter backing the Handle
         # generation contract (bumped on every applied update/load)
         self.generation = 0
+        # client-pipeline hooks (weakrefs — a dropped CachedView or
+        # CoalescingBuffer must not be pinned by its table):
+        # views are woken on every generation bump so their background
+        # refresh starts at the update, not at the next read; coalescers
+        # are flushed by ops that must observe every buffered delta
+        # (supersteps, store/load)
+        self._view_refs: List[weakref.ref] = []
+        self._coalescer_refs: List[weakref.ref] = []
 
         # weight-update sharding (cross-replica sharding of the weight
         # update, arXiv:2004.13336 — the ZeRO-2-on-TPU classic): shard
@@ -225,8 +234,14 @@ class Table:
             lambda s: jax.device_put(s, self.state_sharding),
             self.updater.init_state(self.param))
         state_sh = jax.tree.map(lambda _: self.state_sharding, self.state)
-        self._apply = jax.jit(self.updater.apply, donate_argnums=(0, 1),
-                              out_shardings=(self.sharding, state_sh))
+        # profiled_jit, not bare jax.jit: profile.calls{fn=table.apply.*}
+        # is THE dispatch count of the Add path — the client pipeline's
+        # coalescing contract ("K buffered adds -> 1 apply dispatch") is
+        # asserted against it in tests and the micro-bench
+        self._apply = profiled_jit(
+            self.updater.apply, name=f"table.apply.{name}",
+            donate_argnums=(0, 1),
+            out_shardings=(self.sharding, state_sh))
 
         # whole-table snapshot: logical region, REPLICATED output (the
         # all-gather is the reference's whole-table Get; a replicated
@@ -236,14 +251,17 @@ class Table:
             self.mesh, P(*([None] * len(self.padded_shape))))
         slices = tuple(slice(0, l) for l in self.logical_shape)
 
-        @partial(jax.jit, out_shardings=replicated)
         def snapshot(param):
             # jnp.copy guarantees a fresh buffer even when the slice is
             # the whole array and shardings coincide — the snapshot must
             # survive the next add's donation of the live buffer
             return jnp.copy(param[slices])
 
-        self._snapshot = snapshot
+        # profiled: profile.calls{fn=table.snapshot.*} counts whole-table
+        # Get dispatches — the number a CachedView exists to shrink
+        self._snapshot = profiled_jit(snapshot,
+                                      name=f"table.snapshot.{name}",
+                                      out_shardings=replicated)
         self.table_id = _register(self)
         log.debug("table %r id=%d shape=%s padded=%s updater=%s", name,
                   self.table_id, self.logical_shape, self.padded_shape,
@@ -286,7 +304,53 @@ class Table:
         with self._option_lock:
             self.default_option.step += 1
             self.generation += 1
-            return self.generation
+            gen = self.generation
+        self._notify_views()
+        return gen
+
+    # -- client-pipeline hooks (multiverso_tpu.client) ---------------------
+
+    def _attach_view(self, view: Any) -> None:
+        """Register a CachedView for update notification (weakref)."""
+        self._view_refs.append(weakref.ref(view))
+
+    def _attach_coalescer(self, buf: Any) -> None:
+        """Register a CoalescingBuffer so flush-demanding table ops
+        (supersteps, store/load) can force its buffered deltas out."""
+        self._coalescer_refs.append(weakref.ref(buf))
+
+    def _notify_views(self) -> None:
+        """Wake attached CachedViews: the generation advanced, so their
+        background refresh should start NOW rather than at the next
+        read. Must stay cheap — it runs on every applied update."""
+        refs = self._view_refs
+        if not refs:
+            return
+        live = []
+        for r in refs:
+            v = r()
+            if v is not None:
+                v._on_table_update()
+                live.append(r)
+        self._view_refs[:] = live
+
+    def flush_coalesced(self) -> None:
+        """Flush every attached CoalescingBuffer's pending deltas into
+        the table. Called by ops whose contract requires observing all
+        prior adds (fused supersteps before they read/donate ``param``,
+        store/load around checkpoints); plain ``get`` does NOT call this
+        — a buffered delta is invisible until its flush, the bounded-
+        staleness semantics coalescing opts into."""
+        refs = self._coalescer_refs
+        if not refs:
+            return
+        live = []
+        for r in refs:
+            b = r()
+            if b is not None:
+                b.flush()
+                live.append(r)
+        self._coalescer_refs[:] = live
 
     # -- the Get/Add contract ---------------------------------------------
 
@@ -313,6 +377,7 @@ class Table:
         self.param = jax.device_put(padded, self.sharding)
         with self._option_lock:
             self.generation += 1
+        self._notify_views()
 
     def get_jax(self) -> jax.Array:
         """Device-resident logical value (slices off padding), replicated.
@@ -330,6 +395,10 @@ class Table:
         return np.asarray(self.get_jax())
 
     def get_async(self) -> Handle:
+        """Non-blocking whole-table Get: the returned handle wraps the
+        DEVICE snapshot (a future — dispatch is async), so nothing
+        round-trips to host unless the caller converts the waited value
+        (``np.asarray(h.wait())``)."""
         return Handle(self.get_jax())
 
     def add(self, delta: Any, option: Optional[AddOption] = None,
@@ -415,6 +484,9 @@ class Table:
         (mem://, per-host local disks) each get a copy; on a shared
         filesystem the identical payloads land via the stream layer's
         atomic rename, so same-path writers never interleave."""
+        # a checkpoint must contain every delta the worker has issued,
+        # including ones still parked in attached coalescing buffers
+        self.flush_coalesced()
         payload = {"param": self._export_param()}
         manifest = self._manifest()
         state = self.state
@@ -432,6 +504,11 @@ class Table:
         savez_stream(uri, manifest, payload)
 
     def load(self, uri: str) -> None:
+        # buffered deltas refer to the PRE-load state — flush them into
+        # it before the restore replaces param/state (dropping them
+        # silently, or applying them onto restored state, would both be
+        # wrong orders)
+        self.flush_coalesced()
         manifest, data = loadz_stream(uri, CHECKPOINT_MAGIC)
         if tuple(manifest["logical_shape"]) != self.logical_shape:
             raise ValueError(
@@ -467,6 +544,7 @@ class Table:
         # update/load)
         with self._option_lock:
             self.generation += 1
+        self._notify_views()
 
 
 # -- process-wide table registry (TableFactory / table ids) ---------------

@@ -43,6 +43,8 @@ from multiverso_tpu import core
 from multiverso_tpu.tables.base import (Handle, Table, _register,
                                         loadz_stream, pack_state,
                                         savez_stream, unpack_state)
+from multiverso_tpu.tables.matrix_table import _bucket
+from multiverso_tpu.telemetry.profiling import profiled_jit
 from multiverso_tpu.updaters import (AddOption, get_updater,
                                      resolve_default_option)
 from multiverso_tpu.utils import configure, log
@@ -81,6 +83,20 @@ class KVTableOption:
     name: str = "kv_table"
 
 
+@dataclasses.dataclass
+class PreparedKVAdd:
+    """One Add batch with host prep done and operands staged on device
+    (H2D already issued): the unit the async staging pipeline hands
+    between its prepare thread and the dispatching thread."""
+    buckets: Any        # device int32 [b]   (b = pow2 bucket of n)
+    query: Any          # device uint32 [b, 2]
+    deltas: Any         # device [b(, D)]
+    valid: Any          # device bool [b]    (first n lanes real)
+    option: AddOption   # device-leaved (resolved at prepare time)
+    elems: int
+    nbytes: int
+
+
 class KVTable:
     """Fixed-capacity hashed table. Not a dense-array Table subclass —
     storage is (keys, values, state) triple — but implements the same
@@ -107,6 +123,10 @@ class KVTable:
                                                      default_option)
         self._option_lock = threading.Lock()
         self.generation = 0
+        # client-pipeline hooks (see tables/base.py) — shared by
+        # unbound-method assignment below, like _record_op
+        self._view_refs: list = []
+        self._coalescer_refs: list = []
 
         shards = self.mesh.shape[core.MODEL_AXIS]
         buckets = -(-capacity // self.slots)
@@ -142,7 +162,6 @@ class KVTable:
     def _build_jits(self) -> None:
         replicated = NamedSharding(self.mesh, P(None))
 
-        @partial(jax.jit, out_shardings=(replicated, replicated))
         def lookup(keys_arr, values_arr, query, buckets):
             # keys_arr: (B, S, 2) uint32; query: (n, 2) uint32
             slots = jnp.take(keys_arr, buckets, axis=0)        # (n, S, 2)
@@ -160,11 +179,8 @@ class KVTable:
         scalar_sh = NamedSharding(self.mesh, P())
         state_sh = jax.tree.map(lambda _: self._val_sharding, self.state)
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2),
-                 out_shardings=(self._key_sharding, self._val_sharding,
-                                state_sh, scalar_sh))
         def probe_update(keys_arr, values_arr, state, buckets, query,
-                         deltas, option):
+                         deltas, valid, option):
             """Fused slot probe + updater + scatter. The probe is the
             reference's hash-bucket insertion vectorized: match lane if
             the key is present, else the (rank+1)-th empty lane where
@@ -173,13 +189,21 @@ class KVTable:
             by a run-rank over the sorted bucket ids — no host state).
             Unplaced keys (bucket overflow) get an out-of-range slot and
             their scatters DROP; the count comes back for the host to
-            raise on."""
+            raise on.
+
+            ``valid`` masks PADDING lanes: batch lengths are bucketed to
+            powers of two (prepare_add), so variable-size adds reuse a
+            bounded set of compiled signatures instead of retracing per
+            length. Padded lanes carry the EMPTY sentinel as query (can
+            only ever match empty slots — a reserved key), are excluded
+            from ranks and the overflow count, and are forced to the
+            out-of-range slot so every one of their scatters drops."""
             rows = jnp.take(keys_arr, buckets, axis=0)       # (n, S, 2)
             match = (rows == query[:, None, :]).all(-1)      # (n, S)
             matched = match.any(axis=1)
             mlane = jnp.argmax(match, axis=1)
             empty = (rows == jnp.uint32(0xFFFFFFFF)).all(-1)
-            new = ~matched
+            new = ~matched & valid
             # rank among same-bucket new keys, in batch order
             perm = jnp.argsort(buckets, stable=True)
             b_s = jnp.take(buckets, perm)
@@ -196,12 +220,12 @@ class KVTable:
             placed_new = hit.any(axis=1)
             elane = jnp.argmax(hit, axis=1)
             ok = matched | placed_new
-            n_over = jnp.sum(~ok)
+            n_over = jnp.sum(~ok & valid)
             slot = jnp.where(matched, mlane, elane)
             # all-or-nothing: ANY overflow voids the whole batch (the
             # raise must leave the table untouched) — out-of-range slots
-            # make every scatter drop
-            slot = jnp.where(ok & (n_over == 0), slot, n_slots)
+            # make every scatter drop; padding lanes always drop
+            slot = jnp.where(ok & valid & (n_over == 0), slot, n_slots)
             keys_arr = keys_arr.at[buckets, slot].set(query)
             safe = jnp.minimum(slot, n_slots - 1)
             old = values_arr[buckets, safe]
@@ -220,8 +244,17 @@ class KVTable:
             return jnp.sum(~(keys_arr == jnp.uint32(0xFFFFFFFF))
                            .all(-1))
 
-        self._lookup = lookup
-        self._probe_update = probe_update
+        # profiled: profile.calls{fn=kv.lookup/kv.apply.<name>} are the
+        # Get/Add dispatch counts the client pipeline's coalescing and
+        # caching claims are asserted against
+        self._lookup = profiled_jit(
+            lookup, name=f"kv.lookup.{self.name}",
+            out_shardings=(replicated, replicated))
+        self._probe_update = profiled_jit(
+            probe_update, name=f"kv.apply.{self.name}",
+            donate_argnums=(0, 1, 2),
+            out_shardings=(self._key_sharding, self._val_sharding,
+                           state_sh, scalar_sh))
         self._count_live = count_live
 
     def _buckets_of(self, keys: np.ndarray) -> np.ndarray:
@@ -264,11 +297,16 @@ class KVTable:
         device scalar is already computed are inspected, so back-to-back
         ``add(sync=False)`` calls keep pipelining (a blocking readback
         here would cap the async queue at depth 1 — the exact
-        serialization the deferral exists to avoid)."""
+        serialization the deferral exists to avoid). A flag with no
+        ``is_ready`` attribute stays DEFERRED (treated as still in
+        flight): readiness is unknowable without a blocking
+        ``np.asarray`` readback, and every non-add table op drains it
+        through :meth:`_check_overflow` anyway."""
         still, ready = [], []
         for p in self._pending_over:
             is_ready = getattr(p, "is_ready", None)
-            (ready if is_ready is None or is_ready() else still).append(p)
+            (ready if is_ready is not None and is_ready()
+             else still).append(p)
         self._pending_over = still
         n_over = sum(int(np.asarray(p)) for p in ready)
         if n_over:
@@ -276,24 +314,118 @@ class KVTable:
 
     # -- API ---------------------------------------------------------------
 
-    # per-table op accounting, shared with the dense Table hierarchy
-    # (KVTable is contract-compatible, not a subclass)
+    # per-table op accounting + client-pipeline hooks, shared with the
+    # dense Table hierarchy (KVTable is contract-compatible, not a
+    # subclass)
     _record_op = Table._record_op
+    _attach_view = Table._attach_view
+    _attach_coalescer = Table._attach_coalescer
+    _notify_views = Table._notify_views
+    flush_coalesced = Table.flush_coalesced
+
+    def get_jax(self, keys) -> Tuple[jax.Array, jax.Array]:
+        """Device-resident batched lookup → (values, found_mask) as
+        device arrays (futures — dispatch is async; nothing blocks until
+        the caller reads them back).
+
+        Query lengths are bucketed to powers of two like adds (padded
+        lanes carry the EMPTY sentinel and are sliced off), so variable
+        query sizes share compiled signatures."""
+        self._check_overflow()
+        keys = self._check_keys(keys)
+        n = len(keys)
+        elems = n * max(self.value_dim, 1)
+        self._record_op("get", elems, elems * self.dtype.itemsize)
+        b = _bucket(n)
+        query = np.full((b, 2), 0xFFFFFFFF, np.uint32)
+        query[:n] = _split_keys(keys)
+        buckets = np.zeros(b, np.int32)
+        buckets[:n] = self._buckets_of(keys)
+        vals, found = self._lookup(
+            self.keys, self.values,
+            core.place(query, mesh=self.mesh),
+            core.place(buckets, mesh=self.mesh))
+        if b != n:      # padding lanes (sentinel query) sliced away
+            vals, found = vals[:n], found[:n]
+        return vals, found
 
     def get(self, keys) -> Tuple[np.ndarray, np.ndarray]:
         """Batched lookup → (values, found_mask). Missing keys yield
         ``default_value`` (the reference's KV semantics: absent = initial
-        value)."""
-        self._check_overflow()
-        keys = self._check_keys(keys)
-        elems = len(keys) * max(self.value_dim, 1)
-        self._record_op("get", elems, elems * self.dtype.itemsize)
-        buckets = self._buckets_of(keys)
-        vals, found = self._lookup(
-            self.keys, self.values,
-            core.place(_split_keys(keys), mesh=self.mesh),
-            core.place(buckets, mesh=self.mesh))
+        value). Blocks on the device→host readback; use
+        :meth:`get_async` / :meth:`get_jax` to keep the hot loop
+        non-blocking."""
+        vals, found = self.get_jax(keys)
         return np.asarray(vals), np.asarray(found)
+
+    def get_async(self, keys) -> Handle:
+        """Non-blocking Get: a handle wrapping the DEVICE (values,
+        found) pair; ``wait()`` returns the device arrays once computed
+        (the true-async variant of the reference's ``GetAsync``)."""
+        return Handle(self.get_jax(keys))
+
+    def prepare_add(self, keys, deltas,
+                    option: Optional[AddOption] = None) -> "PreparedKVAdd":
+        """Host-side half of an Add: validate, hash, split, and STAGE the
+        batch onto the device (H2D), without touching table state.
+
+        Safe to run on a worker thread while the device applies a
+        previous batch — the double-buffered upload seam
+        (:class:`multiverso_tpu.client.KVStagingWriter` drives it). The
+        AddOption (lr/step) is resolved HERE, at prepare time.
+
+        The batch is PADDED to a power-of-two length (masked lanes carry
+        the EMPTY sentinel and drop on device), so variable-size adds
+        share a bounded set of compiled signatures — without it every
+        distinct length recompiles the fused probe program."""
+        keys = self._check_keys(keys)
+        uniq = np.unique(keys)
+        if len(uniq) != len(keys):
+            raise ValueError("duplicate keys in one add; pre-aggregate")
+        deltas = np.asarray(deltas)
+        n = len(keys)
+        want = (n, self.value_dim) if self.value_dim else (n,)
+        if deltas.shape != want:
+            raise ValueError(f"deltas shape {deltas.shape} != {want}")
+        b = _bucket(n)
+        query = np.full((b, 2), 0xFFFFFFFF, np.uint32)
+        query[:n] = _split_keys(keys)
+        buckets = np.zeros(b, np.int32)
+        buckets[:n] = self._buckets_of(keys)
+        pdeltas = np.zeros((b,) + deltas.shape[1:], deltas.dtype)
+        pdeltas[:n] = deltas
+        valid = np.zeros(b, bool)
+        valid[:n] = True
+        opt = (option or self.default_option).as_jax(self.mesh)
+        put = lambda a: core.place(a, mesh=self.mesh)
+        return PreparedKVAdd(buckets=put(buckets), query=put(query),
+                             deltas=put(pdeltas), valid=put(valid),
+                             option=opt, elems=int(deltas.size),
+                             nbytes=int(deltas.size) * self.dtype.itemsize)
+
+    def add_prepared(self, prepared: "PreparedKVAdd",
+                     sync: bool = False) -> Handle:
+        """Device half of an Add: dispatch one staged batch through the
+        fused probe+updater program. Must run on the thread that owns
+        the table (it swaps the live buffers)."""
+        self._poll_overflow()
+        self._record_op("add", prepared.elems, prepared.nbytes)
+        self.keys, self.values, self.state, n_over = \
+            self._probe_update(
+                self.keys, self.values, self.state, prepared.buckets,
+                prepared.query, prepared.deltas, prepared.valid,
+                prepared.option)
+        self._pending_over.append(n_over)
+        with self._option_lock:
+            self.default_option.step += 1
+            self.generation += 1
+            gen = self.generation
+        self._notify_views()
+        handle = Handle(table=self, generation=gen)
+        if sync:
+            handle.wait()
+            self._check_overflow()
+        return handle
 
     def add(self, keys, deltas, option: Optional[AddOption] = None,
             sync: bool = False) -> Handle:
@@ -301,6 +433,8 @@ class KVTable:
 
         Duplicate keys within one batch must be pre-aggregated (the
         client-side Aggregator role) — they raise otherwise.
+        :class:`multiverso_tpu.client.CoalescingBuffer` does that
+        pre-aggregation (and batches K adds into one dispatch).
 
         On bucket overflow the batch is dropped atomically ON DEVICE and
         the error surfaces at a later table op; the returned Handle and
@@ -308,34 +442,8 @@ class KVTable:
         dispatch time without serializing the async queue).
         """
         self._poll_overflow()
-        keys = self._check_keys(keys)
-        uniq = np.unique(keys)
-        if len(uniq) != len(keys):
-            raise ValueError("duplicate keys in one add; pre-aggregate")
-        deltas = np.asarray(deltas)
-        want = (len(keys), self.value_dim) if self.value_dim else (len(keys),)
-        if deltas.shape != want:
-            raise ValueError(f"deltas shape {deltas.shape} != {want}")
-        self._record_op("add", deltas.size,
-                        deltas.size * self.dtype.itemsize)
-
-        buckets = self._buckets_of(keys)
-        opt = (option or self.default_option).as_jax(self.mesh)
-        put = lambda a: core.place(a, mesh=self.mesh)
-        self.keys, self.values, self.state, n_over = \
-            self._probe_update(
-                self.keys, self.values, self.state, put(buckets),
-                put(_split_keys(keys)), put(deltas), opt)
-        self._pending_over.append(n_over)
-        with self._option_lock:
-            self.default_option.step += 1
-            self.generation += 1
-            gen = self.generation
-        handle = Handle(table=self, generation=gen)
-        if sync:
-            handle.wait()
-            self._check_overflow()
-        return handle
+        return self.add_prepared(self.prepare_add(keys, deltas, option),
+                                 sync=sync)
 
     def wait(self) -> None:
         jax.block_until_ready(self._live_buffers())
@@ -357,6 +465,9 @@ class KVTable:
     KV_MAGIC = "multiverso_tpu.kvtable.v1"
 
     def store(self, uri: str) -> None:
+        # checkpoint contract: every issued delta lands, including ones
+        # parked in attached coalescing buffers
+        self.flush_coalesced()
         self._check_overflow()
         host_keys = np.asarray(self.keys)
         # lanes fill contiguously (no deletion), so fill = live count
@@ -378,6 +489,9 @@ class KVTable:
         savez_stream(uri, manifest, payload)
 
     def load(self, uri: str) -> None:
+        # buffered deltas refer to the PRE-load state — flush them into
+        # it before the restore replaces the triple
+        self.flush_coalesced()
         # load is a table op: a pending overflow surfaces HERE, before
         # the restore replaces the state it refers to (a post-load raise
         # about pre-load state would be spurious)
@@ -440,6 +554,7 @@ class KVTable:
         # load replaces live state: outstanding add-handles read superseded
         with self._option_lock:
             self.generation += 1
+        self._notify_views()
 
     def _rehash_checkpoint(self, manifest, data):
         """Re-insert a checkpoint's live (key, value, state) triples into

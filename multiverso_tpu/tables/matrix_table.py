@@ -24,7 +24,6 @@ TPU design:
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 from typing import Any, Optional
 
 import jax
@@ -33,6 +32,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from multiverso_tpu.tables.base import Handle, Table
+from multiverso_tpu.telemetry.profiling import profiled_jit
 from multiverso_tpu.updaters import AddOption
 
 
@@ -91,18 +91,14 @@ class MatrixTable(Table):
     def _build_jits(self) -> None:
         replicated = NamedSharding(self.mesh, P(None, None))
 
-        @partial(jax.jit, out_shardings=replicated)
         def gather_rows(param, ids):
             return jnp.take(param, ids, axis=0)
 
-        @partial(jax.jit, donate_argnums=(0,))
         def scatter_add(param, ids, deltas):
             return param.at[ids].add(deltas.astype(param.dtype))
 
         state_sh = jax.tree.map(lambda _: self.state_sharding, self.state)
 
-        @partial(jax.jit, donate_argnums=(0, 1),
-                 out_shardings=(self.sharding, state_sh))
         def gather_apply_scatter(param, state, ids, deltas, mask, option):
             rows = jnp.take(param, ids, axis=0)
             st_rows = jax.tree.map(lambda s: jnp.take(s, ids, axis=0), state)
@@ -117,9 +113,19 @@ class MatrixTable(Table):
                 state, new_st, st_rows)
             return param, state
 
-        self._gather_rows = gather_rows
-        self._scatter_add = scatter_add
-        self._gather_apply_scatter = gather_apply_scatter
+        # profiled: profile.calls{fn=table.{gather,scatter_add,
+        # apply_rows}.<name>} count the row-path dispatches the client
+        # pipeline's row coalescing / caching are measured against
+        self._gather_rows = profiled_jit(
+            gather_rows, name=f"table.gather.{self.name}",
+            out_shardings=replicated)
+        self._scatter_add = profiled_jit(
+            scatter_add, name=f"table.scatter_add.{self.name}",
+            donate_argnums=(0,))
+        self._gather_apply_scatter = profiled_jit(
+            gather_apply_scatter, name=f"table.apply_rows.{self.name}",
+            donate_argnums=(0, 1),
+            out_shardings=(self.sharding, state_sh))
 
     def _pad_ids(self, ids: np.ndarray,
                  deltas: Optional[np.ndarray] = None):

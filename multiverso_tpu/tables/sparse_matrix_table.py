@@ -43,6 +43,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from multiverso_tpu import core
 from multiverso_tpu.tables.base import Handle
 from multiverso_tpu.tables.matrix_table import MatrixTable, _bucket
+from multiverso_tpu.telemetry.profiling import profiled_jit
 from multiverso_tpu.updaters import AddOption
 
 LANES = 128
@@ -97,41 +98,47 @@ class SparseMatrixTable(MatrixTable):
         replicated = NamedSharding(self.mesh, P(None, None))
         n_rows, n_cols = self.logical_shape
 
-        @partial(jax.jit, out_shardings=replicated)
         def snapshot(param):
             p2 = param.reshape(self.padded_shape)
             return jnp.copy(p2[:n_rows, :n_cols])
 
-        self._snapshot = snapshot
+        # profiled like the base kernels (tiled layouts replace them)
+        self._snapshot = profiled_jit(
+            snapshot, name=f"table.snapshot.{self.name}",
+            out_shardings=replicated)
 
-        @partial(jax.jit, out_shardings=replicated)
         def gather_rows(param, ids):
             rows = jnp.take(param, ids, axis=0)      # [n, C, 128]
             return rows.reshape(ids.shape[0], n_cols)
 
-        @partial(jax.jit, donate_argnums=(0,))
         def scatter_add(param, ids, deltas):
             d3 = deltas.reshape(ids.shape[0], c, LANES)
             return param.at[ids].add(d3.astype(param.dtype))
 
-        self._gather_rows = gather_rows
-        self._scatter_add = scatter_add
+        self._gather_rows = profiled_jit(
+            gather_rows, name=f"table.gather.{self.name}",
+            out_shardings=replicated)
+        self._scatter_add = profiled_jit(
+            scatter_add, name=f"table.scatter_add.{self.name}",
+            donate_argnums=(0,))
         # _gather_apply_scatter is unreachable: stateless updaters only
 
     # -- jitted sparse kernels --------------------------------------------
 
     def _build_sparse_jits(self) -> None:
         if self.tiled:
-            @partial(jax.jit, donate_argnums=(0,))
             def coo_scatter_add(param, rows, cols, vals):
                 return param.at[rows, cols // LANES, cols % LANES].add(
                     vals.astype(param.dtype))
         else:
-            @partial(jax.jit, donate_argnums=(0,))
             def coo_scatter_add(param, rows, cols, vals):
                 return param.at[rows, cols].add(vals.astype(param.dtype))
 
-        self._coo_scatter_add = coo_scatter_add
+        # profiled: the COO Add dispatch count (client coalescing of
+        # sparse adds is asserted against profile.calls on this name)
+        self._coo_scatter_add = profiled_jit(
+            coo_scatter_add, name=f"table.coo_scatter_add.{self.name}",
+            donate_argnums=(0,))
 
         replicated = NamedSharding(self.mesh, P(None))
         n_cols = self.num_cols

@@ -98,6 +98,11 @@ class FusedSuperstep:
         """
         if options is None:
             options = (None,) * len(self.tables)
+        # client pipeline: buffered coalesced deltas must land BEFORE
+        # the fused program reads (and donates) each table's storage —
+        # applying them after would reorder updates across the superstep
+        for t in self.tables:
+            t.flush_coalesced()
         opts = tuple(t._resolve_option(o)
                      for t, o in zip(self.tables, options))
         params = tuple(t.param for t in self.tables)

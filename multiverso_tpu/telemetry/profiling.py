@@ -14,6 +14,9 @@ to one wedged in a collective. :func:`profiled_jit` splits that out:
     histograms + last-value gauges,
   - ``profile.compiles{fn=...}`` counter (signature-cache misses —
     retrace storms show up as a climbing counter),
+  - ``profile.calls{fn=...}`` counter (every dispatch through the
+    wrapper, all paths — the denominator that proves dispatch-count
+    claims like the client pipeline's delta coalescing),
   - ``profile.flops{fn=...}`` / ``profile.bytes_accessed{fn=...}``
     gauges from XLA cost analysis where the backend reports them,
   - ``profile.memory.*{fn=...}`` gauges from XLA memory analysis
@@ -80,6 +83,13 @@ class _ProfiledJit:
         self._jit = jax.jit(fn, **jit_kw)
         self._compiled: Dict[Tuple, Any] = {}
         self._fallback = False
+        # per-dispatch counter (cached object — the registry lookup is a
+        # lock + dict probe, too hot for a per-call path): together with
+        # profile.compiles this is the evidence the client pipeline's
+        # coalescing claims rest on — N adds through a CoalescingBuffer
+        # must move this by 1, not N
+        self._calls = _metrics.registry().counter("profile.calls",
+                                                  fn=name)
 
     def _sig(self, args, kwargs) -> Tuple:
         import jax
@@ -141,6 +151,9 @@ class _ProfiledJit:
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
         import jax
 
+        # counted on EVERY path (AOT, tracer, fallback): the counter
+        # means "dispatches requested", not "AOT executions"
+        self._calls.inc()
         if self._fallback or any(
                 isinstance(l, jax.core.Tracer)
                 for l in jax.tree.leaves((args, kwargs))):

@@ -131,6 +131,59 @@ class TestASyncBuffer:
         with pytest.raises(RuntimeError, match="fill failed"):
             buf.get()
 
+    def test_single_persistent_worker(self):
+        # one worker thread serves ALL fills (no thread create/teardown
+        # on the per-batch path) — every fill must run on the same ident
+        import threading
+        idents = []
+
+        def fill(i):
+            idents.append(threading.get_ident())
+            return i
+
+        buf = ASyncBuffer(fill)
+        for _ in range(5):
+            buf.get()
+        buf.stop()
+        assert len(set(idents)) == 1
+        assert idents[0] != threading.get_ident()
+
+    def test_stop_joins_worker(self):
+        buf = ASyncBuffer(lambda i: i)
+        buf.get()
+        buf.stop()
+        assert not buf._thread.is_alive()
+
+    def test_poll_nonblocking(self):
+        import threading
+        gate = threading.Event()
+
+        def fill(i):
+            gate.wait(5.0)
+            return i * 10
+
+        buf = ASyncBuffer(fill)
+        assert buf.poll() is None       # fill still blocked: not ready
+        gate.set()
+        deadline = time.perf_counter() + 5.0
+        got = None
+        while got is None and time.perf_counter() < deadline:
+            got = buf.poll()
+            time.sleep(0.005)
+        assert got == 0                 # first fill; poll kicked the next
+        buf.stop()
+
+    def test_poll_propagates_error(self):
+        def fill(i):
+            raise ValueError("poll boom")
+
+        buf = ASyncBuffer(fill)
+        with pytest.raises(ValueError, match="poll boom"):
+            deadline = time.perf_counter() + 5.0
+            while time.perf_counter() < deadline:
+                buf.poll()
+                time.sleep(0.005)
+
     def test_prefetch_iterator(self):
         assert list(prefetch_iterator(range(10), depth=3)) == list(range(10))
 

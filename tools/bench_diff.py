@@ -14,13 +14,17 @@ autodetects each side:
   ``counter:...`` / ``gauge:...`` keys; step-time histograms become
   ``hist_mean_s:...``).
 
+- a client-pipeline micro-bench line (``client_bench.json`` from
+  ``benchmarks/client_pipeline.py`` — same flat metric-line shape).
+
 Prints every shared numeric key with old/new/delta%, plus keys present
 on only one side. Exit status is the CI contract: 0 when every watched
 key holds, 1 when a watched key REGRESSED (dropped) by more than
 ``--threshold`` percent (watched metrics are throughputs — higher is
 better; improvements never fail), 2 on unusable input. Default watch
-list: the two metrics of record plus the e2e tier (applied when
-present; ``--watch`` replaces it).
+list: the two metrics of record, the e2e tier, and the client-pipeline
+micro-bench throughputs (each applied when present; ``--watch``
+replaces the whole list).
 
 Pure stdlib, no jax — it must run on the same wedged-tunnel hosts the
 report CLI serves, and in CI (``make bench-diff`` /
@@ -35,7 +39,13 @@ import sys
 from typing import Dict, List, Tuple
 
 SNAPSHOT_KIND = "mvtpu.metrics.v1"
-DEFAULT_WATCH = ("value", "e2e_words_per_sec", "lda_doc_tokens_per_sec")
+DEFAULT_WATCH = ("value", "e2e_words_per_sec", "lda_doc_tokens_per_sec",
+                 # client-pipeline micro-bench (benchmarks/
+                 # client_pipeline.py): the coalesced-add and cached-get
+                 # throughputs are the PR's metrics of record
+                 "kv_add_ops_per_sec_coalesced",
+                 "kv_add_ops_per_sec_staged",
+                 "get_ops_per_sec_cached")
 
 
 def _flatten(prefix: str, obj, out: Dict[str, float]) -> None:
@@ -213,6 +223,20 @@ def selftest() -> int:
             "watched snapshot gauge regression must fail"
         m = load_metrics(s_old)
         assert m["hist_mean_s:dispatch.seconds"] == 0.25
+        # client-pipeline micro-bench lines: the coalesced/cached
+        # throughputs are watched by default
+        cl_old = put("cl_old.json", {
+            "metric": "client_kv_add_ops_per_sec", "value": 1000.0,
+            "unit": "adds/s", "kv_add_ops_per_sec_coalesced": 1000.0,
+            "kv_add_ops_per_sec_staged": 400.0,
+            "get_ops_per_sec_cached": 5000.0,
+            "kv_apply_dispatches_coalesced": 8.0})
+        cl_doc = json.loads(json.dumps(json.load(open(cl_old))))
+        cl_doc["get_ops_per_sec_cached"] = 2000.0           # -60%
+        cl_bad = put("cl_bad.json", cl_doc)
+        assert main([cl_old, cl_old]) == 0, "identical client line passes"
+        assert main([cl_old, cl_bad]) == 1, \
+            "cached-get throughput regression must fail"
         # unusable inputs exit 2, not a traceback
         hung = put("hung.json", {"rc": 124, "tail": "...", "parsed": None})
         assert main([hung, raw_ok]) == 2, "no parsed line -> exit 2"
