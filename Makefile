@@ -16,6 +16,13 @@
 #   make client-bench - worker-side client pipeline micro-bench
 #                   (coalescing / cache / staging) at tiny sizes on CPU;
 #                   drop MVTPU_CLIENT_BENCH_TINY for real sizes
+#   make ckpt-bench - run-level checkpoint store/restore micro-bench
+#                   (tiny sizes on CPU; drop MVTPU_CKPT_BENCH_TINY for
+#                   real sizes; emits checkpoint_bench.json)
+#   make chaos    - the chaos lane: fault-injection test subset
+#                   (ft subsystem + overwrite crash-window fuzz) plus a
+#                   CLI checkpoint/resume smoke under an active
+#                   MVTPU_CHAOS spec
 #   make native   - C++ data loader + baseline binaries
 #   make ci       - everything CI runs, in order
 
@@ -24,7 +31,7 @@ OLD ?= BENCH_r04.json
 NEW ?= BENCH_r05.json
 
 .PHONY: test dryrun bench bench-dryrun bench-diff bench-diff-selftest \
-	client-bench fuzz lint native ci
+	client-bench ckpt-bench chaos fuzz lint native ci
 
 fuzz:
 	$(PY) tests/deep_fuzz.py
@@ -47,6 +54,32 @@ bench-dryrun:
 client-bench:
 	MVTPU_CLIENT_BENCH_TINY=1 $(PY) benchmarks/client_pipeline.py
 
+ckpt-bench:
+	MVTPU_CKPT_BENCH_TINY=1 $(PY) benchmarks/checkpoint_bench.py
+
+# the chaos lane: recovery paths exercised under injected faults —
+# the ft test subset, the overwrite crash-window fuzz, and an app CLI
+# checkpoint + resume smoke with chaos-injected IO errors retried live
+chaos:
+	$(PY) -m pytest tests/test_ft.py \
+	  "tests/test_io.py::TestOverwriteCrashWindow" -q \
+	  -p no:cacheprovider
+	rm -rf /tmp/mvtpu_chaos_smoke
+	MVTPU_CHAOS="seed=1;io.write:error:times=2;io.write:latency:ms=1" \
+	  $(PY) -c "import jax; jax.config.update('jax_platforms', 'cpu'); \
+	  from multiverso_tpu.apps.logreg import main; \
+	  main(['-input_dimension=12', '-output_dimension=3', \
+	        '-minibatch_size=128', '-train_epoch=2', \
+	        '-run_dir=/tmp/mvtpu_chaos_smoke', '-ckpt_every=1'])"
+	MVTPU_CHAOS="seed=2;io.read:latency:ms=1" \
+	  $(PY) -c "import jax; jax.config.update('jax_platforms', 'cpu'); \
+	  from multiverso_tpu.apps.logreg import main; \
+	  main(['-input_dimension=12', '-output_dimension=3', \
+	        '-minibatch_size=128', '-train_epoch=2', \
+	        '-run_dir=/tmp/mvtpu_chaos_smoke', '-ckpt_every=1', \
+	        '-resume=true'])"
+	rm -rf /tmp/mvtpu_chaos_smoke
+
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	  $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
@@ -57,4 +90,5 @@ bench:
 native:
 	$(MAKE) -C native
 
-ci: lint bench-diff-selftest native test dryrun bench-dryrun client-bench
+ci: lint bench-diff-selftest native test dryrun bench-dryrun \
+	client-bench ckpt-bench chaos

@@ -9,13 +9,14 @@ compiles as an on-device sharded optimizer step.
 """
 
 from multiverso_tpu.version import __version__
-from multiverso_tpu import client, telemetry
+from multiverso_tpu import client, ft, telemetry
 from multiverso_tpu.core import (barrier, init, is_initialized, mesh,
                                  num_servers, num_workers, rank, server_id,
                                  shutdown, size, worker_id)
 
 __all__ = [
-    "__version__", "barrier", "client", "init", "is_initialized", "mesh",
+    "__version__", "barrier", "client", "ft", "init", "is_initialized",
+    "mesh",
     "num_servers", "num_workers", "rank", "server_id", "shutdown", "size",
     "telemetry", "worker_id",
 ]

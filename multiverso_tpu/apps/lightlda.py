@@ -215,6 +215,14 @@ class LightLDA:
                              f"got {c.precision!r}")
         self.alpha = c.resolved_alpha()
         self.beta = c.beta
+        # fault tolerance (ft.checkpoint.wire_app): run manager +
+        # sweep cursor. _sweep_done counts completed sweeps (what a
+        # checkpoint records); _resume_sweeps is the restored offset,
+        # consumed by the FIRST train() after a resume — repeated
+        # in-session train(n) calls keep their "n more sweeps" meaning
+        self.run_ckpt = None
+        self._sweep_done = 0
+        self._resume_sweeps = 0
 
         tiled = c.sampler == "tiled"
         if tiled and self.K % 128:
@@ -1567,7 +1575,11 @@ class LightLDA:
         every = max(self.config.eval_every, 1)
         t0 = time.perf_counter()
         ck_every = self.config.checkpoint_interval
-        for it in range(iters):
+        # the restored cursor applies ONCE (the resume); later train()
+        # calls start from 0 like they always did
+        start_sweep = min(self._resume_sweeps, iters)
+        self._resume_sweeps = 0
+        for it in range(start_sweep, iters):
             t_sweep = time.perf_counter()
             with telemetry.span("lda.sweep"):
                 self.sweep()
@@ -1575,10 +1587,16 @@ class LightLDA:
                 "lda", it, tokens=self.num_tokens,
                 dispatch_s=time.perf_counter() - t_sweep)
             telemetry.beat()    # flight recorder: a heartbeat per sweep
-            if ck_every > 0 and self.config.checkpoint_prefix \
+            self._sweep_done = it + 1
+            if self.run_ckpt is not None:
+                # run-level manager (replaces the bespoke
+                # checkpoint_interval prefix dump): atomic generations,
+                # keep-K retention, overlapped writes; collective
+                self.run_ckpt.maybe_save(it + 1, self.run_state)
+            elif ck_every > 0 and self.config.checkpoint_prefix \
                     and (it + 1) % ck_every == 0:
-                # periodic full-state dump (sampler state included, so
-                # a crash resumes mid-training); collective
+                # legacy periodic full-state dump (sampler state
+                # included, so a crash resumes mid-training); collective
                 self.store(self.config.checkpoint_prefix)
             if (it + 1) % every and it != iters - 1:
                 continue
@@ -1586,7 +1604,7 @@ class LightLDA:
             self.ll_history.append(ll)
             log.info("lightlda iter %d: loglik/token=%.4f", it, ll)
         dt = time.perf_counter() - t0
-        tokens = self.num_tokens * iters
+        tokens = self.num_tokens * max(iters - start_sweep, 0)
         telemetry.counter("lda.tokens").inc(tokens)
         telemetry.emit("lda.doc_tokens_per_sec", tokens / dt,
                        "tokens/s")
@@ -1693,17 +1711,16 @@ class LightLDA:
                     lines.append(f"{w} {ent}".rstrip())
                 stream.write(("\n".join(lines) + "\n").encode())
 
-    def store(self, uri_prefix: str) -> None:
-        """Checkpoint tables AND sampler state (z, doc-topic counts):
-        the three must stay consistent or resumed sweeps corrupt counts.
+    def _export_sampler_state(self):
+        """(manifest scalars, payload arrays) of the sampler state —
+        z assignments + doc-topic counts in the layout-appropriate
+        encoding. ONE copy of the export logic, shared by the legacy
+        prefix :meth:`store` and the run-manager :meth:`run_state`.
 
         Multi-process ``stream_blocks`` note: COLLECTIVE (like table
         store) — the lazy z sync all-gathers owned lanes, so every
         process must call it in lockstep (an ``if rank == 0:`` guard
         deadlocks)."""
-        from multiverso_tpu.tables.base import savez_stream
-        self.word_topic.store(f"{uri_prefix}.word_topic.npz")
-        self.summary.store(f"{uri_prefix}.summary.npz")
         if self._docblock:
             if self.config.local_corpus:
                 # per-process shard: z alone is the sampler state (load
@@ -1746,7 +1763,6 @@ class LightLDA:
             # lengths with different block geometry must not load
             manifest["block_tokens"] = self.config.block_tokens
             manifest["block_docs"] = self.config.block_docs
-        state_path = f"{uri_prefix}.state.npz"
         if self.config.local_corpus:
             # per-process sampler-state shard (z and doc counts are
             # process-local under local_corpus); same process layout
@@ -1760,13 +1776,25 @@ class LightLDA:
             crc, ntok = self._local_shard_digest()
             manifest["shard_crc32"] = crc
             manifest["local_tokens"] = ntok
+        return manifest, {"z": z, "ndk": dense}
+
+    def store(self, uri_prefix: str) -> None:
+        """Checkpoint tables AND sampler state (z, doc-topic counts):
+        the three must stay consistent or resumed sweeps corrupt counts.
+        Collectivity caveats: see :meth:`_export_sampler_state`."""
+        from multiverso_tpu.tables.base import savez_stream
+        self.word_topic.store(f"{uri_prefix}.word_topic.npz")
+        self.summary.store(f"{uri_prefix}.summary.npz")
+        manifest, payload = self._export_sampler_state()
+        state_path = f"{uri_prefix}.state.npz"
+        if self.config.local_corpus:
             state_path = (f"{uri_prefix}.state"
                           f".rank{jax.process_index()}.npz")
         # every rank writes (z is globally complete after the sync above,
         # so the shared-path payloads are identical; per-process targets
         # like mem:// need their own copy); shared-path safety comes from
         # the stream layer's atomic rename
-        savez_stream(state_path, manifest, {"z": z, "ndk": dense})
+        savez_stream(state_path, manifest, payload)
         self._last_store = (uri_prefix, self._calls_done)
 
     def _local_shard_digest(self):
@@ -1793,6 +1821,14 @@ class LightLDA:
                           f".rank{jax.process_index()}.npz")
         manifest, data = loadz_stream(state_path,
                                       "multiverso_tpu.lda_state.v1")
+        self._import_sampler_state(manifest, data)
+
+    def _import_sampler_state(self, manifest, data) -> None:
+        """Validate + install sampler state (z, doc counts) against the
+        LIVE tables — ONE copy of the geometry/seed/layout/torn-set
+        checks, shared by the legacy prefix :meth:`load` and the
+        run-manager :meth:`restore_run_state`. ``data`` is dict-like
+        with ``"z"``/``"ndk"`` arrays."""
         if self.config.local_corpus and \
                 manifest.get("processes") != jax.process_count():
             raise ValueError(
@@ -1819,7 +1855,7 @@ class LightLDA:
                 self.word_topic.default_option.step \
                 != int(manifest["word_topic_step"]):
             raise ValueError(
-                f"lda checkpoint {uri_prefix!r} is torn: state was "
+                "lda checkpoint is torn: state was "
                 f"written at word_topic step "
                 f"{manifest['word_topic_step']} but the loaded table "
                 f"is at step {self.word_topic.default_option.step} — a "
@@ -1868,6 +1904,11 @@ class LightLDA:
         self._z = self._place(
             np.asarray(data["z"]).reshape(self._z.shape), P())
         dense = np.asarray(data["ndk"])
+        # restore INTO the live array's own sharding (the init-time
+        # build jit's output layout) — the fused superstep's donation
+        # aliasing was compiled against it, and a replicated P() here
+        # hits an XLA aliased-size mismatch on model-parallel meshes
+        ndk_sharding = self._ndk.sharding
         if self._docblock:
             blocked = np.zeros(self._ndk.shape,
                                np.dtype(self._ndk.dtype)).reshape(
@@ -1877,15 +1918,33 @@ class LightLDA:
                     + self._row_of_doc[valid])
             blocked[rows] = dense[:self.num_docs][valid].reshape(
                 int(valid.sum()), -1)
-            self._ndk = self._place(
-                blocked.reshape(self._ndk.shape), P())
+            self._ndk = jax.device_put(
+                blocked.reshape(self._ndk.shape), ndk_sharding)
         else:
-            self._ndk = self._place(
+            self._ndk = jax.device_put(
                 dense.reshape(self._ndk.shape).astype(self._ndk.dtype),
-                P())
+                ndk_sharding)
         # resume the RNG sequence where the checkpoint left off; replaying
         # consumed fold_in keys would correlate sweeps across the resume
         self._calls_done = int(manifest.get("calls_done", 0))
+
+    # -- fault tolerance (ft.checkpoint contract) --------------------------
+
+    def run_state(self) -> dict:
+        """Train-state for the run manager: the sampler state (z +
+        doc-topic counts, via the shared export) plus the sweep cursor.
+        The tables ride the manager's own table export. COLLECTIVE
+        under multi-process ``stream_blocks`` (see
+        :meth:`_export_sampler_state`)."""
+        manifest, payload = self._export_sampler_state()
+        # the scalars flatten into the app-state manifest; arrays into
+        # the payload — restore_run_state reassembles both
+        return {**manifest, **payload, "sweep_done": self._sweep_done}
+
+    def restore_run_state(self, restored) -> None:
+        self._import_sampler_state(restored.state, restored.arrays)
+        self._sweep_done = int(restored.get("sweep_done", 0))
+        self._resume_sweeps = self._sweep_done
 
 
 def main(argv=None) -> None:
@@ -1910,6 +1969,8 @@ def main(argv=None) -> None:
     configure.define_int("checkpoint_interval", 0,
                          "store -output_file every N sweeps (0 = only "
                          "at end)", overwrite=True)
+    from multiverso_tpu.ft.checkpoint import define_run_flags, wire_app
+    define_run_flags()
     core.init(argv)
     path = configure.get_flag("input_file")
     if not path:
@@ -1928,10 +1989,18 @@ def main(argv=None) -> None:
         checkpoint_interval=configure.get_flag("checkpoint_interval"),
     )
     app = LightLDA(tw, td, vocab, cfg)
+    # fault tolerance: run-level checkpoint/resume, cadence in SWEEPS.
+    # -run_dir routes the periodic trigger through the manager (atomic
+    # generations + retention), replacing the bespoke prefix dump; the
+    # legacy -checkpoint_interval value still sets the cadence.
+    mgr = wire_app(app, [app.word_topic, app.summary],
+                   every_default=cfg.checkpoint_interval or 1)
     # flight recorder: env-gated stall watchdog + device capture (the
     # per-sweep beat is in train)
     with telemetry.maybe_watchdog("lda"), telemetry.profile_window("lda"):
         app.train()
+    if mgr is not None:
+        mgr.close()     # drain pending background checkpoint writes
     telemetry.record_device_memory()
     out = configure.get_flag("output_file")
     # skip the end-of-train dump when the last periodic store already

@@ -195,6 +195,15 @@ class LogisticRegression:
         # cached view instead of a blocking whole-table fetch per call
         self._view = client.maybe_cached_view(self.table)
         self._data_sharding = NamedSharding(self.mesh, P(core.DATA_AXIS))
+        # fault tolerance (ft.checkpoint.wire_app): run-level manager +
+        # resume cursor — epochs are the checkpoint/restart unit here.
+        # _epoch_done counts completed epochs (what a checkpoint
+        # records); _resume_epochs is the restored offset, consumed by
+        # the FIRST train() after a resume — repeated in-session
+        # train() calls keep their run-all-epochs meaning
+        self.run_ckpt = None
+        self._epoch_done = 0
+        self._resume_epochs = 0
         self._build_step()
 
     # -- model math --------------------------------------------------------
@@ -358,9 +367,30 @@ class LogisticRegression:
 
     def train(self, X: np.ndarray, y: np.ndarray) -> float:
         loss = float("nan")
-        for e in range(self.config.epochs):
+        # resume picks up at the restored epoch cursor (applied ONCE):
+        # the table state is exact (CRC-verified restore) and each
+        # epoch's shuffle seed derives from its index, so the remaining
+        # epochs replay identically to the uninterrupted run
+        start = min(self._resume_epochs, self.config.epochs)
+        self._resume_epochs = 0
+        for e in range(start, self.config.epochs):
             loss = self.train_epoch(X, y, shuffle_seed=self.config.seed + e)
+            self._epoch_done = e + 1
+            if self.run_ckpt is not None:
+                self.run_ckpt.maybe_save(self._epoch_done, self.run_state)
         return loss
+
+    # -- fault tolerance (ft.checkpoint contract) --------------------------
+
+    def run_state(self) -> dict:
+        """App train-state for the run checkpoint manager: the epoch
+        cursor (RNG state is derived from it — shuffle seeds fold the
+        epoch index)."""
+        return {"epoch_done": self._epoch_done}
+
+    def restore_run_state(self, restored) -> None:
+        self._epoch_done = int(restored.get("epoch_done", 0))
+        self._resume_epochs = self._epoch_done
 
     # -- inference / eval --------------------------------------------------
 
@@ -405,6 +435,8 @@ def main(argv=None) -> None:
                           "(updater state + update FLOPs / dp)",
                           overwrite=True)
     configure.define_string("output_model_file", "", "checkpoint URI", overwrite=True)
+    from multiverso_tpu.ft.checkpoint import define_run_flags, wire_app
+    define_run_flags()
     core.init(argv)
     # the global updater_type default is "default" (plain add) — for a
     # gradient-descent app that means ascent; this app's default is sgd
@@ -443,12 +475,18 @@ def main(argv=None) -> None:
                         np.float32)
     else:
         X, y = synthetic_blobs(20000, cfg.input_dim, cfg.num_classes)
+    # fault tolerance: -run_dir/-resume (or MVTPU_RUN_DIR/MVTPU_RESUME)
+    # enable run-level checkpoint/resume, cadence in EPOCHS (default:
+    # every epoch once a run dir is configured)
+    mgr = wire_app(app, [app.table], every_default=1)
     # flight recorder: MVTPU_WATCHDOG=<s> arms a stall watchdog (the
     # per-step beat is in train_epoch); MVTPU_PROFILE_DIR captures a
     # device profile of the whole training run
     with telemetry.maybe_watchdog("logreg"), \
             telemetry.profile_window("logreg"):
         app.train(X, y)
+    if mgr is not None:
+        mgr.close()     # drain pending background checkpoint writes
     telemetry.record_device_memory()
     log.info("train accuracy: %.4f", app.accuracy(X, y))
     if test_file:

@@ -118,6 +118,12 @@ class SparseLogisticRegression:
         # stale, the reference worker's own bounded-staleness semantics.
         self._coalescer = client.maybe_coalescing(self.table)
         self._step_jits: Dict[Tuple[int, int], object] = {}
+        # fault tolerance (ft.checkpoint.wire_app): epoch-cursor
+        # resume; the restored offset is consumed by the FIRST train()
+        # after a resume (in-session train() calls keep restarting)
+        self.run_ckpt = None
+        self._epoch_done = 0
+        self._resume_epochs = 0
 
     # -- batch packing -----------------------------------------------------
 
@@ -213,7 +219,12 @@ class SparseLogisticRegression:
         loss = float("nan")
         t0 = time.perf_counter()
         step_no = 0
-        for e in range(c.epochs):
+        # resume (applied ONCE): table state restored exactly at an
+        # epoch boundary and each epoch's permutation seed derives from
+        # its index, so the remaining epochs replay identically
+        start = min(self._resume_epochs, c.epochs)
+        self._resume_epochs = 0
+        for e in range(start, c.epochs):
             order = np.random.default_rng(c.seed + e).permutation(n)
             losses = []
             for s in range(0, n, c.minibatch_size):
@@ -229,6 +240,11 @@ class SparseLogisticRegression:
                 step_no += 1
             loss = float(np.mean(losses))
             log.info("sparse_logreg epoch %d: loss=%.4f", e, loss)
+            self._epoch_done = e + 1
+            if self.run_ckpt is not None:
+                # export_checkpoint_async flushes the coalescer, so the
+                # checkpoint observes every buffered delta
+                self.run_ckpt.maybe_save(self._epoch_done, self.run_state)
         if self._coalescer is not None:
             # the tail partial group must land before eval/checkpoint
             self._coalescer.flush()
@@ -237,6 +253,18 @@ class SparseLogisticRegression:
         telemetry.emit("sparse_logreg.samples_per_sec",
                        n * c.epochs / dt, "samples/s")
         return loss
+
+    # -- fault tolerance (ft.checkpoint contract) --------------------------
+
+    def run_state(self) -> dict:
+        """Epoch cursor: the KVTable (weights + updater state + key
+        layout) rides the manager's table export; minibatch RNG derives
+        from the epoch index."""
+        return {"epoch_done": self._epoch_done}
+
+    def restore_run_state(self, restored) -> None:
+        self._epoch_done = int(restored.get("epoch_done", 0))
+        self._resume_epochs = self._epoch_done
 
     # -- inference ---------------------------------------------------------
 
@@ -286,6 +314,8 @@ def main(argv=None) -> None:
     configure.define_int("epoch", 1, "epochs", overwrite=True)
     configure.define_string("output_file", "", "checkpoint uri",
                             overwrite=True)
+    from multiverso_tpu.ft.checkpoint import define_run_flags, wire_app
+    define_run_flags()
     core.init(argv)
     path = configure.get_flag("train_file")
     if not path:
@@ -300,11 +330,15 @@ def main(argv=None) -> None:
         regular_lambda=configure.get_flag("regular_lambda"),
         epochs=configure.get_flag("epoch"))
     app = SparseLogisticRegression(cfg)
+    # fault tolerance: run-level checkpoint/resume, cadence in epochs
+    mgr = wire_app(app, [app.table], every_default=1)
     # flight recorder: env-gated stall watchdog + device capture (the
     # per-step beat is in train)
     with telemetry.maybe_watchdog("sparse_logreg"), \
             telemetry.profile_window("sparse_logreg"):
         app.train(rows, y)
+    if mgr is not None:
+        mgr.close()     # drain pending background checkpoint writes
     telemetry.record_device_memory()
     log.info("train accuracy: %.4f", app.accuracy(rows, y))
     test = configure.get_flag("test_file")

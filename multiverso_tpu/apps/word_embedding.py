@@ -221,6 +221,7 @@ class WordEmbedding:
             raise ValueError(f"model must be 'skipgram' or 'cbow', "
                              f"got {c.model!r}")
         self._key = core.prng_key(c.seed, mesh=self.mesh)
+        self.run_ckpt = None        # ft.checkpoint.wire_app attaches
         self._step_no = 0
         self._sched_offset = 0      # set by load(): resumed-call count
         self._sched_plan = 0        # set by load(): original planned
@@ -490,11 +491,17 @@ class WordEmbedding:
             losses.append(loss)
             srcs_buf, tgts_buf = [], []
             call_no += 1
-            if c.checkpoint_interval > 0 and c.checkpoint_prefix \
+            if self.run_ckpt is not None:
+                # run-level manager (preferred over the bespoke prefix
+                # dump): atomically-committed generations, keep-K
+                # retention, overlapped writes; collective — every
+                # process reaches the same call_no in lockstep
+                self.run_ckpt.maybe_save(
+                    self._step_no // c.steps_per_call, self.run_state)
+            elif c.checkpoint_interval > 0 and c.checkpoint_prefix \
                     and call_no % c.checkpoint_interval == 0:
-                # periodic mid-train dump (SURVEY §6.4's flag-driven
-                # trigger); collective — every process reaches the same
-                # call_no in lockstep
+                # legacy periodic mid-train dump (SURVEY §6.4's
+                # flag-driven trigger); collective
                 self.store(c.checkpoint_prefix)
             if total_steps is not None \
                     and call_no * c.steps_per_call >= total_steps:
@@ -675,6 +682,33 @@ class WordEmbedding:
             self._sched_offset = \
                 self._step_no // self.config.steps_per_call
 
+    # -- fault tolerance (ft.checkpoint contract) --------------------------
+
+    def run_state(self) -> dict:
+        """Train-state for the run manager: the step cursor and the
+        ORIGINAL planned call count, so a resumed run continues the
+        stored run's LR decay and ``fold_in`` key sequence instead of
+        restarting them (same semantics as the meta-file resume)."""
+        return {"step_no": self._step_no,
+                "steps_per_call": self.config.steps_per_call,
+                "sched_plan": self._sched_plan or self._train_plan}
+
+    def restore_run_state(self, restored) -> None:
+        spc = int(restored.get("steps_per_call",
+                               self.config.steps_per_call))
+        if spc != self.config.steps_per_call:
+            raise ValueError(
+                f"run checkpoint was written with steps_per_call={spc}, "
+                f"this app uses {self.config.steps_per_call}: the "
+                "resume offset and fold_in key sequence are "
+                "call-indexed — construct the app with the original "
+                "steps_per_call")
+        self._step_no = int(restored.get("step_no", 0))
+        self._sched_plan = int(restored.get("sched_plan", 0))
+        if self._sched_plan:
+            self._sched_offset = \
+                self._step_no // self.config.steps_per_call
+
 
 def main(argv=None) -> None:
     """CLI mirroring the reference's word2vec-style argv."""
@@ -694,6 +728,8 @@ def main(argv=None) -> None:
     configure.define_int("checkpoint_interval", 0,
                          "store -output_file every N superstep calls "
                          "(0 = only at end)", overwrite=True)
+    from multiverso_tpu.ft.checkpoint import define_run_flags, wire_app
+    define_run_flags()
     core.init(argv)
     train_file = configure.get_flag("train_file")
     if not train_file:
@@ -716,10 +752,17 @@ def main(argv=None) -> None:
         checkpoint_interval=configure.get_flag("checkpoint_interval"),
     )
     app = WordEmbedding(corpus, cfg)
+    # fault tolerance: run-level checkpoint/resume, cadence in superstep
+    # calls (-ckpt_every / MVTPU_CKPT_EVERY; falls back to the legacy
+    # -checkpoint_interval cadence, default 50 calls)
+    mgr = wire_app(app, [app.w_in, app.w_out],
+                   every_default=cfg.checkpoint_interval or 50)
     # flight recorder: env-gated stall watchdog + device capture (the
     # per-dispatch beat is in _dispatch)
     with telemetry.maybe_watchdog("w2v"), telemetry.profile_window("w2v"):
         app.train()
+    if mgr is not None:
+        mgr.close()     # drain pending background checkpoint writes
     telemetry.record_device_memory()
     out = configure.get_flag("output_file")
     # skip the end-of-train dump when the last periodic store already

@@ -135,6 +135,11 @@ def init(argv: Optional[Sequence[str]] = None, *,
             jax.distributed.initialize(coordinator_address=address,
                                        **kwargs)
 
+        # fault injection rides runtime init: one env var turns any run
+        # into a chaos run (tests / the chaos CI lane)
+        from multiverso_tpu.ft.chaos import chaos_from_env
+        chaos_from_env()
+
         devs = list(devices) if devices is not None else jax.devices()
         dp = data_parallel if data_parallel is not None \
             else configure.get_flag("data_parallel")
@@ -256,6 +261,11 @@ def barrier(name: Optional[str] = None) -> None:
     collective cannot complete until every host has dispatched it.
     """
     m = mesh()
+    # fault point: a 'latency' rule here models a straggler host; an
+    # 'error' rule a lost peer (the failure mode SURVEY §6.3 records
+    # the reference hangs on)
+    from multiverso_tpu.ft.chaos import chaos_point
+    chaos_point("core.barrier")
     _RT.barrier_count += 1
     t0 = time.perf_counter()
     ones = jax.device_put(

@@ -1,0 +1,272 @@
+"""Deterministic fault injection: the ``MVTPU_CHAOS`` spec.
+
+The reference has no fault injection at all — recovery code that is
+never exercised is recovery code that does not work. This module puts
+named *fault points* on the paths a preemption or flaky filesystem
+actually hits (stream IO, table dispatch, the barrier), and a
+seedable, deterministic injector that fires faults at them according
+to a spec string, so every recovery path runs in tests and a chaos CI
+lane (``make chaos``) instead of only in production.
+
+Spec grammar (semicolon-separated rules)::
+
+    MVTPU_CHAOS = "[seed=<int>;]rule[;rule...]"
+    rule        = <point-pattern>:<kind>[:key=value[,key=value...]]
+
+- ``point-pattern`` — a fault-point name, ``fnmatch``-style globs
+  allowed (``io.*`` matches ``io.write`` and ``io.read``).
+- ``kind`` — one of:
+  - ``error``   — raise :class:`ChaosError` (an ``OSError`` subclass,
+    so IO retry policies treat it as transient),
+  - ``latency`` — sleep ``ms`` milliseconds,
+  - ``torn``    — for write points: make the write LOOK like a crash
+    between the payload write and the commit rename (the temp bytes
+    land, the rename never happens) by raising :class:`ChaosTornWrite`
+    *after* the payload is on disk,
+  - ``crash``   — raise :class:`ChaosCrash` (NOT an OSError: retry
+    policies never swallow it — it simulates the process dying).
+- params:
+  - ``p=<float>``   — firing probability per hit (default 1.0),
+  - ``after=<int>`` — skip the first N matching hits (default 0),
+  - ``times=<int>`` — fire at most N times (default unlimited),
+  - ``ms=<float>``  — latency milliseconds (``latency`` kind, default 1).
+
+Determinism: the injector derives every probabilistic draw from
+``splitmix64(seed, point-hit-counter)`` — same spec, same call
+sequence, same faults. No wall clock, no global RNG.
+
+Examples::
+
+    MVTPU_CHAOS="io.write:error:p=0.5,times=3"
+    MVTPU_CHAOS="seed=7;io.*:latency:ms=5;ckpt.commit:torn:after=2,times=1"
+
+Fault points in the codebase (grep ``chaos_point(`` for ground truth):
+
+====================  =====================================================
+``io.open.read``      stream open for read (`io/stream.py`)
+``io.open.write``     stream open for write
+``io.read``           every stream read call
+``io.write``          every stream write call
+``io.rename``         the atomic temp->final commit rename (torn-write
+                      simulation: payload lands in the temp file, the
+                      final path is never updated)
+``io.mv.aside``       fsspec overwrite: the ``final -> final.bak`` move
+``io.mv.replace``     fsspec overwrite: the ``tmp -> final`` move
+``table.add``         dense/KV table Add dispatch (`tables/base.py`)
+``table.get``         whole-table Get dispatch
+``core.barrier``      the global barrier (`core.py`)
+``multihost.allgather``  multihost collectives (`parallel/multihost.py`)
+``ckpt.commit``       RunCheckpointManager manifest commit (`ft/checkpoint.py`)
+``ckpt.gc``           RunCheckpointManager retention delete
+====================  =====================================================
+
+The injector is process-global and OFF unless installed: fault points
+cost one ``is None`` check when no chaos is active, so production hot
+paths pay nothing.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+CHAOS_ENV = "MVTPU_CHAOS"
+
+
+class ChaosError(OSError):
+    """Injected transient IO fault (retryable — an OSError)."""
+
+
+class ChaosTornWrite(ChaosError):
+    """Injected crash between payload write and commit rename."""
+
+
+class ChaosCrash(BaseException):
+    """Injected process death. Deliberately NOT an Exception subclass:
+    retry policies and broad ``except Exception`` recovery code must
+    never swallow it — it models the process being killed."""
+
+
+def _splitmix64(x: int) -> int:
+    """splitmix64 finalizer — the deterministic per-hit hash."""
+    x = (x + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return x ^ (x >> 31)
+
+
+@dataclass
+class ChaosRule:
+    """One parsed spec rule (see module docstring for the grammar)."""
+    pattern: str
+    kind: str                   # error | latency | torn | crash
+    p: float = 1.0
+    after: int = 0
+    times: Optional[int] = None
+    ms: float = 1.0
+    # runtime state
+    hits: int = 0               # matching hits seen
+    fired: int = 0              # faults actually fired
+
+    def matches(self, point: str) -> bool:
+        return fnmatch.fnmatchcase(point, self.pattern)
+
+
+KINDS = ("error", "latency", "torn", "crash")
+
+
+def parse_chaos_spec(spec: str) -> "ChaosInjector":
+    """Parse a ``MVTPU_CHAOS`` spec string into an injector (raises
+    ``ValueError`` on malformed specs — a typo'd chaos spec silently
+    doing nothing would defeat the test that set it)."""
+    seed = 0
+    rules: List[ChaosRule] = []
+    for raw in spec.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        if raw.startswith("seed="):
+            seed = int(raw[5:])
+            continue
+        parts = raw.split(":")
+        if len(parts) < 2:
+            raise ValueError(
+                f"chaos rule {raw!r}: expected '<point>:<kind>[:k=v,...]'")
+        pattern, kind = parts[0].strip(), parts[1].strip()
+        if kind not in KINDS:
+            raise ValueError(
+                f"chaos rule {raw!r}: kind {kind!r} not in {KINDS}")
+        rule = ChaosRule(pattern=pattern, kind=kind)
+        if len(parts) > 2:
+            for kv in ":".join(parts[2:]).split(","):
+                kv = kv.strip()
+                if not kv:
+                    continue
+                if "=" not in kv:
+                    raise ValueError(
+                        f"chaos rule {raw!r}: param {kv!r} is not k=v")
+                k, v = kv.split("=", 1)
+                k = k.strip()
+                if k == "p":
+                    rule.p = float(v)
+                elif k == "after":
+                    rule.after = int(v)
+                elif k == "times":
+                    rule.times = int(v)
+                elif k == "ms":
+                    rule.ms = float(v)
+                else:
+                    raise ValueError(
+                        f"chaos rule {raw!r}: unknown param {k!r} "
+                        "(valid: p, after, times, ms)")
+        rules.append(rule)
+    return ChaosInjector(rules=rules, seed=seed)
+
+
+@dataclass
+class ChaosInjector:
+    """Deterministic fault injector over a rule list."""
+
+    rules: List[ChaosRule] = field(default_factory=list)
+    seed: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False)
+
+    def hit(self, point: str) -> None:
+        """Evaluate the fault point: no-op, sleep, or raise. Called by
+        :func:`chaos_point` when an injector is installed."""
+        for rule in self.rules:
+            if not rule.matches(point):
+                continue
+            with self._lock:
+                rule.hits += 1
+                n = rule.hits
+                if n <= rule.after:
+                    continue
+                if rule.times is not None and rule.fired >= rule.times:
+                    continue
+                if rule.p < 1.0:
+                    # deterministic draw: hash(seed, pattern, hit index)
+                    # — crc32, not hash(): str hash is randomized per
+                    # process (PYTHONHASHSEED), which would make the
+                    # same spec fire differently across processes
+                    import zlib
+                    pat = zlib.crc32(rule.pattern.encode())
+                    h = _splitmix64(self.seed ^ _splitmix64(pat) ^ n)
+                    if (h / 2.0 ** 64) >= rule.p:
+                        continue
+                rule.fired += 1
+            self._fire(rule, point)
+
+    def _fire(self, rule: ChaosRule, point: str) -> None:
+        # telemetry through sys.modules only (an installed injector in
+        # a jax-free process must not drag the package in)
+        import sys
+        m = sys.modules.get("multiverso_tpu.telemetry.metrics")
+        if m is not None:
+            try:
+                m.counter("chaos.fired", point=point,
+                          kind=rule.kind).inc()
+            except Exception:
+                pass
+        if rule.kind == "latency":
+            time.sleep(rule.ms / 1000.0)
+            return
+        if rule.kind == "error":
+            raise ChaosError(f"chaos: injected IO error at {point!r} "
+                             f"(rule {rule.pattern!r}, firing "
+                             f"{rule.fired})")
+        if rule.kind == "torn":
+            raise ChaosTornWrite(
+                f"chaos: injected torn write at {point!r} — payload "
+                "written, commit rename suppressed")
+        raise ChaosCrash(f"chaos: injected crash at {point!r}")
+
+    def counts(self) -> Dict[str, int]:
+        """{pattern:kind: fired count} — test/report introspection."""
+        return {f"{r.pattern}:{r.kind}": r.fired for r in self.rules}
+
+
+# -- process-global installation -------------------------------------------
+
+_INSTALLED: Optional[ChaosInjector] = None
+
+
+def install_chaos(spec_or_injector) -> ChaosInjector:
+    """Install a chaos injector process-wide (spec string or injector).
+    Returns the installed injector."""
+    global _INSTALLED
+    inj = spec_or_injector if isinstance(spec_or_injector, ChaosInjector) \
+        else parse_chaos_spec(str(spec_or_injector))
+    _INSTALLED = inj
+    return inj
+
+
+def uninstall_chaos() -> None:
+    global _INSTALLED
+    _INSTALLED = None
+
+
+def installed_chaos() -> Optional[ChaosInjector]:
+    return _INSTALLED
+
+
+def chaos_from_env() -> Optional[ChaosInjector]:
+    """Install from ``MVTPU_CHAOS`` when set (idempotent per call —
+    re-parses, so a changed env var takes effect); None when unset."""
+    spec = os.environ.get(CHAOS_ENV, "")
+    if not spec:
+        return None
+    return install_chaos(spec)
+
+
+def chaos_point(point: str) -> None:
+    """THE fault-point hook instrumented code calls. Free when no
+    injector is installed (one module-global ``is None`` check)."""
+    inj = _INSTALLED
+    if inj is not None:
+        inj.hit(point)

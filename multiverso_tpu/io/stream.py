@@ -32,6 +32,7 @@ import io
 import os
 from typing import BinaryIO, Callable, Dict, Tuple
 
+from multiverso_tpu.ft.chaos import chaos_point
 from multiverso_tpu.telemetry import metrics as telemetry
 
 Stream = BinaryIO
@@ -77,6 +78,18 @@ class _AtomicWriteFile:
     def close(self) -> None:
         if not self._f.closed:
             self._f.close()
+            # fault point for the torn-write window: a 'torn' chaos
+            # rule raises HERE — payload bytes are on disk in the temp
+            # file, the commit rename never happens (exactly what a
+            # crash between write and rename leaves behind)
+            try:
+                chaos_point("io.rename")
+            except BaseException:
+                try:
+                    os.remove(self._tmp)
+                except OSError:
+                    pass
+                raise
             os.replace(self._tmp, self._final)
 
     @property
@@ -207,6 +220,7 @@ class _FsspecAtomicWrite:
         bak = f"{self._final}.bak"
         self._rm_quiet(bak)            # stale .bak from a prior cycle
         try:
+            chaos_point("io.mv.aside")
             self._fs.mv(self._final, bak)
             moved_aside = True
         except Exception:
@@ -215,6 +229,14 @@ class _FsspecAtomicWrite:
             # final-exists check below decide
             moved_aside = False
         try:
+            # THE crash window the overwrite dance exists for: between
+            # the aside move (final -> final.bak) and this replacement
+            # move the only good payload is at .bak. A 'crash' chaos
+            # rule fires here (BaseException — no recovery code runs),
+            # simulating the process dying inside the window; the fuzz
+            # in tests/test_io.py asserts .bak still holds the last
+            # good checkpoint.
+            chaos_point("io.mv.replace")
             self._fs.mv(self._tmp, self._final)
         except Exception:
             restored = False
@@ -289,11 +311,13 @@ class _CountingStream:
         self._counted = False
 
     def read(self, *args):
+        chaos_point("io.read")
         b = self._inner.read(*args)
         self._r += len(b)
         return b
 
     def write(self, b):
+        chaos_point("io.write")
         n = self._inner.write(b)
         self._w += n if isinstance(n, int) else len(b)
         return n
@@ -347,6 +371,8 @@ def open_stream(uri: str, mode: str = "rb") -> Stream:
     Every stream is wrapped for telemetry byte accounting
     (:class:`_CountingStream`)."""
     scheme, path = _split_uri(uri)
+    chaos_point("io.open.write" if ("w" in mode or "a" in mode)
+                else "io.open.read")
     open_fn = _SCHEMES.get(scheme)
     if open_fn is not None:
         return _CountingStream(open_fn(path, mode), scheme)

@@ -18,6 +18,8 @@ def allgather_i64(vals) -> np.ndarray:
     """process_allgather of an int64 vector without x64 truncation.
     Returns [P, n] int64 (single-process: [1, n])."""
     import jax
+    from multiverso_tpu.ft.chaos import chaos_point
+    chaos_point("multihost.allgather")
     v = np.atleast_1d(np.asarray(vals, np.int64))
     if jax.process_count() == 1:
         return v[None]

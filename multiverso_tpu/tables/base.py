@@ -39,6 +39,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from multiverso_tpu import core
+from multiverso_tpu.ft.chaos import chaos_point
 from multiverso_tpu.io import open_stream
 from multiverso_tpu.telemetry import metrics as telemetry
 from multiverso_tpu.telemetry.profiling import profiled_jit
@@ -49,20 +50,47 @@ from multiverso_tpu.utils import configure, log
 CHECKPOINT_MAGIC = "multiverso_tpu.table.v1"
 
 
+def _payload_crc32(arr: np.ndarray) -> int:
+    """CRC32 over an array's raw bytes (C order) — the per-array
+    checksum ``savez_stream`` stamps and ``loadz_stream`` verifies."""
+    import zlib
+    return int(zlib.crc32(np.ascontiguousarray(arr).tobytes()))
+
+
 def savez_stream(uri: str, manifest: Dict[str, Any],
                  payload: Dict[str, np.ndarray]) -> None:
-    """Write an npz (manifest json + arrays) through the stream layer."""
+    """Write an npz (manifest json + arrays) through the stream layer.
+
+    The manifest is stamped with a per-array CRC32 (verified at load:
+    a torn or bit-rotted checkpoint fails LOUDLY instead of silently
+    corrupting a resumed run), and the stream write is guarded by the
+    env-configured IO :class:`~multiverso_tpu.ft.retry.RetryPolicy`
+    (transient faults — including chaos-injected ones — are retried
+    with jittered backoff and ``retry.*`` telemetry)."""
+    from multiverso_tpu.ft.retry import io_retry_policy
+    manifest = dict(manifest)
+    manifest["crc32"] = {k: _payload_crc32(v) for k, v in payload.items()}
     buf = io.BytesIO()
     np.savez(buf, manifest=json.dumps(manifest), **payload)
-    with open_stream(uri, "wb") as stream:
-        stream.write(buf.getvalue())
+    data = buf.getvalue()
+
+    def write() -> None:
+        with open_stream(uri, "wb") as stream:
+            stream.write(data)
+    io_retry_policy("io.store").call(write)
 
 
 def loadz_stream(uri: str, magic: str):
-    """Read an npz through the stream layer; validate its manifest magic.
+    """Read an npz through the stream layer; validate its manifest magic
+    and (when present) the per-array CRC32 checksums.
     Returns (manifest dict, npz data)."""
-    with open_stream(uri, "rb") as stream:
-        data = np.load(io.BytesIO(stream.read()), allow_pickle=False)
+    from multiverso_tpu.ft.retry import io_retry_policy
+
+    def read() -> bytes:
+        with open_stream(uri, "rb") as stream:
+            return stream.read()
+    data = np.load(io.BytesIO(io_retry_policy("io.load").call(read)),
+                   allow_pickle=False)
     try:
         manifest = json.loads(str(data["manifest"]))
     except Exception:
@@ -71,6 +99,20 @@ def loadz_stream(uri: str, magic: str):
     if manifest.get("magic") != magic:
         raise ValueError(f"{uri!r}: checkpoint magic "
                          f"{manifest.get('magic')!r} != expected {magic!r}")
+    # checksum verification: pre-CRC checkpoints (no "crc32" key) load
+    # unverified for back-compat; anything stamped must match
+    for key, want in (manifest.get("crc32") or {}).items():
+        if key not in data:
+            raise ValueError(
+                f"{uri!r}: checkpoint is torn — manifest lists payload "
+                f"{key!r} but the archive lacks it")
+        got = _payload_crc32(data[key])
+        if got != int(want):
+            raise ValueError(
+                f"{uri!r}: payload {key!r} checksum mismatch "
+                f"(crc32 {got:#010x} != manifest {int(want):#010x}) — "
+                "the checkpoint is torn or bit-rotted; use an older "
+                "complete generation")
     return manifest, data
 
 
@@ -262,6 +304,9 @@ class Table:
         self._snapshot = profiled_jit(snapshot,
                                       name=f"table.snapshot.{name}",
                                       out_shardings=replicated)
+        # checkpoint-export copier, built lazily on the first export
+        # (tables that never checkpoint pay nothing)
+        self._export_copy = None
         self.table_id = _register(self)
         log.debug("table %r id=%d shape=%s padded=%s updater=%s", name,
                   self.table_id, self.logical_shape, self.padded_shape,
@@ -385,6 +430,7 @@ class Table:
         Returns a fresh buffer: ``add`` donates the param buffer, so a
         zero-copy view would be invalidated by the next update.
         """
+        chaos_point("table.get")
         elems = int(np.prod(self.logical_shape)) if self.logical_shape \
             else 1
         self._record_op("get", elems, elems * self.dtype.itemsize)
@@ -409,6 +455,7 @@ class Table:
         until the update has been applied, matching the reference's
         blocking Add.
         """
+        chaos_point("table.add")
         if isinstance(delta, jax.Array):
             if delta.shape == self.logical_shape \
                     and self.logical_shape != self.padded_shape:
@@ -466,15 +513,47 @@ class Table:
             "step": self.default_option.step,
         }
 
-    def _export_param(self) -> np.ndarray:
-        """Param as a host array in the PADDED (layout-agnostic) shape —
-        checkpoints interchange across storage layouts."""
-        return np.asarray(self.param).reshape(self.padded_shape)
-
     def _install_param(self, host_padded: np.ndarray) -> None:
         """Place a host array of the padded shape into table storage."""
         self.param = jax.device_put(
             host_padded.reshape(self.storage_shape), self.sharding)
+
+    def export_checkpoint_async(self):
+        """The checkpoint export, split along the thread-safety line
+        (the :class:`~multiverso_tpu.ft.checkpoint.RunCheckpointManager`
+        overlap contract, same split as ``client/cache.py``):
+
+        - the DISPATCH half runs here, on the caller's (table dispatch)
+          thread: flush attached coalescers, then launch one jitted
+          copy of param + state into fresh buffers — the copies survive
+          the next add's donation, and under ``shard_update`` the state
+          gathers to the model-only sharding (per-process addressable),
+        - the returned ``finish()`` closure is the BLOCKING half, safe
+          on a worker thread: D2H waits, payload assembly, accounting.
+
+        ``finish()`` returns ``(manifest, payload)`` ready for
+        :func:`savez_stream`.
+        """
+        # a checkpoint must contain every delta the worker has issued,
+        # including ones still parked in attached coalescing buffers
+        self.flush_coalesced()
+        manifest = self._manifest()
+        if self._export_copy is None:
+            state_sh = jax.tree.map(lambda _: self.sharding, self.state)
+            self._export_copy = jax.jit(
+                lambda p, s: (jnp.copy(p),
+                              jax.tree.map(jnp.copy, s)),
+                out_shardings=(self.sharding, state_sh))
+        param_fut, state_fut = self._export_copy(self.param, self.state)
+
+        def finish():
+            payload = {"param": np.asarray(param_fut)
+                       .reshape(self.padded_shape)}
+            manifest["n_state_leaves"] = pack_state(state_fut, payload)
+            self._record_op("store", payload["param"].size,
+                            sum(a.nbytes for a in payload.values()))
+            return manifest, payload
+        return finish
 
     def store(self, uri: str) -> None:
         """Serialize param + updater state through the stream layer.
@@ -484,23 +563,7 @@ class Table:
         (mem://, per-host local disks) each get a copy; on a shared
         filesystem the identical payloads land via the stream layer's
         atomic rename, so same-path writers never interleave."""
-        # a checkpoint must contain every delta the worker has issued,
-        # including ones still parked in attached coalescing buffers
-        self.flush_coalesced()
-        payload = {"param": self._export_param()}
-        manifest = self._manifest()
-        state = self.state
-        if self.shard_update:
-            # (model, data)-sharded state spans processes on a
-            # multi-host data axis — np.asarray on such a leaf raises.
-            # Gather over the data axis first (jitted identity to the
-            # model-only sharding, per-process addressable), the state
-            # analog of the param snapshot's replicated out-sharding.
-            model_sh = jax.tree.map(lambda _: self.sharding, state)
-            state = jax.jit(lambda s: s, out_shardings=model_sh)(state)
-        manifest["n_state_leaves"] = pack_state(state, payload)
-        self._record_op("store", payload["param"].size,
-                        sum(a.nbytes for a in payload.values()))
+        manifest, payload = self.export_checkpoint_async()()
         savez_stream(uri, manifest, payload)
 
     def load(self, uri: str) -> None:

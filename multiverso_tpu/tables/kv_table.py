@@ -155,6 +155,8 @@ class KVTable:
         # scalars, one per in-flight add; drained non-blocking in add,
         # blocking at every other table op)
         self._build_jits()
+        # checkpoint-export copier, built lazily on the first export
+        self._export_copy = None
         self.table_id = _register(self)  # type: ignore[arg-type]
         log.debug("kv table %r: %d buckets x %d slots (capacity %d)",
                   name, self.num_buckets, self.slots, self.capacity)
@@ -464,28 +466,49 @@ class KVTable:
 
     KV_MAGIC = "multiverso_tpu.kvtable.v1"
 
-    def store(self, uri: str) -> None:
+    def export_checkpoint_async(self):
+        """Checkpoint export split like ``Table.export_checkpoint_async``:
+        dispatch half here (flush, overflow check, jitted copies of the
+        keys/values/state triple — the copies survive the next add's
+        donation), blocking half in the returned ``finish()``."""
         # checkpoint contract: every issued delta lands, including ones
         # parked in attached coalescing buffers
         self.flush_coalesced()
         self._check_overflow()
-        host_keys = np.asarray(self.keys)
-        # lanes fill contiguously (no deletion), so fill = live count
-        fill = (~(host_keys == 0xFFFFFFFF).all(-1)).sum(-1)
-        payload = {"keys": host_keys,
-                   "values": np.asarray(self.values),
-                   "bucket_fill": fill.astype(np.int32)}
+        if self._export_copy is None:
+            state_sh = jax.tree.map(lambda _: self._val_sharding,
+                                    self.state)
+            self._export_copy = jax.jit(
+                lambda k, v, s: (jnp.copy(k), jnp.copy(v),
+                                 jax.tree.map(jnp.copy, s)),
+                out_shardings=(self._key_sharding, self._val_sharding,
+                               state_sh))
+        keys_fut, vals_fut, state_fut = self._export_copy(
+            self.keys, self.values, self.state)
         manifest = {"magic": self.KV_MAGIC, "name": self.name,
                     "capacity": self.capacity, "value_dim": self.value_dim,
                     "slots": self.slots, "num_buckets": self.num_buckets,
                     "dtype": self.dtype.name, "updater": self.updater.name,
-                    "n_state_leaves": pack_state(self.state, payload),
                     "step": self.default_option.step}
+
+        def finish():
+            host_keys = np.asarray(keys_fut)
+            # lanes fill contiguously (no deletion), so fill = live count
+            fill = (~(host_keys == 0xFFFFFFFF).all(-1)).sum(-1)
+            payload = {"keys": host_keys,
+                       "values": np.asarray(vals_fut),
+                       "bucket_fill": fill.astype(np.int32)}
+            manifest["n_state_leaves"] = pack_state(state_fut, payload)
+            self._record_op("store", payload["values"].size,
+                            sum(a.nbytes for a in payload.values()))
+            return manifest, payload
+        return finish
+
+    def store(self, uri: str) -> None:
         # every rank writes (per-process targets need their own copy);
         # shared-path safety comes from the stream layer's atomic rename
         # — same rationale as tables/base.py store
-        self._record_op("store", payload["values"].size,
-                        sum(a.nbytes for a in payload.values()))
+        manifest, payload = self.export_checkpoint_async()()
         savez_stream(uri, manifest, payload)
 
     def load(self, uri: str) -> None:

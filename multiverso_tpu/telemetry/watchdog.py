@@ -251,8 +251,17 @@ class Watchdog:
             except OSError as e:
                 _warn(f"watchdog: trace tail failed: {e!r}")
 
-        # 4. manifest — ties the artifacts to who/when/why
+        # 4. manifest — ties the artifacts to who/when/why, and names
+        # the restart point: the latest good run checkpoint (when the
+        # ft subsystem is loaded — sys.modules lookup, never an import)
         import json
+        latest_ckpt = None
+        ft_ckpt = sys.modules.get("multiverso_tpu.ft.checkpoint")
+        if ft_ckpt is not None:
+            try:
+                latest_ckpt = ft_ckpt.latest_good_checkpoint()
+            except Exception:   # diagnostics must never raise
+                pass
         with open(os.path.join(path, "watchdog.json"), "w") as f:
             json.dump({
                 "kind": DUMP_KIND, "name": self.name,
@@ -261,6 +270,7 @@ class Watchdog:
                 "stalls": self.stalls, "action": self.action,
                 "ts": time.time(), "pid": os.getpid(),
                 "host": _host_index(), "argv": sys.argv,
+                "latest_checkpoint": latest_ckpt,
             }, f, indent=1)
         return path
 

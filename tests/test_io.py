@@ -160,6 +160,108 @@ class TestCheckpointThroughFsspec:
             fsspec.filesystem("memory").store.clear()
 
 
+class TestOverwriteCrashWindow:
+    """Chaos-driven fuzz of the fsspec overwrite dance (the
+    ``final -> final.bak`` aside move + ``tmp -> final`` replacement):
+    kill or fault the writer at every point inside the window and
+    assert the last good checkpoint is ALWAYS recoverable — at
+    ``final`` or ``final.bak``, never lost and never torn. Uses an
+    hdfs-like in-memory filesystem whose ``mv`` refuses to clobber an
+    existing destination (the semantics the dance exists for)."""
+
+    @pytest.fixture
+    def hdfsish(self):
+        fsspec = pytest.importorskip("fsspec")
+        from fsspec.implementations.memory import MemoryFileSystem
+
+        class RefuseOverwriteFS(MemoryFileSystem):
+            protocol = "hdfsish"
+
+            def mv(self, path1, path2, **kwargs):
+                if self.exists(self._strip_protocol(path2)):
+                    raise OSError(f"destination exists: {path2}")
+                return super().mv(path1, path2, **kwargs)
+
+        fsspec.register_implementation("hdfsish", RefuseOverwriteFS,
+                                       clobber=True)
+        fs = fsspec.filesystem("hdfsish")
+        try:
+            yield fs
+        finally:
+            fs.store.clear()     # class-level global store
+            from multiverso_tpu.ft.chaos import uninstall_chaos
+            uninstall_chaos()
+
+    def _write(self, uri, payload):
+        with open_stream(uri, "wb") as s:
+            s.write(payload)
+
+    def _recoverable(self, fs, base):
+        """The payload a resume would find: final first, then .bak."""
+        for p in (base, base + ".bak"):
+            if fs.exists(p):
+                with fs.open(p, "rb") as f:
+                    return f.read()
+        return None
+
+    def test_overwrite_goes_through_bak_window(self, hdfsish):
+        uri = "hdfsish://win/ck.bin"
+        self._write(uri, b"v1")
+        self._write(uri, b"v2")      # refuse-mv forces the dance
+        assert self._recoverable(hdfsish, uri) == b"v2"
+        assert not hdfsish.exists(uri + ".bak")   # cleaned after success
+
+    def test_fuzz_fault_at_every_window_point(self, hdfsish):
+        """Every fault kind at every point in the window: the
+        recoverable payload is always one of the two complete versions
+        — and a subsequent clean overwrite always lands."""
+        from multiverso_tpu.ft.chaos import install_chaos
+        scenarios = [
+            # transient errors: recovery code runs
+            "io.mv.aside:error:times=1",
+            "io.mv.replace:error:times=1",
+            # hard kills (BaseException): NO recovery code runs — this
+            # is the crash-between-the-moves window itself
+            "io.mv.aside:crash:times=1",
+            "io.mv.replace:crash:times=1",
+            "io.write:error:times=1",
+        ]
+        for i, spec in enumerate(scenarios):
+            uri = f"hdfsish://fz{i}/ck.bin"
+            self._write(uri, b"v1")
+            inj = install_chaos(spec)
+            try:
+                self._write(uri, b"v2")
+            except BaseException:
+                pass            # the simulated fault/kill
+            from multiverso_tpu.ft.chaos import uninstall_chaos
+            uninstall_chaos()
+            good = self._recoverable(hdfsish, uri)
+            assert good in (b"v1", b"v2"), \
+                f"{spec}: lost the checkpoint (fired={inj.counts()}, " \
+                f"recoverable={good!r})"
+            # the run is still writable after the fault clears
+            self._write(uri, b"v3")
+            with open_stream(uri, "rb") as s:
+                assert s.read() == b"v3", spec
+
+    def test_crash_in_window_leaves_bak_for_resume(self, hdfsish):
+        """The titled window: killed AFTER final moved aside, BEFORE
+        the replacement landed — final is gone, .bak holds the last
+        good checkpoint (what a post-mortem resume reads)."""
+        from multiverso_tpu.ft.chaos import ChaosCrash, install_chaos
+        uri = "hdfsish://crash/ck.bin"
+        self._write(uri, b"v1")
+        install_chaos("io.mv.replace:crash:times=1")
+        with pytest.raises(ChaosCrash):
+            self._write(uri, b"v2")
+        from multiverso_tpu.ft.chaos import uninstall_chaos
+        uninstall_chaos()
+        assert not hdfsish.exists(uri)            # the window is real
+        with hdfsish.open(uri + ".bak", "rb") as f:
+            assert f.read() == b"v1"              # last good survives
+
+
 class TestAtomicLocalWrite:
     """file:// write mode is temp+rename (multi-process collective
     stores write the same path from every rank; readers must never see

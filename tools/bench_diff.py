@@ -45,7 +45,12 @@ DEFAULT_WATCH = ("value", "e2e_words_per_sec", "lda_doc_tokens_per_sec",
                  # throughputs are the PR's metrics of record
                  "kv_add_ops_per_sec_coalesced",
                  "kv_add_ops_per_sec_staged",
-                 "get_ops_per_sec_cached")
+                 "get_ops_per_sec_cached",
+                 # checkpoint micro-bench (benchmarks/
+                 # checkpoint_bench.py): run-level store throughput —
+                 # a regression here makes every checkpoint cadence
+                 # steal more training wall-clock
+                 "ckpt_store_mb_per_sec")
 
 
 def _flatten(prefix: str, obj, out: Dict[str, float]) -> None:
