@@ -19,6 +19,11 @@
 #   make ckpt-bench - run-level checkpoint store/restore micro-bench
 #                   (tiny sizes on CPU; drop MVTPU_CKPT_BENCH_TINY for
 #                   real sizes; emits checkpoint_bench.json)
+#   make kernel-bench - server-side table-kernel micro-bench, XLA vs
+#                   Pallas engines with a cross-engine parity guard
+#                   (tiny interpret-mode sizes on CPU; drop
+#                   MVTPU_KERNEL_BENCH_TINY for real sizes on TPU;
+#                   emits table_kernels_bench.json)
 #   make chaos    - the chaos lane: fault-injection test subset
 #                   (ft subsystem + overwrite crash-window fuzz) plus a
 #                   CLI checkpoint/resume smoke under an active
@@ -31,7 +36,7 @@ OLD ?= BENCH_r04.json
 NEW ?= BENCH_r05.json
 
 .PHONY: test dryrun bench bench-dryrun bench-diff bench-diff-selftest \
-	client-bench ckpt-bench chaos fuzz lint native ci
+	client-bench ckpt-bench kernel-bench chaos fuzz lint native ci
 
 fuzz:
 	$(PY) tests/deep_fuzz.py
@@ -56,6 +61,9 @@ client-bench:
 
 ckpt-bench:
 	MVTPU_CKPT_BENCH_TINY=1 $(PY) benchmarks/checkpoint_bench.py
+
+kernel-bench:
+	MVTPU_KERNEL_BENCH_TINY=1 $(PY) benchmarks/table_kernels.py
 
 # the chaos lane: recovery paths exercised under injected faults —
 # the ft test subset, the overwrite crash-window fuzz, and an app CLI
@@ -91,4 +99,4 @@ native:
 	$(MAKE) -C native
 
 ci: lint bench-diff-selftest native test dryrun bench-dryrun \
-	client-bench ckpt-bench chaos
+	client-bench ckpt-bench kernel-bench chaos

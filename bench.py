@@ -302,6 +302,47 @@ def _text_tail(data, max_chars: int = 2000) -> str:
     return data[-max_chars:]
 
 
+def _latest_dump_tail(dump_dir: str, max_chars: int = 1200) -> str:
+    """Tail of the NEWEST watchdog dump's thread stacks — the payload
+    the give-up JSON line carries so the driver's `parsed` capture (not
+    just the log tail) names the hanging frame."""
+    entries = _dump_entries(dump_dir)
+    if not entries:
+        return ""
+    path = entries[-1][1]
+    for fname in ("stacks.txt", "watchdog.json"):
+        try:
+            with open(os.path.join(path, fname)) as f:
+                return f"{os.path.basename(path)}/{fname}: " \
+                       f"{f.read()[-max_chars:]}"
+        except OSError:
+            continue
+    return os.path.basename(path)
+
+
+def _probe_give_up(msg: str, *, attempts: int, elapsed_s: float,
+                   deadline_s: float, hang_kills: int, rc_failures: int,
+                   last_failure: str, dump_dir: str) -> None:
+    """Abort the probe with rc=2 — but FIRST emit a partial BENCH JSON
+    line on stdout. The driver records the last complete JSON line; a
+    wedged round previously left `parsed: null` (rc=124 after the whole
+    window burned), while this line carries the probe forensics and the
+    newest post-mortem's stack tail."""
+    print(f"bench: {msg}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "bench_probe_gave_up",
+        "probe_rc": 2,
+        "probe_attempts": attempts,
+        "probe_elapsed_s": round(elapsed_s, 1),
+        "probe_deadline_s": deadline_s,
+        "probe_hang_kills": hang_kills,
+        "probe_rc_failures": rc_failures,
+        "probe_last_failure": last_failure[-400:],
+        "probe_dump_tail": _latest_dump_tail(dump_dir),
+    }), flush=True)
+    raise SystemExit(2)
+
+
 def _beat() -> None:
     """Tier-boundary heartbeat (no-op when the watchdog is disabled)."""
     if _WATCHDOG is not None:
@@ -363,7 +404,15 @@ def _probe_chip(timeout_s: float = 180.0, deadline_s: "float | None" = None,
     (a wedge that survives 3 kill cycles is not clearing this window),
     and every kill ships the child's stderr tail plus any watchdog
     dump artifacts (thread stacks!) to stderr, where the driver's
-    BENCH-json `tail` capture preserves them."""
+    BENCH-json `tail` capture preserves them.
+
+    Every attempt's kill timeout is additionally CAPPED by the
+    remaining OUTER budget (``deadline_s`` minus elapsed): BENCH_r05
+    showed seven 180s probe kills overrunning the 1800s driver window
+    into rc=124 — an attempt may not start a 180s wait it cannot finish
+    inside the window. Every give-up path emits a partial BENCH JSON
+    line (probe forensics + newest dump's stack tail) so the driver's
+    `parsed` capture is never null."""
     import subprocess
     if deadline_s is None:
         raw = os.environ.get("MVTPU_BENCH_PROBE_DEADLINE", "1800")
@@ -383,10 +432,16 @@ def _probe_chip(timeout_s: float = 180.0, deadline_s: "float | None" = None,
         if _WATCHDOG is not None:
             _WATCHDOG.beat()        # each attempt is forward progress
         attempt_t0 = time.time()
+        # cap this attempt's kill timeout by the remaining outer budget
+        # (min 1s so a clamped attempt can still fail fast) — the probe
+        # must never run past deadline_s into the driver's own timeout
+        attempt_timeout = min(timeout_s,
+                              max(1.0, deadline_s
+                                  - (time.monotonic() - t0)))
         try:
             proc = subprocess.run(
-                [sys.executable, "-c", _probe_src(timeout_s)],
-                timeout=timeout_s, capture_output=True, text=True)
+                [sys.executable, "-c", _probe_src(attempt_timeout)],
+                timeout=attempt_timeout, capture_output=True, text=True)
             if proc.returncode == 0:
                 if attempt > 1:
                     print(f"bench: chip recovered on probe {attempt} "
@@ -402,7 +457,7 @@ def _probe_chip(timeout_s: float = 180.0, deadline_s: "float | None" = None,
             if _TELEMETRY is not None:
                 _TELEMETRY.counter("bench.probe.rc_failures").inc()
         except subprocess.TimeoutExpired as e:
-            failure = f"hang, killed after {timeout_s:.0f}s"
+            failure = f"hang, killed after {attempt_timeout:.0f}s"
             hang_kills += 1
             stderr_tail = _text_tail(e.stderr)
             if stderr_tail:
@@ -426,24 +481,27 @@ def _probe_chip(timeout_s: float = 180.0, deadline_s: "float | None" = None,
         # assertion, a persistent plugin error) is usually
         # deterministic — allow a few retries for transient blips
         # during tunnel recovery, then surface it fast too.
+        give_up = dict(attempts=attempt, elapsed_s=elapsed,
+                       deadline_s=deadline_s, hang_kills=hang_kills,
+                       rc_failures=rc_failures, last_failure=failure,
+                       dump_dir=dump_dir)
         if hang_kills >= max_hang_kills:
-            print(f"bench: chip probe hung {hang_kills}x consecutively "
-                  f"({elapsed:.0f}s spent) — tunnel wedged; giving up "
-                  f"early with post-mortems in {dump_dir} instead of "
-                  "burning the rest of the window", file=sys.stderr)
-            raise SystemExit(2)
+            _probe_give_up(
+                f"chip probe hung {hang_kills}x consecutively "
+                f"({elapsed:.0f}s spent) — tunnel wedged; giving up "
+                f"early with post-mortems in {dump_dir} instead of "
+                "burning the rest of the window", **give_up)
         if rc_failures >= max_rc_failures:
-            print(f"bench: chip probe failed {rc_failures}x with a "
-                  f"nonzero exit (not a hang) — deterministic failure, "
-                  f"giving up early (last: {failure})", file=sys.stderr)
-            raise SystemExit(2)
+            _probe_give_up(
+                f"chip probe failed {rc_failures}x with a nonzero exit "
+                f"(not a hang) — deterministic failure, giving up "
+                f"early (last: {failure})", **give_up)
         if elapsed >= deadline_s:
-            print(f"bench: chip probe gave up after {elapsed:.0f}s / "
-                  f"{attempt} attempt(s) (deadline {deadline_s:.0f}s; "
-                  f"last failure: {failure}) — tunnel wedged; exiting "
-                  "fast so the remaining driver window isn't a hang",
-                  file=sys.stderr)
-            raise SystemExit(2)
+            _probe_give_up(
+                f"chip probe gave up after {elapsed:.0f}s / {attempt} "
+                f"attempt(s) (deadline {deadline_s:.0f}s; last "
+                f"failure: {failure}) — tunnel wedged; exiting fast so "
+                "the remaining driver window isn't a hang", **give_up)
         print(f"bench: chip probe {attempt} failed ({failure}); "
               f"retrying in {retry_wait_s:.0f}s "
               f"({elapsed:.0f}s/{deadline_s:.0f}s of the probe window "

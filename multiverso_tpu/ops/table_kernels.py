@@ -1,0 +1,669 @@
+"""Pallas TPU kernel engine for the server-side table hot paths, plus
+the XLA-fallback selection layer (``MVTPU_KERNELS``).
+
+Why (the PR-3 aftermath): with the worker-side client pipeline removing
+coalescing/caching/staging overheads, the hot path is the server-side
+table kernels themselves — and those were plain XLA: the fused KV probe
+materializes full bucket rows via ``jnp.take`` and pays a batch-wide
+stable ``argsort`` per dispatch, and the COO path round-trips whole
+rows through HBM. The kernels here keep the touched rows in VMEM:
+
+- **KV probe+update** (:func:`build_kv_probe_update`): probe, empty-lane
+  claim, updater apply, and scatter fused in ONE kernel. The batch is
+  host-sorted by bucket (``KVTable.prepare_add``), so each bucket's
+  lanes are CONSECUTIVE steps of the sequential TPU grid and the bucket's
+  slot rows stay resident in VMEM across them; the per-bucket empty-lane
+  rank is a run-local claims counter in SMEM — an in-kernel per-bucket
+  scan replacing the XLA path's global ``argsort``. A two-pass grid
+  (pass 0: probe + overflow count into scratch; pass 1: masked writes)
+  preserves the all-or-nothing overflow contract: ANY overflow voids the
+  whole batch on device, bit-identical to the XLA path.
+- **KV lookup** (:func:`build_kv_lookup`): gather bucket rows by
+  scalar-prefetch index map, match + pick in VMEM.
+- **Row gather / row scatter-add / COO scatter-add**
+  (:func:`build_row_gather`, :func:`build_row_scatter_add`,
+  :func:`build_coo_scatter_add`): matrix/sparse-table row paths. Scatter
+  batches are host-sorted by row, so each touched row is fetched once,
+  segment-summed in VMEM across its run of grid steps, and written back
+  to HBM exactly once (duplicate-safe without XLA's sorted-scatter
+  machinery).
+
+Correctness-critical grid semantics the scatter kernels rely on (probed
+empirically in interpret mode, documented Pallas behavior on TPU):
+consecutive grid steps whose index maps return the SAME block index keep
+the block resident (no flush/refetch between them), and with
+``input_output_aliases`` the unvisited rows of the aliased output keep
+their input content. Input blocks always read PRE-batch data (each row's
+input is fetched once, at its run start, before any flush of that row),
+which is exactly what the rank/claims equivalence argument needs.
+
+Selection layer (:func:`select_kernel`): every kernel registers as an
+(xla, pallas) pair behind ``MVTPU_KERNELS``:
+
+- ``auto`` (default): Pallas on an accelerator backend, XLA on CPU
+  (counted in ``kernels.fallbacks{reason=cpu}``) — so tier-1 on CPU
+  exercises the fallback path by default.
+- ``pallas``: force Pallas; on CPU the kernels run under
+  ``interpret=True`` (the ``ops/lda_sampler.py`` test precedent) — so
+  tier-1 also exercises the interpreted kernels.
+- ``xla``: force the existing XLA implementations.
+
+Sharded tables (mesh.size > 1) always fall back to XLA
+(``reason=sharded``): a bare ``pallas_call`` has no SPMD partitioning
+rule, and the cross-chip gather/scatter is XLA's job (use the
+functional forms below inside ``shard_map`` for per-shard kernels). Any
+Pallas failure at lowering/compile time falls back to XLA permanently
+for that kernel (``reason=error``), logged once — correctness over
+speed. Fallbacks are observable: ``kernels.fallbacks`` counter plus the
+per-engine ``profile.calls{fn=...}`` / ``profile.calls{fn=....pallas}``
+dispatch counts (every engine stays under ``profiled_jit``).
+
+Functional forms (:func:`gather_rows`, :func:`row_scatter_add`,
+:func:`coo_scatter_add`) are traceable inside an outer jit — fused
+supersteps pick up the same kernels by calling them from their bodies
+(re-exported by ``tables/superstep.py``).
+
+This module imports NO table classes (it sits below the table layer);
+shared hashing helpers live in ``tables/hashing.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from multiverso_tpu.telemetry import metrics as _metrics
+from multiverso_tpu.updaters import AddOption
+from multiverso_tpu.utils import log
+
+LANES = 128
+
+_MODES = ("auto", "xla", "pallas")
+_WARNED: set = set()
+
+
+def kernel_mode() -> str:
+    """The engine knob, re-read per selection (tests flip it):
+    ``MVTPU_KERNELS=auto|xla|pallas`` (default ``auto``)."""
+    mode = os.environ.get("MVTPU_KERNELS", "auto").strip().lower() or "auto"
+    if mode not in _MODES:
+        if ("mode", mode) not in _WARNED:
+            _WARNED.add(("mode", mode))
+            log.warn("ignoring unknown MVTPU_KERNELS=%r (valid: %s); "
+                     "using 'auto'", mode, "|".join(_MODES))
+        mode = "auto"
+    return mode
+
+
+def interpret_mode() -> bool:
+    """Pallas interpreter mode: on for CPU backends (tests), off on a
+    real accelerator — the ``ops/lda_sampler.py`` precedent."""
+    return jax.default_backend() == "cpu"
+
+
+def _note_fallback(name: str, reason: str,
+                   exc: Optional[BaseException] = None) -> None:
+    """Count (always) + log (once per reason) a pallas→xla fallback."""
+    _metrics.registry().counter("kernels.fallbacks", kernel=name,
+                                reason=reason).inc()
+    if ("fallback", reason) not in _WARNED:
+        _WARNED.add(("fallback", reason))
+        log.warn("kernel engine: %s falling back to XLA (reason=%s%s); "
+                 "further %s fallbacks counted in kernels.fallbacks "
+                 "without this log line", name, reason,
+                 f": {exc!r}" if exc is not None else "", reason)
+
+
+class KernelEngine:
+    """One selected kernel: calls the Pallas engine when active, with a
+    permanent runtime fallback to the XLA engine on any failure. Holders
+    treat it exactly like the jitted callable they held before;
+    ``.engine`` ("xla"|"pallas") is the selection evidence tests and the
+    micro-bench read."""
+
+    def __init__(self, name: str, xla: Callable,
+                 pallas: Optional[Callable] = None) -> None:
+        self.name = name
+        self._xla = xla
+        self._pallas = pallas
+
+    @property
+    def engine(self) -> str:
+        return "pallas" if self._pallas is not None else "xla"
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        if self._pallas is None:
+            return self._xla(*args, **kwargs)
+        try:
+            return self._pallas(*args, **kwargs)
+        except Exception as e:
+            # lowering/compile failures surface here BEFORE execution
+            # (so the donated operands are still alive for the retry);
+            # flip to XLA for good — correctness over metrics
+            self._pallas = None
+            _note_fallback(self.name, "error", e)
+            return self._xla(*args, **kwargs)
+
+    # AOT passthrough, matching _ProfiledJit's debugging surface
+    def lower(self, *args: Any, **kwargs: Any):
+        target = self._pallas if self._pallas is not None else self._xla
+        return target.lower(*args, **kwargs)
+
+
+def select_kernel(name: str, *, xla: Callable,
+                  pallas: Optional[Callable[[], Callable]] = None,
+                  mesh: Any = None) -> KernelEngine:
+    """Register one hot-path kernel behind the engine knob.
+
+    ``xla`` is the already-built (profiled_jit) XLA implementation;
+    ``pallas`` is a zero-arg FACTORY for the Pallas implementation,
+    built only when selected (tables on the default CPU path pay
+    nothing). ``mesh`` (when given) gates selection: sharded meshes
+    keep XLA.
+    """
+    mode = kernel_mode()
+    if mode == "xla" or pallas is None:
+        return KernelEngine(name, xla)
+    if mesh is not None and getattr(mesh, "size", 1) > 1:
+        _note_fallback(name, "sharded")
+        return KernelEngine(name, xla)
+    if mode == "auto" and jax.default_backend() == "cpu":
+        _note_fallback(name, "cpu")
+        return KernelEngine(name, xla)
+    try:
+        built = pallas()
+    except Exception as e:       # a build-time failure is also a fallback
+        _note_fallback(name, "error", e)
+        return KernelEngine(name, xla)
+    return KernelEngine(name, xla, built)
+
+
+# -- KV lookup -------------------------------------------------------------
+
+
+def _kv_lookup_kernel(bkt_ref, keys_ref, vals_ref, q_ref, picked_ref,
+                      found_ref, *, vdim: int):
+    """One lane: match the query against its bucket's slot rows (VMEM)
+    and pick the matched value. Same pick formula as the XLA path
+    (where-sum over matching lanes), so NaN payloads round-trip
+    identically."""
+    row = keys_ref[...]                               # (1, S, 2) uint32
+    q = q_ref[...]                                    # (1, 2)
+    match = (row == q[:, None, :]).all(-1)            # (1, S)
+    found = match.any(axis=1, keepdims=True)          # (1, 1)
+    vals = vals_ref[...]                              # (1, S[, D])
+    m = match if vals.ndim == 2 else match[:, :, None]
+    picked = jnp.where(m, vals, 0).sum(axis=1,
+                                       keepdims=(vdim == 0))
+    picked_ref[...] = picked
+    found_ref[...] = found.astype(jnp.int32)
+
+
+def build_kv_lookup(*, slots: int, value_dim: int, default_value: float,
+                    interpret: bool) -> Callable:
+    """(keys_arr, values_arr, query, buckets) -> (picked, found) —
+    signature-compatible with ``KVTable``'s XLA ``lookup``."""
+    vdim = int(value_dim)
+
+    def lookup(keys_arr, values_arr, query, buckets):
+        b = query.shape[0]
+        vblk = (1, slots, vdim) if vdim else (1, slots)
+        vmap = (lambda i, bkt: (bkt[i], 0, 0)) if vdim \
+            else (lambda i, bkt: (bkt[i], 0))
+        oshape = (b, vdim) if vdim else (b, 1)
+        omap = lambda i, bkt: (i, 0)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b,),
+            in_specs=[
+                pl.BlockSpec((1, slots, 2), lambda i, bkt: (bkt[i], 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec(vblk, vmap, memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 2), omap, memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, oshape[1]), omap,
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1), omap, memory_space=pltpu.VMEM),
+            ],
+        )
+        picked, found = pl.pallas_call(
+            functools.partial(_kv_lookup_kernel, vdim=vdim),
+            grid_spec=grid_spec,
+            out_shape=[jax.ShapeDtypeStruct(oshape, values_arr.dtype),
+                       jax.ShapeDtypeStruct((b, 1), jnp.int32)],
+            interpret=interpret,
+        )(buckets, keys_arr, values_arr, query)
+        found_b = found[:, 0] != 0
+        if vdim == 0:
+            picked = picked[:, 0]
+            fill = found_b
+        else:
+            fill = found_b[:, None]
+        picked = jnp.where(fill, picked,
+                           jnp.asarray(default_value, picked.dtype))
+        return picked, found_b
+
+    return lookup
+
+
+# -- KV fused probe + updater apply + scatter ------------------------------
+
+
+def _kv_probe_kernel(*refs, slots: int, vdim: int, nstate: int,
+                     updater: Any, state_treedef: Any):
+    """Two-pass sequential grid over (pass, lane) — see module doc.
+    Requires the batch sorted by bucket (host prep does it)."""
+    bkt = refs[0]
+    keys_in, vals_in = refs[1], refs[2]
+    state_in = refs[3:3 + nstate]
+    q_ref, d_ref, v_ref, o_ref = refs[3 + nstate:7 + nstate]
+    keys_out, vals_out = refs[7 + nstate], refs[8 + nstate]
+    state_out = refs[9 + nstate:9 + 2 * nstate]
+    nover_ref = refs[9 + 2 * nstate]
+    slot_ref, claims_ref = refs[10 + 2 * nstate], refs[11 + 2 * nstate]
+
+    p = pl.program_id(0)
+    i = pl.program_id(1)
+    new_run = jnp.logical_or(
+        i == 0, bkt[i] != bkt[jnp.maximum(i - 1, 0)])
+
+    @pl.when(jnp.logical_and(p == 0, i == 0))
+    def _():
+        nover_ref[0, 0] = jnp.int32(0)
+
+    @pl.when(new_run)
+    def _():
+        # run start: reset the per-bucket claims scan, and copy the
+        # bucket's rows input→output so (a) pass-0 flushes write back
+        # identical data and (b) pass-1's masked slot writes merge into
+        # the original row (the aliased buffer keeps unvisited rows)
+        claims_ref[0] = jnp.int32(0)
+        keys_out[...] = keys_in[...]
+        vals_out[...] = vals_in[...]
+        for si, so in zip(state_in, state_out):
+            so[...] = si[...]
+
+    row = keys_in[...]                                # (1, S, 2) uint32
+    q = q_ref[...]                                    # (1, 2)
+    match = (row == q[:, None, :]).all(-1)            # (1, S)
+    matched = match.any(axis=1, keepdims=True)        # (1, 1)
+    valid_l = v_ref[...] > 0                          # (1, 1)
+    lane_iota = jax.lax.broadcasted_iota(jnp.int32, (1, slots), 1)
+
+    @pl.when(p == 0)
+    def _():
+        # probe: matching lane, else the (claims+1)-th empty lane of the
+        # ORIGINAL row — the claims counter is the run-local scan that
+        # replaces the XLA path's global argsort rank (equivalent count:
+        # claims == min(rank, n_empty), and both miss past n_empty)
+        empty = (row == jnp.uint32(0xFFFFFFFF)).all(-1)   # (1, S)
+        tri = (jax.lax.broadcasted_iota(jnp.int32, (slots, slots), 0)
+               <= jax.lax.broadcasted_iota(jnp.int32, (slots, slots), 1)
+               ).astype(jnp.float32)
+        ecs = jnp.dot(empty.astype(jnp.float32), tri,
+                      preferred_element_type=jnp.float32)  # incl. cumsum
+        claims = claims_ref[0]
+        hit = empty & (ecs == (claims + 1).astype(jnp.float32))
+        placed = hit.any(axis=1, keepdims=True)
+        new = valid_l & ~matched
+        oh = jnp.where(matched, match, hit) & valid_l      # (1, S)
+        ok = (matched | placed) & valid_l
+        slot = jnp.sum(jnp.where(oh, lane_iota, 0), axis=1,
+                       keepdims=True)
+        slot = jnp.where(ok, slot, jnp.int32(slots))
+        slot_ref[i, 0] = slot[0, 0]
+        claims_ref[0] = claims + (new & placed)[0, 0].astype(jnp.int32)
+        nover_ref[0, 0] = nover_ref[0, 0] \
+            + (new & ~placed)[0, 0].astype(jnp.int32)
+
+    @pl.when(p == 1)
+    def _():
+        # apply: masked one-hot writes; the whole batch drops when ANY
+        # lane overflowed (the table must stay untouched for the raise)
+        slot = slot_ref[i, 0]
+        good = jnp.logical_and(slot < slots, nover_ref[0, 0] == 0)
+        oh = (lane_iota == slot) & good                   # (1, S)
+        keys_out[...] = jnp.where(oh[:, :, None], q[:, None, :],
+                                  keys_out[...])
+        if vdim:
+            ohv = oh[:, :, None]
+            old = jnp.where(ohv, vals_in[...], 0).sum(axis=1)   # (1, D)
+            old_state = [jnp.where(ohv, s[...], 0).sum(axis=1)
+                         for s in state_in]
+        else:
+            old = jnp.where(oh, vals_in[...], 0).sum(axis=1,
+                                                     keepdims=True)
+            old_state = [jnp.where(oh, s[...], 0).sum(axis=1,
+                                                      keepdims=True)
+                         for s in state_in]
+        o = o_ref[...]                                    # (1, 8) f32
+        opt = AddOption(learning_rate=o[0, 0], momentum=o[0, 1],
+                        rho=o[0, 2], lam=o[0, 3], step=o[0, 4])
+        upd, new_state = updater.apply(
+            old, jax.tree.unflatten(state_treedef, old_state),
+            d_ref[...], opt)
+        new_leaves = jax.tree.leaves(new_state)
+        if vdim:
+            vals_out[...] = jnp.where(
+                oh[:, :, None], upd[:, None, :].astype(vals_out.dtype),
+                vals_out[...])
+            for so, ns in zip(state_out, new_leaves):
+                so[...] = jnp.where(oh[:, :, None],
+                                    ns[:, None, :].astype(so.dtype),
+                                    so[...])
+        else:
+            vals_out[...] = jnp.where(oh, upd.astype(vals_out.dtype),
+                                      vals_out[...])
+            for so, ns in zip(state_out, new_leaves):
+                so[...] = jnp.where(oh, ns.astype(so.dtype), so[...])
+
+
+def build_kv_probe_update(*, slots: int, value_dim: int, updater: Any,
+                          state_template: Any,
+                          interpret: bool) -> Callable:
+    """(keys, values, state, buckets, query, deltas, valid, option) ->
+    (keys, values, state, n_over) — signature-compatible with
+    ``KVTable``'s XLA ``probe_update``. Requires the batch host-sorted
+    by bucket (``prepare_add`` guarantees it)."""
+    vdim = int(value_dim)
+    treedef = jax.tree.structure(state_template)
+    nstate = len(jax.tree.leaves(state_template))
+    kern = functools.partial(_kv_probe_kernel, slots=slots, vdim=vdim,
+                             nstate=nstate, updater=updater,
+                             state_treedef=treedef)
+
+    def probe_update(keys_arr, values_arr, state, buckets, query,
+                     deltas, valid, option):
+        b = buckets.shape[0]
+        state_leaves = jax.tree.leaves(state)
+        d2 = deltas.reshape(b, vdim) if vdim else deltas.reshape(b, 1)
+        v2 = valid.astype(jnp.int32).reshape(b, 1)
+        opt = jnp.zeros((1, 8), jnp.float32)
+        opt = opt.at[0, 0].set(option.learning_rate)
+        opt = opt.at[0, 1].set(option.momentum)
+        opt = opt.at[0, 2].set(option.rho)
+        opt = opt.at[0, 3].set(option.lam)
+        opt = opt.at[0, 4].set(option.step.astype(jnp.float32))
+
+        if vdim:
+            vblk = (1, slots, vdim)
+            vmap = lambda p, i, bkt: (bkt[i], 0, 0)
+        else:
+            vblk = (1, slots)
+            vmap = lambda p, i, bkt: (bkt[i], 0)
+        lane = lambda p, i, bkt: (i, 0)
+        const = lambda p, i, bkt: (0, 0)
+        kblk = pl.BlockSpec((1, slots, 2),
+                            lambda p, i, bkt: (bkt[i], 0, 0),
+                            memory_space=pltpu.VMEM)
+        vspec = pl.BlockSpec(vblk, vmap, memory_space=pltpu.VMEM)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(2, b),
+            in_specs=(
+                [kblk, vspec] + [vspec] * nstate
+                + [pl.BlockSpec((1, 2), lane, memory_space=pltpu.VMEM),
+                   pl.BlockSpec((1, d2.shape[1]), lane,
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((1, 1), lane, memory_space=pltpu.VMEM),
+                   pl.BlockSpec((1, 8), const,
+                                memory_space=pltpu.VMEM)]),
+            out_specs=(
+                [kblk, vspec] + [vspec] * nstate
+                + [pl.BlockSpec((1, 1), const,
+                                memory_space=pltpu.VMEM)]),
+            scratch_shapes=[pltpu.VMEM((b, 1), jnp.int32),
+                            pltpu.SMEM((1,), jnp.int32)],
+        )
+        # operands 1..2+nstate (keys, values, state) alias their outputs
+        # in place — one HBM buffer, unvisited rows untouched
+        aliases = {1 + j: j for j in range(2 + nstate)}
+        outs = pl.pallas_call(
+            kern,
+            grid_spec=grid_spec,
+            out_shape=(
+                [jax.ShapeDtypeStruct(keys_arr.shape, keys_arr.dtype),
+                 jax.ShapeDtypeStruct(values_arr.shape,
+                                      values_arr.dtype)]
+                + [jax.ShapeDtypeStruct(s.shape, s.dtype)
+                   for s in state_leaves]
+                + [jax.ShapeDtypeStruct((1, 1), jnp.int32)]),
+            input_output_aliases=aliases,
+            interpret=interpret,
+        )(buckets, keys_arr, values_arr, *state_leaves, query, d2, v2,
+          opt)
+        new_keys, new_vals = outs[0], outs[1]
+        new_state = jax.tree.unflatten(treedef, outs[2:2 + nstate])
+        n_over = outs[2 + nstate][0, 0]
+        return new_keys, new_vals, new_state, n_over
+
+    return probe_update
+
+
+# -- matrix / sparse row paths ---------------------------------------------
+
+
+def _row_block(tiles: int, num_cols: int):
+    """(block shape, gather index map, lane count) for a row of flat
+    ``(R, C)`` or tiled ``(R, C/128, 128)`` storage."""
+    if tiles:
+        return ((1, tiles, LANES),
+                lambda i, ids: (ids[i], 0, 0))
+    return ((1, num_cols), lambda i, ids: (ids[i], 0))
+
+
+def _gather_kernel(ids_ref, p_ref, o_ref):
+    o_ref[...] = p_ref[...].reshape(o_ref.shape)
+
+
+def build_row_gather(*, num_cols: int, tiles: int,
+                     interpret: bool) -> Callable:
+    """(param, ids) -> rows [n, num_cols] — the ``jnp.take`` row gather
+    as a scalar-prefetch-indexed VMEM copy."""
+    blk, imap = _row_block(tiles, num_cols)
+
+    def gather(param, ids):
+        n = ids.shape[0]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n,),
+            in_specs=[pl.BlockSpec(blk, imap, memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((1, num_cols),
+                                   lambda i, ids: (i, 0),
+                                   memory_space=pltpu.VMEM),
+        )
+        return pl.pallas_call(
+            _gather_kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((n, num_cols), param.dtype),
+            interpret=interpret,
+        )(ids, param)
+
+    return gather
+
+
+def _row_scatter_kernel(ids_ref, p_ref, d_ref, o_ref):
+    i = pl.program_id(0)
+    first = jnp.logical_or(
+        i == 0, ids_ref[i] != ids_ref[jnp.maximum(i - 1, 0)])
+
+    @pl.when(first)
+    def _():
+        o_ref[...] = p_ref[...]
+    o_ref[...] = o_ref[...] + d_ref[...].reshape(o_ref.shape).astype(
+        o_ref.dtype)
+
+
+def build_row_scatter_add(*, num_cols: int, tiles: int,
+                          interpret: bool) -> Callable:
+    """(param, ids, deltas) -> param — duplicate-safe row scatter-add.
+    Requires ``ids`` sorted (host prep); each touched row is fetched
+    once, its duplicates segment-summed in the resident VMEM block, and
+    written back to HBM once."""
+    blk, imap = _row_block(tiles, num_cols)
+
+    def scatter_add(param, ids, deltas):
+        n = ids.shape[0]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n,),
+            in_specs=[pl.BlockSpec(blk, imap, memory_space=pltpu.VMEM),
+                      pl.BlockSpec((1, num_cols),
+                                   lambda i, ids: (i, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(blk, imap, memory_space=pltpu.VMEM),
+        )
+        return pl.pallas_call(
+            _row_scatter_kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(param.shape, param.dtype),
+            input_output_aliases={1: 0},
+            interpret=interpret,
+        )(ids, param, deltas)
+
+    return scatter_add
+
+
+def _coo_kernel(rows_ref, p_ref, c_ref, v_ref, o_ref, *, tiles: int,
+                num_cols: int):
+    i = pl.program_id(0)
+    first = jnp.logical_or(
+        i == 0, rows_ref[i] != rows_ref[jnp.maximum(i - 1, 0)])
+
+    @pl.when(first)
+    def _():
+        o_ref[...] = p_ref[...]
+    col = c_ref[0, 0]
+    if tiles:
+        kc = jax.lax.broadcasted_iota(jnp.int32, (1, tiles, LANES), 1)
+        kl = jax.lax.broadcasted_iota(jnp.int32, (1, tiles, LANES), 2)
+        oh = (kc * LANES + kl) == col
+    else:
+        oh = jax.lax.broadcasted_iota(jnp.int32, (1, num_cols), 1) == col
+    o_ref[...] = o_ref[...] + jnp.where(
+        oh, v_ref[0, 0].astype(o_ref.dtype), 0)
+
+
+def build_coo_scatter_add(*, num_cols: int, tiles: int,
+                          interpret: bool) -> Callable:
+    """(param, rows, cols, vals) -> param — the COO sparse Add.
+    Requires ``rows`` sorted (host prep): one VMEM-resident run per
+    touched row, one HBM write per touched row."""
+    blk, imap = _row_block(tiles, num_cols)
+    kern = functools.partial(_coo_kernel, tiles=tiles,
+                             num_cols=num_cols)
+
+    def coo(param, rows, cols, vals):
+        n = rows.shape[0]
+        lane = lambda i, ids: (i, 0)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n,),
+            in_specs=[pl.BlockSpec(blk, imap, memory_space=pltpu.VMEM),
+                      pl.BlockSpec((1, 1), lane,
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((1, 1), lane,
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(blk, imap, memory_space=pltpu.VMEM),
+        )
+        return pl.pallas_call(
+            kern,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(param.shape, param.dtype),
+            input_output_aliases={1: 0},
+            interpret=interpret,
+        )(rows, param, cols.reshape(n, 1), vals.reshape(n, 1))
+
+    return coo
+
+
+# -- functional forms for superstep bodies ---------------------------------
+#
+# Traceable inside an outer jit (a bare pallas_call is a first-class
+# primitive): fused supersteps use the SAME gather/scatter engine by
+# calling these from their bodies. Engine choice is made at trace time
+# from MVTPU_KERNELS + backend; there is no runtime fallback inside a
+# trace, so `auto` only picks Pallas off-CPU. Scatter inputs are sorted
+# in-trace (a batch-sized argsort — still far smaller than the XLA
+# scatter's full sorted-segment machinery over table rows).
+
+
+def _functional_pallas() -> bool:
+    mode = kernel_mode()
+    if mode == "xla":
+        return False
+    if mode == "pallas":
+        return True
+    return jax.default_backend() != "cpu"
+
+
+def _layout(param) -> tuple:
+    """(num_cols, tiles) from a flat (R, C) or tiled (R, C/128, 128)
+    param array."""
+    if param.ndim == 3:
+        return param.shape[1] * param.shape[2], param.shape[1]
+    return param.shape[1], 0
+
+
+@functools.lru_cache(maxsize=64)
+def _cached(builder: Callable, num_cols: int, tiles: int,
+            interpret: bool) -> Callable:
+    return builder(num_cols=num_cols, tiles=tiles, interpret=interpret)
+
+
+def gather_rows(param, ids):
+    """Row gather ``param[ids]`` → ``[n, num_cols]`` through the
+    selected engine (superstep-body form)."""
+    num_cols, tiles = _layout(param)
+    if not _functional_pallas():
+        rows = jnp.take(param, ids, axis=0)
+        return rows.reshape(ids.shape[0], num_cols)
+    fn = _cached(build_row_gather, num_cols, tiles, interpret_mode())
+    return fn(param, ids.astype(jnp.int32))
+
+
+def row_scatter_add(param, ids, deltas):
+    """Duplicate-safe ``param.at[ids].add(deltas)`` through the selected
+    engine (superstep-body form; sorts in-trace)."""
+    num_cols, tiles = _layout(param)
+    if not _functional_pallas():
+        d = deltas.reshape((ids.shape[0],) + param.shape[1:])
+        return param.at[ids].add(d.astype(param.dtype))
+    order = jnp.argsort(ids, stable=True)
+    fn = _cached(build_row_scatter_add, num_cols, tiles,
+                 interpret_mode())
+    return fn(param, jnp.take(ids, order).astype(jnp.int32),
+              jnp.take(deltas.reshape(ids.shape[0], num_cols), order,
+                       axis=0))
+
+
+def coo_scatter_add(param, rows, cols, vals):
+    """COO ``param[rows[i], cols[i]] += vals[i]`` through the selected
+    engine (superstep-body form; sorts in-trace)."""
+    num_cols, tiles = _layout(param)
+    if not _functional_pallas():
+        if tiles:
+            return param.at[rows, cols // LANES, cols % LANES].add(
+                vals.astype(param.dtype))
+        return param.at[rows, cols].add(vals.astype(param.dtype))
+    order = jnp.argsort(rows, stable=True)
+    fn = _cached(build_coo_scatter_add, num_cols, tiles,
+                 interpret_mode())
+    return fn(param, jnp.take(rows, order).astype(jnp.int32),
+              jnp.take(cols, order).astype(jnp.int32),
+              jnp.take(vals, order))
+
+
+__all__ = [
+    "KernelEngine", "build_coo_scatter_add", "build_kv_lookup",
+    "build_kv_probe_update", "build_row_gather", "build_row_scatter_add",
+    "coo_scatter_add", "gather_rows", "interpret_mode", "kernel_mode",
+    "row_scatter_add", "select_kernel",
+]

@@ -1,0 +1,47 @@
+"""Shared hashing / batch-shaping helpers for the table layer AND the
+kernel engine.
+
+Hoisted out of ``matrix_table.py`` / ``kv_table.py`` so that
+``multiverso_tpu/ops/table_kernels.py`` (the Pallas kernel engine) can
+use the same key→bucket mix and power-of-two batch bucketing WITHOUT
+importing table classes (ops must stay importable with zero table-layer
+dependencies — kernels are below tables in the layering). The old
+locations re-export these names for back-compat.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: reserved sentinel: a key value that can never be inserted (its split
+#: uint32 planes equal the empty-slot marker).
+EMPTY_KEY = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def _bucket(n: int) -> int:
+    """Round up to the next power of two (min 8) to bound recompiles."""
+    b = 8
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _hash_u64(keys: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer — stable key→bucket mix (host + device safe)."""
+    x = keys.astype(np.uint64)
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
+def _split_keys(keys: np.ndarray) -> np.ndarray:
+    """(n,) uint64 → (n, 2) uint32 [hi, lo] for device storage."""
+    return np.stack([(keys >> np.uint64(32)).astype(np.uint32),
+                     (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)],
+                    axis=1)
+
+
+def _join_keys(split: np.ndarray) -> np.ndarray:
+    """(..., 2) uint32 [hi, lo] → (...,) uint64."""
+    return (split[..., 0].astype(np.uint64) << np.uint64(32)) \
+        | split[..., 1].astype(np.uint64)

@@ -40,37 +40,19 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from multiverso_tpu import core
+from multiverso_tpu.ops import table_kernels as tk
 from multiverso_tpu.tables.base import (Handle, Table, _register,
                                         loadz_stream, pack_state,
                                         savez_stream, unpack_state)
-from multiverso_tpu.tables.matrix_table import _bucket
+# hashing helpers live in tables/hashing.py (shared with the kernel
+# engine); re-imported here so historical `from kv_table import ...`
+# call sites keep working
+from multiverso_tpu.tables.hashing import (EMPTY_KEY, _bucket, _hash_u64,
+                                           _join_keys, _split_keys)
 from multiverso_tpu.telemetry.profiling import profiled_jit
 from multiverso_tpu.updaters import (AddOption, get_updater,
                                      resolve_default_option)
 from multiverso_tpu.utils import configure, log
-
-EMPTY_KEY = np.uint64(0xFFFFFFFFFFFFFFFF)
-
-
-def _split_keys(keys: np.ndarray) -> np.ndarray:
-    """(n,) uint64 → (n, 2) uint32 [hi, lo] for device storage."""
-    return np.stack([(keys >> np.uint64(32)).astype(np.uint32),
-                     (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)],
-                    axis=1)
-
-
-def _join_keys(split: np.ndarray) -> np.ndarray:
-    """(..., 2) uint32 [hi, lo] → (...,) uint64."""
-    return (split[..., 0].astype(np.uint64) << np.uint64(32)) \
-        | split[..., 1].astype(np.uint64)
-
-
-def _hash_u64(keys: np.ndarray) -> np.ndarray:
-    """splitmix64 finalizer — stable key→bucket mix (host + device safe)."""
-    x = keys.astype(np.uint64)
-    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
-    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
-    return x ^ (x >> np.uint64(31))
 
 
 @dataclasses.dataclass
@@ -248,15 +230,43 @@ class KVTable:
 
         # profiled: profile.calls{fn=kv.lookup/kv.apply.<name>} are the
         # Get/Add dispatch counts the client pipeline's coalescing and
-        # caching claims are asserted against
-        self._lookup = profiled_jit(
-            lookup, name=f"kv.lookup.{self.name}",
-            out_shardings=(replicated, replicated))
-        self._probe_update = profiled_jit(
-            probe_update, name=f"kv.apply.{self.name}",
-            donate_argnums=(0, 1, 2),
-            out_shardings=(self._key_sharding, self._val_sharding,
-                           state_sh, scalar_sh))
+        # caching claims are asserted against. Both paths register
+        # behind the kernel engine (MVTPU_KERNELS): the XLA closures
+        # above stay the fallback, the Pallas engine (same signatures,
+        # bit-equal results — tests/test_table_kernels.py) keeps each
+        # bucket's slot rows in VMEM and replaces the batch-wide argsort
+        # with the in-kernel per-bucket scan. The Pallas engine's
+        # dispatches land on profile.calls{fn=....pallas}.
+        self._lookup = tk.select_kernel(
+            f"kv.lookup.{self.name}",
+            xla=profiled_jit(
+                lookup, name=f"kv.lookup.{self.name}",
+                out_shardings=(replicated, replicated)),
+            pallas=lambda: profiled_jit(
+                tk.build_kv_lookup(
+                    slots=self.slots, value_dim=self.value_dim,
+                    default_value=self.default_value,
+                    interpret=tk.interpret_mode()),
+                name=f"kv.lookup.{self.name}.pallas",
+                out_shardings=(replicated, replicated)),
+            mesh=self.mesh)
+        self._probe_update = tk.select_kernel(
+            f"kv.apply.{self.name}",
+            xla=profiled_jit(
+                probe_update, name=f"kv.apply.{self.name}",
+                donate_argnums=(0, 1, 2),
+                out_shardings=(self._key_sharding, self._val_sharding,
+                               state_sh, scalar_sh)),
+            pallas=lambda: profiled_jit(
+                tk.build_kv_probe_update(
+                    slots=self.slots, value_dim=self.value_dim,
+                    updater=self.updater, state_template=self.state,
+                    interpret=tk.interpret_mode()),
+                name=f"kv.apply.{self.name}.pallas",
+                donate_argnums=(0, 1, 2),
+                out_shardings=(self._key_sharding, self._val_sharding,
+                               state_sh, scalar_sh)),
+            mesh=self.mesh)
         self._count_live = count_live
 
     def _buckets_of(self, keys: np.ndarray) -> np.ndarray:
@@ -379,7 +389,14 @@ class KVTable:
         The batch is PADDED to a power-of-two length (masked lanes carry
         the EMPTY sentinel and drop on device), so variable-size adds
         share a bounded set of compiled signatures — without it every
-        distinct length recompiles the fused probe program."""
+        distinct length recompiles the fused probe program.
+
+        Lanes are stable-SORTED by bucket: the Pallas probe engine needs
+        same-bucket lanes on consecutive grid steps (its per-bucket scan
+        replaces the XLA path's global argsort), and the XLA path is
+        lane-order-insensitive (its rank tie-break is batch order, which
+        a stable sort preserves within each bucket) — so the final table
+        state is identical either way."""
         keys = self._check_keys(keys)
         uniq = np.unique(keys)
         if len(uniq) != len(keys):
@@ -389,11 +406,18 @@ class KVTable:
         want = (n, self.value_dim) if self.value_dim else (n,)
         if deltas.shape != want:
             raise ValueError(f"deltas shape {deltas.shape} != {want}")
+        lane_buckets = self._buckets_of(keys)
+        order = np.argsort(lane_buckets, kind="stable")
+        keys = keys[order]
+        deltas = deltas[order]
+        lane_buckets = lane_buckets[order]
         b = _bucket(n)
         query = np.full((b, 2), 0xFFFFFFFF, np.uint32)
         query[:n] = _split_keys(keys)
-        buckets = np.zeros(b, np.int32)
-        buckets[:n] = self._buckets_of(keys)
+        # padding lanes park on the LAST bucket so the sorted-by-bucket
+        # invariant holds across them (they never write — valid=False)
+        buckets = np.full(b, self.num_buckets - 1, np.int32)
+        buckets[:n] = lane_buckets
         pdeltas = np.zeros((b,) + deltas.shape[1:], deltas.dtype)
         pdeltas[:n] = deltas
         valid = np.zeros(b, bool)

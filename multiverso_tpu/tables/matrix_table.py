@@ -31,17 +31,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from multiverso_tpu.ops import table_kernels as tk
 from multiverso_tpu.tables.base import Handle, Table
+# _bucket lives in tables/hashing.py now (shared with the kernel
+# engine); re-imported here for historical import sites
+from multiverso_tpu.tables.hashing import _bucket
 from multiverso_tpu.telemetry.profiling import profiled_jit
 from multiverso_tpu.updaters import AddOption
-
-
-def _bucket(n: int) -> int:
-    """Round up to the next power of two (min 8) to bound recompiles."""
-    b = 8
-    while b < n:
-        b <<= 1
-    return b
 
 
 @dataclasses.dataclass
@@ -115,20 +111,50 @@ class MatrixTable(Table):
 
         # profiled: profile.calls{fn=table.{gather,scatter_add,
         # apply_rows}.<name>} count the row-path dispatches the client
-        # pipeline's row coalescing / caching are measured against
-        self._gather_rows = profiled_jit(
-            gather_rows, name=f"table.gather.{self.name}",
-            out_shardings=replicated)
-        self._scatter_add = profiled_jit(
-            scatter_add, name=f"table.scatter_add.{self.name}",
-            donate_argnums=(0,))
+        # pipeline's row coalescing / caching are measured against.
+        # Gather and scatter-add register behind the kernel engine
+        # (MVTPU_KERNELS) with the XLA closures above as fallback;
+        # apply_rows (stateful row updates) stays XLA-only.
+        self._gather_rows = tk.select_kernel(
+            f"table.gather.{self.name}",
+            xla=profiled_jit(
+                gather_rows, name=f"table.gather.{self.name}",
+                out_shardings=replicated),
+            pallas=lambda: profiled_jit(
+                tk.build_row_gather(num_cols=self.num_cols, tiles=0,
+                                    interpret=tk.interpret_mode()),
+                name=f"table.gather.{self.name}.pallas",
+                out_shardings=replicated),
+            mesh=self.mesh)
+        self._scatter_add = tk.select_kernel(
+            f"table.scatter_add.{self.name}",
+            xla=profiled_jit(
+                scatter_add, name=f"table.scatter_add.{self.name}",
+                donate_argnums=(0,)),
+            pallas=lambda: profiled_jit(
+                tk.build_row_scatter_add(num_cols=self.num_cols, tiles=0,
+                                         interpret=tk.interpret_mode()),
+                name=f"table.scatter_add.{self.name}.pallas",
+                donate_argnums=(0,)),
+            mesh=self.mesh)
         self._gather_apply_scatter = profiled_jit(
             gather_apply_scatter, name=f"table.apply_rows.{self.name}",
             donate_argnums=(0, 1),
             out_shardings=(self.sharding, state_sh))
 
     def _pad_ids(self, ids: np.ndarray,
-                 deltas: Optional[np.ndarray] = None):
+                 deltas: Optional[np.ndarray] = None, *,
+                 sort: bool = False):
+        # scatter paths stable-sort by row id: the Pallas scatter engine
+        # segment-sums each touched row's run in VMEM (requires sorted
+        # ids), XLA's duplicate-combining scatter is order-insensitive,
+        # and the scratch-row padding (the max row id) keeps the array
+        # sorted. Gathers must NOT sort — output order is request order.
+        if sort and len(ids) > 1:
+            order = np.argsort(ids, kind="stable")
+            ids = ids[order]
+            if deltas is not None:
+                deltas = deltas[order]
         n = len(ids)
         b = _bucket(n)
         out_ids = np.full(b, self._scratch_row, dtype=np.int32)
@@ -178,11 +204,11 @@ class MatrixTable(Table):
         self._record_op("add", deltas.size,
                         deltas.size * self.dtype.itemsize)
         if self.updater.name == "default":
-            padded, _, _, pd = self._pad_ids(ids, deltas)
+            padded, _, _, pd = self._pad_ids(ids, deltas, sort=True)
             self.param = self._scatter_add(self.param, padded, pd)
         elif self.updater.name == "sgd":
             # stateless: scatter-add of -lr*delta, duplicate-safe
-            padded, _, _, pd = self._pad_ids(ids, deltas)
+            padded, _, _, pd = self._pad_ids(ids, deltas, sort=True)
             lr = float(option.learning_rate if option is not None
                        else self.default_option.learning_rate)
             self.param = self._scatter_add(self.param, padded, -lr * pd)

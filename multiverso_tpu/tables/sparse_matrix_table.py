@@ -41,8 +41,10 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from multiverso_tpu import core
+from multiverso_tpu.ops import table_kernels as tk
 from multiverso_tpu.tables.base import Handle
-from multiverso_tpu.tables.matrix_table import MatrixTable, _bucket
+from multiverso_tpu.tables.hashing import _bucket
+from multiverso_tpu.tables.matrix_table import MatrixTable
 from multiverso_tpu.telemetry.profiling import profiled_jit
 from multiverso_tpu.updaters import AddOption
 
@@ -115,12 +117,31 @@ class SparseMatrixTable(MatrixTable):
             d3 = deltas.reshape(ids.shape[0], c, LANES)
             return param.at[ids].add(d3.astype(param.dtype))
 
-        self._gather_rows = profiled_jit(
-            gather_rows, name=f"table.gather.{self.name}",
-            out_shardings=replicated)
-        self._scatter_add = profiled_jit(
-            scatter_add, name=f"table.scatter_add.{self.name}",
-            donate_argnums=(0,))
+        # tiled layouts re-register behind the kernel engine with
+        # tiles=c (one logical row = one (8,128) tile — the layout the
+        # Pallas row kernels want)
+        self._gather_rows = tk.select_kernel(
+            f"table.gather.{self.name}",
+            xla=profiled_jit(
+                gather_rows, name=f"table.gather.{self.name}",
+                out_shardings=replicated),
+            pallas=lambda: profiled_jit(
+                tk.build_row_gather(num_cols=n_cols, tiles=c,
+                                    interpret=tk.interpret_mode()),
+                name=f"table.gather.{self.name}.pallas",
+                out_shardings=replicated),
+            mesh=self.mesh)
+        self._scatter_add = tk.select_kernel(
+            f"table.scatter_add.{self.name}",
+            xla=profiled_jit(
+                scatter_add, name=f"table.scatter_add.{self.name}",
+                donate_argnums=(0,)),
+            pallas=lambda: profiled_jit(
+                tk.build_row_scatter_add(num_cols=n_cols, tiles=c,
+                                         interpret=tk.interpret_mode()),
+                name=f"table.scatter_add.{self.name}.pallas",
+                donate_argnums=(0,)),
+            mesh=self.mesh)
         # _gather_apply_scatter is unreachable: stateless updaters only
 
     # -- jitted sparse kernels --------------------------------------------
@@ -135,10 +156,23 @@ class SparseMatrixTable(MatrixTable):
                 return param.at[rows, cols].add(vals.astype(param.dtype))
 
         # profiled: the COO Add dispatch count (client coalescing of
-        # sparse adds is asserted against profile.calls on this name)
-        self._coo_scatter_add = profiled_jit(
-            coo_scatter_add, name=f"table.coo_scatter_add.{self.name}",
-            donate_argnums=(0,))
+        # sparse adds is asserted against profile.calls on this name).
+        # Registered behind the kernel engine: the Pallas COO kernel
+        # segment-sums each touched row's entries in VMEM and writes the
+        # row back to HBM once (requires add_sparse's row sort).
+        self._coo_scatter_add = tk.select_kernel(
+            f"table.coo_scatter_add.{self.name}",
+            xla=profiled_jit(
+                coo_scatter_add,
+                name=f"table.coo_scatter_add.{self.name}",
+                donate_argnums=(0,)),
+            pallas=lambda: profiled_jit(
+                tk.build_coo_scatter_add(
+                    num_cols=self.num_cols, tiles=self.tiles,
+                    interpret=tk.interpret_mode()),
+                name=f"table.coo_scatter_add.{self.name}.pallas",
+                donate_argnums=(0,)),
+            mesh=self.mesh)
 
         replicated = NamedSharding(self.mesh, P(None))
         n_cols = self.num_cols
@@ -200,6 +234,13 @@ class SparseMatrixTable(MatrixTable):
 
         n = len(rows)
         self._record_op("add", n, n * self.dtype.itemsize)
+        # stable row sort: the Pallas COO engine segment-sums each row's
+        # run in VMEM (requires sorted rows; same-(row,col) duplicates
+        # keep their input order, so float accumulation order matches
+        # the XLA scatter on the same sorted batch), and the scratch-row
+        # padding (the max row id) keeps the array sorted
+        order = np.argsort(rows, kind="stable")
+        rows, cols, values = rows[order], cols[order], values[order]
         b = _bucket(n)
         prows = np.full(b, self._scratch_row, dtype=np.int32)
         pcols = np.zeros(b, dtype=np.int32)

@@ -38,6 +38,14 @@ state is the empty pytree). Bodies that apply updater math should call
 ``table.updater.apply(param, state, delta, option)`` — the same pure
 function ``add`` uses, so the fused path and the plain path share
 semantics.
+
+Kernel engine: bodies that gather/scatter table rows should use the
+re-exported :func:`gather_rows` / :func:`row_scatter_add` /
+:func:`coo_scatter_add` (from ``ops/table_kernels.py``) instead of raw
+``jnp.take`` / ``.at[].add`` — they are traceable inside the fused jit
+and route through the same ``MVTPU_KERNELS``-selected Pallas/XLA engine
+as the plain table Get/Add paths, so a fused superstep picks up the
+kernel engine with no other change.
 """
 
 from __future__ import annotations
@@ -46,9 +54,17 @@ from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
 
+# re-exported for superstep bodies (see module docstring): the
+# engine-selected, trace-safe gather/scatter kernels
+from multiverso_tpu.ops.table_kernels import (coo_scatter_add,
+                                              gather_rows,
+                                              row_scatter_add)
 from multiverso_tpu.tables.base import Handle, Table
 from multiverso_tpu.telemetry.profiling import profiled_jit
 from multiverso_tpu.updaters import AddOption
+
+__all__ = ["FusedSuperstep", "coo_scatter_add", "gather_rows",
+           "make_superstep", "row_scatter_add"]
 
 
 class FusedSuperstep:

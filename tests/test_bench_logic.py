@@ -337,6 +337,70 @@ def test_probe_chip_rc_failure_resets_hang_streak(bench_mod, monkeypatch):
     assert calls["n"] == 15
 
 
+def test_probe_attempt_timeout_capped_by_outer_budget(bench_mod,
+                                                      monkeypatch):
+    """BENCH_r05: seven 180s hang-kills overran the 1800s driver window
+    into rc=124. Each attempt's kill timeout must be capped by the
+    REMAINING outer budget, so the probe never runs past deadline_s."""
+    import subprocess
+    bench, _ = bench_mod
+    timeouts = []
+    clock = {"now": 0.0}
+
+    def fake_monotonic():
+        return clock["now"]
+
+    def hang(*a, **k):
+        timeouts.append(k["timeout"])
+        clock["now"] += k["timeout"]       # the attempt burns its timeout
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=k["timeout"])
+
+    monkeypatch.setattr(bench.time, "monotonic", fake_monotonic)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setattr("subprocess.run", hang)
+    with pytest.raises(SystemExit) as e:
+        bench._probe_chip(timeout_s=180.0, deadline_s=400.0,
+                          retry_wait_s=0.0, max_hang_kills=99)
+    assert e.value.code == 2
+    # attempt 3 gets only the 40s left of the window, never 180
+    assert timeouts == [180.0, 180.0, 40.0]
+    assert clock["now"] <= 400.0
+
+
+def test_probe_give_up_emits_partial_bench_json(bench_mod, monkeypatch,
+                                                tmp_path, capsys):
+    """Every give-up path prints a partial BENCH JSON line on STDOUT
+    (the driver records the last complete JSON line — `parsed` must
+    never be null again) carrying probe forensics + the newest watchdog
+    dump's stack tail."""
+    import subprocess
+    bench, _ = bench_mod
+    dump = tmp_path / "dump-probe-h0-p9-1"
+    dump.mkdir()
+    (dump / "stacks.txt").write_text(
+        'File "jax/_src/xla_bridge.py", line 1, in backends')
+    monkeypatch.setenv("MVTPU_DUMP_DIR", str(tmp_path))
+
+    def hang(*a, **k):
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=k["timeout"])
+
+    monkeypatch.setattr("subprocess.run", hang)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    with pytest.raises(SystemExit) as e:
+        bench._probe_chip(timeout_s=1.0, deadline_s=3600.0,
+                          retry_wait_s=1.0, max_hang_kills=3)
+    assert e.value.code == 2
+    out_lines = [ln for ln in capsys.readouterr().out.splitlines()
+                 if ln.strip()]
+    line = json.loads(out_lines[-1])
+    assert line["metric"] == "bench_probe_gave_up"
+    assert line["probe_rc"] == 2
+    assert line["probe_hang_kills"] == 3
+    assert line["probe_attempts"] == 3
+    assert "xla_bridge" in line["probe_dump_tail"]
+    assert "hang" in line["probe_last_failure"]
+
+
 def test_probe_child_arms_standalone_watchdog(bench_mod):
     """The probe child's source must arm the file-path-loaded watchdog
     BEFORE `import jax` — the half-timeout deadline is what turns a

@@ -1,0 +1,320 @@
+"""Kernel engine (multiverso_tpu/ops/table_kernels.py): Pallas-vs-XLA
+parity fuzz plus the MVTPU_KERNELS selection/fallback contract.
+
+The Pallas kernels run INTERPRETED on the CPU test rig (the
+ops/lda_sampler.py precedent) and must be BIT-EQUAL to the XLA path —
+randomized keys, cross-batch duplicates, padding lanes, and bucket
+overflow all compared on the final table triple, not just happy-path
+lookups. Selection/fallback is asserted through the telemetry spine:
+``kernels.fallbacks{reason=...}`` counters and the per-engine
+``profile.calls{fn=...}`` dispatch counts.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from multiverso_tpu import core, telemetry
+from multiverso_tpu.ops import table_kernels as tk
+from multiverso_tpu.tables import (KVTable, MatrixTable,
+                                   SparseMatrixTable, make_superstep)
+
+
+@pytest.fixture()
+def mesh1(devices):
+    """Single-device mesh: the shape the Pallas engine selects on (a
+    bare pallas_call has no SPMD partitioning rule — sharded meshes
+    keep XLA)."""
+    m = core.init(devices=devices[:1], data_parallel=1, model_parallel=1)
+    yield m
+    core.shutdown()
+
+
+def _engine_pair(monkeypatch, build):
+    """The same table under each engine: (xla_table, pallas_table)."""
+    monkeypatch.setenv("MVTPU_KERNELS", "xla")
+    tx = build("xla")
+    monkeypatch.setenv("MVTPU_KERNELS", "pallas")
+    tp = build("pallas")
+    return tx, tp
+
+
+def _assert_kv_equal(tx, tp, where=""):
+    assert np.array_equal(np.asarray(tx.keys), np.asarray(tp.keys)), \
+        f"keys diverged {where}"
+    assert np.array_equal(np.asarray(tx.values), np.asarray(tp.values)), \
+        f"values diverged {where}"
+    for lx, lp in zip(jax.tree.leaves(tx.state),
+                      jax.tree.leaves(tp.state)):
+        assert np.array_equal(np.asarray(lx), np.asarray(lp)), \
+            f"updater state diverged {where}"
+
+
+class TestKVParity:
+    @pytest.mark.parametrize("updater,value_dim", [
+        ("default", 0), ("sgd", 3), ("adagrad", 3), ("adam", 0),
+    ])
+    def test_probe_update_and_lookup_fuzz(self, mesh1, monkeypatch,
+                                          updater, value_dim):
+        """Randomized add/lookup stream: cross-batch duplicate keys
+        (re-probe the matched slot), non-pow2 batch lengths (padding
+        lanes), missing-key gets — final triple bit-equal."""
+        rng = np.random.default_rng(hash((updater, value_dim)) % 2**32)
+        tx, tp = _engine_pair(monkeypatch, lambda m: KVTable(
+            2048, value_dim=value_dim, slots_per_bucket=8,
+            updater=updater, mesh=mesh1,
+            name=f"kvf_{updater}_{value_dim}_{m}"))
+        assert tp._probe_update.engine == "pallas"
+        assert tx._probe_update.engine == "xla"
+        universe = np.arange(1, 400, dtype=np.uint64)
+        for step in range(4):
+            n = int(rng.integers(1, 25))       # non-pow2: padding lanes
+            keys = rng.choice(universe, size=n, replace=False)
+            shape = (n, value_dim) if value_dim else (n,)
+            deltas = rng.integers(-4, 5, size=shape).astype(np.float32)
+            tx.add(keys, deltas)
+            tp.add(keys, deltas)
+        tx.wait()
+        tp.wait()
+        _assert_kv_equal(tx, tp, f"({updater}, {value_dim})")
+        assert len(tx) == len(tp)
+        # lookups: mix of present and missing keys, duplicates allowed
+        q = rng.choice(np.arange(1, 600, dtype=np.uint64), size=19,
+                       replace=True)
+        vx, fx = tx.get(q)
+        vp, fp = tp.get(q)
+        assert np.array_equal(fx, fp)
+        assert np.array_equal(vx, vp)
+
+    def test_overflow_drops_whole_batch_on_both_engines(self, mesh1,
+                                                        monkeypatch):
+        """All-or-nothing: a batch mixing one matched update with
+        overflowing new keys must leave the table UNTOUCHED (and raise)
+        on both engines."""
+        tx, tp = _engine_pair(monkeypatch, lambda m: KVTable(
+            8, slots_per_bucket=1, updater="default", mesh=mesh1,
+            name=f"kv_over_{m}"))
+        b0 = tx._buckets_of(np.asarray([1], np.uint64))[0]
+        same = [k for k in range(1, 8000)
+                if tx._buckets_of(np.asarray([k], np.uint64))[0] == b0]
+        assert len(same) >= 3
+        k0 = np.asarray(same[:1], np.uint64)
+        for t in (tx, tp):
+            t.add(k0, np.asarray([5.0], np.float32), sync=True)
+        _assert_kv_equal(tx, tp, "(pre-overflow)")
+        batch = np.asarray(same[:3], np.uint64)   # k0 matches; 2 overflow
+        d = np.asarray([1.0, 2.0, 3.0], np.float32)
+        for t in (tx, tp):
+            t.add(batch, d)
+            with pytest.raises(RuntimeError, match="overflowed"):
+                t.wait()
+        _assert_kv_equal(tx, tp, "(post-overflow)")
+        # the matched lane's update dropped with the batch
+        vx, _ = tx.get(k0)
+        assert vx[0] == 5.0
+
+    def test_prepare_add_sorted_by_bucket(self, mesh1, monkeypatch):
+        """The Pallas probe contract: prepare_add stable-sorts lanes by
+        bucket, padding parked on the last bucket."""
+        monkeypatch.setenv("MVTPU_KERNELS", "xla")
+        t = KVTable(256, updater="default", mesh=mesh1, name="kv_sorted")
+        keys = np.arange(1, 12, dtype=np.uint64)
+        prep = t.prepare_add(keys, np.zeros(11, np.float32))
+        buckets = np.asarray(prep.buckets)
+        assert (np.diff(buckets) >= 0).all()
+        assert (buckets[11:] == t.num_buckets - 1).all()
+
+
+class TestRowParity:
+    def test_gather_and_scatter_add_fuzz(self, mesh1, monkeypatch):
+        rng = np.random.default_rng(3)
+        tx, tp = _engine_pair(monkeypatch, lambda m: MatrixTable(
+            60, 12, updater="default", mesh=mesh1, name=f"rows_{m}"))
+        assert tp._scatter_add.engine == "pallas"
+        for _ in range(3):
+            n = int(rng.integers(1, 40))
+            ids = rng.integers(0, 60, size=n)          # duplicates ok
+            deltas = rng.integers(-5, 6, size=(n, 12)).astype(np.float32)
+            tx.add_rows(ids, deltas)
+            tp.add_rows(ids, deltas)
+        assert np.array_equal(tx.get(), tp.get())
+        q = rng.integers(0, 60, size=13)               # duplicates ok
+        assert np.array_equal(tx.get_rows(q), tp.get_rows(q))
+
+    def test_sgd_scatter_parity(self, mesh1, monkeypatch):
+        tx, tp = _engine_pair(monkeypatch, lambda m: MatrixTable(
+            20, 5, updater="sgd", mesh=mesh1, name=f"rows_sgd_{m}"))
+        ids = np.asarray([3, 3, 7, 0])
+        deltas = np.ones((4, 5), np.float32)
+        tx.add_rows(ids, deltas)
+        tp.add_rows(ids, deltas)
+        assert np.array_equal(tx.get(), tp.get())
+
+
+class TestCOOParity:
+    @pytest.mark.parametrize("dtype,num_cols,tiled", [
+        ("int32", 40, False), ("float32", 40, False),
+        ("int32", 256, True),
+    ])
+    def test_coo_scatter_add_fuzz(self, mesh1, monkeypatch, dtype,
+                                  num_cols, tiled):
+        rng = np.random.default_rng(num_cols)
+        tx, tp = _engine_pair(monkeypatch, lambda m: SparseMatrixTable(
+            30, num_cols, dtype=dtype, updater="default", tiled=tiled,
+            mesh=mesh1, name=f"coo_{dtype}_{num_cols}_{m}"))
+        assert tp._coo_scatter_add.engine == "pallas"
+        for _ in range(3):
+            n = int(rng.integers(1, 50))
+            rows = rng.integers(0, 30, size=n)
+            cols = rng.integers(0, num_cols, size=n)
+            vals = rng.integers(-4, 5, size=n).astype(dtype)
+            tx.add_sparse(rows, cols, vals)      # duplicate (r,c) ok
+            tp.add_sparse(rows, cols, vals)
+        assert np.array_equal(tx.get(), tp.get())
+
+    def test_tiled_row_path_parity(self, mesh1, monkeypatch):
+        """Tiled storage re-registers gather/scatter with tiles=C/128."""
+        rng = np.random.default_rng(11)
+        tx, tp = _engine_pair(monkeypatch, lambda m: SparseMatrixTable(
+            24, 256, dtype="int32", updater="default", tiled=True,
+            mesh=mesh1, name=f"coo_rows_{m}"))
+        ids = rng.integers(0, 24, size=9)
+        deltas = rng.integers(0, 7, size=(9, 256)).astype(np.int32)
+        tx.add_rows(ids, deltas)
+        tp.add_rows(ids, deltas)
+        assert np.array_equal(tx.get(), tp.get())
+        q = rng.integers(0, 24, size=5)
+        assert np.array_equal(tx.get_rows(q), tp.get_rows(q))
+
+
+class TestSelection:
+    def _fallbacks(self, name, reason):
+        return telemetry.registry().counter(
+            "kernels.fallbacks", kernel=name, reason=reason).value
+
+    def test_auto_on_cpu_falls_back_counted(self, mesh1, monkeypatch):
+        monkeypatch.setenv("MVTPU_KERNELS", "auto")
+        name = "kv.apply.kv_auto_cpu"
+        before = self._fallbacks(name, "cpu")
+        t = KVTable(64, updater="default", mesh=mesh1, name="kv_auto_cpu")
+        assert t._probe_update.engine == "xla"
+        assert self._fallbacks(name, "cpu") == before + 1
+
+    def test_explicit_xla_no_fallback_count(self, mesh1, monkeypatch):
+        monkeypatch.setenv("MVTPU_KERNELS", "xla")
+        name = "kv.apply.kv_xla_mode"
+        before = self._fallbacks(name, "cpu")
+        t = KVTable(64, updater="default", mesh=mesh1, name="kv_xla_mode")
+        assert t._probe_update.engine == "xla"
+        assert self._fallbacks(name, "cpu") == before
+
+    def test_sharded_mesh_keeps_xla(self, mesh8, monkeypatch):
+        monkeypatch.setenv("MVTPU_KERNELS", "pallas")
+        name = "kv.apply.kv_sharded"
+        before = self._fallbacks(name, "sharded")
+        t = KVTable(64, updater="default", mesh=mesh8, name="kv_sharded")
+        assert t._probe_update.engine == "xla"
+        assert self._fallbacks(name, "sharded") == before + 1
+        # the XLA path still works end-to-end on the sharded mesh
+        t.add(np.asarray([3], np.uint64), np.asarray([1.0], np.float32),
+              sync=True)
+        assert len(t) == 1
+
+    def test_pallas_dispatches_counted_on_pallas_profile(self, mesh1,
+                                                         monkeypatch):
+        """The acceptance telemetry: under MVTPU_KERNELS=pallas the
+        interpreted kernels carry the dispatches
+        (profile.calls{fn=....pallas}), not the XLA path."""
+        monkeypatch.setenv("MVTPU_KERNELS", "pallas")
+        t = KVTable(64, updater="default", mesh=mesh1, name="kv_pdisp")
+        reg = telemetry.registry()
+        xla_calls = reg.counter("profile.calls", fn="kv.apply.kv_pdisp")
+        pal_calls = reg.counter("profile.calls",
+                                fn="kv.apply.kv_pdisp.pallas")
+        x0, p0 = xla_calls.value, pal_calls.value
+        t.add(np.asarray([1, 2], np.uint64),
+              np.asarray([1.0, 2.0], np.float32), sync=True)
+        assert pal_calls.value == p0 + 1
+        assert xla_calls.value == x0
+
+    def test_runtime_error_falls_back_permanently(self, mesh1,
+                                                  monkeypatch):
+        monkeypatch.setenv("MVTPU_KERNELS", "pallas")
+        calls = {"pallas": 0, "xla": 0}
+
+        def bad_pallas(*a):
+            calls["pallas"] += 1
+            raise RuntimeError("lowering failed")
+
+        def good_xla(*a):
+            calls["xla"] += 1
+            return "xla-result"
+
+        before = self._fallbacks("unit.kernel", "error")
+        eng = tk.select_kernel("unit.kernel", xla=good_xla,
+                               pallas=lambda: bad_pallas, mesh=mesh1)
+        assert eng.engine == "pallas"
+        assert eng(1, 2) == "xla-result"       # transparent fallback
+        assert eng.engine == "xla"             # ...and permanent
+        assert eng(1, 2) == "xla-result"
+        assert calls == {"pallas": 1, "xla": 2}
+        assert self._fallbacks("unit.kernel", "error") == before + 1
+
+    def test_unknown_mode_is_auto(self, monkeypatch):
+        monkeypatch.setenv("MVTPU_KERNELS", "turbo")
+        assert tk.kernel_mode() == "auto"
+
+
+class TestSuperstepBodies:
+    def test_fused_body_picks_up_engine_kernels(self, mesh1,
+                                                monkeypatch):
+        """A fused superstep body using the re-exported
+        gather_rows/row_scatter_add runs the Pallas engine in-trace and
+        matches the plain-XLA oracle."""
+        from multiverso_tpu.tables import superstep as ss
+
+        def build(mode):
+            monkeypatch.setenv("MVTPU_KERNELS", mode)
+            t = MatrixTable(32, 8, updater="default", mesh=mesh1,
+                            name=f"ss_{mode}")
+
+            def body(params, states, locals_, options, ids, deltas):
+                (p,) = params
+                rows = ss.gather_rows(p, ids)
+                p = ss.row_scatter_add(p, ids, deltas + 0 * rows)
+                return (p,), states, locals_, rows.sum()
+
+            step = make_superstep([t], body, name=f"ss_{mode}")
+            return t, step
+
+        ids = np.asarray([1, 1, 5, 30], np.int32)
+        deltas = np.arange(32, dtype=np.float32).reshape(4, 8)
+        outs = {}
+        for mode in ("xla", "pallas"):
+            t, step = build(mode)
+            _, aux = step((), core.place(ids, mesh=t.mesh),
+                          core.place(deltas, mesh=t.mesh))
+            t.wait()
+            outs[mode] = (t.get(), float(aux))
+        assert np.array_equal(outs["xla"][0], outs["pallas"][0])
+        assert outs["xla"][1] == outs["pallas"][1]
+
+
+class TestHashingHoist:
+    def test_backcompat_reexports(self):
+        """The hoisted helpers stay importable from their historical
+        locations (satellite: tables/hashing.py)."""
+        from multiverso_tpu.tables import hashing
+        from multiverso_tpu.tables import kv_table, matrix_table
+        assert matrix_table._bucket is hashing._bucket
+        assert kv_table._bucket is hashing._bucket
+        assert kv_table._hash_u64 is hashing._hash_u64
+        assert kv_table._split_keys is hashing._split_keys
+        assert kv_table.EMPTY_KEY == hashing.EMPTY_KEY
+        assert hashing._bucket(1) == 8 and hashing._bucket(9) == 16
+        roundtrip = hashing._join_keys(
+            hashing._split_keys(np.asarray([0, 1, 2**40 + 7],
+                                           np.uint64)))
+        assert np.array_equal(roundtrip,
+                              np.asarray([0, 1, 2**40 + 7], np.uint64))

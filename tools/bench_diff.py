@@ -50,7 +50,13 @@ DEFAULT_WATCH = ("value", "e2e_words_per_sec", "lda_doc_tokens_per_sec",
                  # checkpoint_bench.py): run-level store throughput —
                  # a regression here makes every checkpoint cadence
                  # steal more training wall-clock
-                 "ckpt_store_mb_per_sec")
+                 "ckpt_store_mb_per_sec",
+                 # table-kernel micro-bench (benchmarks/
+                 # table_kernels.py): the Pallas engine's KV probe and
+                 # COO scatter dispatch rates — the server-side hot
+                 # path's metrics of record
+                 "kv_probe_ops_per_sec_pallas",
+                 "coo_scatter_ops_per_sec_pallas")
 
 
 def _flatten(prefix: str, obj, out: Dict[str, float]) -> None:
@@ -242,6 +248,19 @@ def selftest() -> int:
         assert main([cl_old, cl_old]) == 0, "identical client line passes"
         assert main([cl_old, cl_bad]) == 1, \
             "cached-get throughput regression must fail"
+        # table-kernel micro-bench lines: the Pallas probe/COO dispatch
+        # rates are watched by default
+        tk_old = put("tk_old.json", {
+            "metric": "kv_probe_ops_per_sec_pallas", "value": 900.0,
+            "unit": "dispatch/s", "kv_probe_ops_per_sec_pallas": 900.0,
+            "kv_probe_ops_per_sec_xla": 500.0,
+            "coo_scatter_ops_per_sec_pallas": 1200.0})
+        tk_doc = json.loads(json.dumps(json.load(open(tk_old))))
+        tk_doc["coo_scatter_ops_per_sec_pallas"] = 300.0    # -75%
+        tk_bad = put("tk_bad.json", tk_doc)
+        assert main([tk_old, tk_old]) == 0, "identical kernel line passes"
+        assert main([tk_old, tk_bad]) == 1, \
+            "pallas COO throughput regression must fail"
         # unusable inputs exit 2, not a traceback
         hung = put("hung.json", {"rc": 124, "tail": "...", "parsed": None})
         assert main([hung, raw_ok]) == 2, "no parsed line -> exit 2"
