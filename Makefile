@@ -20,10 +20,11 @@
 #                   (tiny sizes on CPU; drop MVTPU_CKPT_BENCH_TINY for
 #                   real sizes; emits checkpoint_bench.json)
 #   make kernel-bench - server-side table-kernel micro-bench, XLA vs
-#                   Pallas engines with a cross-engine parity guard
-#                   (tiny interpret-mode sizes on CPU; drop
-#                   MVTPU_KERNEL_BENCH_TINY for real sizes on TPU;
-#                   emits table_kernels_bench.json)
+#                   Pallas engines with a cross-engine parity guard,
+#                   plus the sharded lane (model=2 shard_map engines;
+#                   TINY forces 2 virtual CPU devices so it always
+#                   runs; drop MVTPU_KERNEL_BENCH_TINY for real sizes
+#                   on TPU; emits table_kernels_bench.json)
 #   make chaos    - the chaos lane: fault-injection test subset
 #                   (ft subsystem + overwrite crash-window fuzz) plus a
 #                   CLI checkpoint/resume smoke under an active

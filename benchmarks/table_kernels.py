@@ -22,10 +22,17 @@ touched-rows model (touched rows × row bytes × read+write + batch
 operands); where XLA reports cost analysis, the per-engine
 ``profile.bytes_accessed{fn=...}`` gauges ride the telemetry snapshot.
 
+A SHARDED lane rides every run with ≥2 devices (TINY forces 2 virtual
+CPU devices): a data=1 × model=2 mesh where the per-shard lane-sliced
+Pallas engine is timed against the flat XLA engine GSPMD-partitioned
+over the same mesh — the dispatch it replaces. Parity-guarded like the
+flat lanes; emits ``*_ops_per_sec_{xla,pallas}_sharded``.
+
 Emits ONE final JSON line in the bench metric-line shape (flat numeric
-keys — ``tools/bench_diff.py`` watches ``kv_probe_ops_per_sec_pallas``
-and ``coo_scatter_ops_per_sec_pallas``) and writes the same document to
-``table_kernels_bench.json`` (override: ``MVTPU_KERNEL_BENCH_JSON``).
+keys — ``tools/bench_diff.py`` watches ``kv_probe_ops_per_sec_pallas``,
+``coo_scatter_ops_per_sec_pallas`` and their ``_sharded`` twins) and
+writes the same document to ``table_kernels_bench.json`` (override:
+``MVTPU_KERNEL_BENCH_JSON``).
 
 ``MVTPU_KERNEL_BENCH_TINY=1`` shrinks every size for the ``make
 kernel-bench`` CI smoke and pins the CPU platform.
@@ -47,7 +54,13 @@ CPU = TINY or os.environ.get("MVTPU_KERNEL_BENCH_CPU", "").lower() \
 
 if CPU:
     # must precede any backend touch (wedged-tunnel hazard, see
-    # tests/conftest.py)
+    # tests/conftest.py). Two virtual CPU devices so the SHARDED lane
+    # (model=2 mesh, per-shard lane-sliced engines) always runs — the
+    # watched *_sharded metrics must exist even on a laptop.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=2").strip()
     import jax
     jax.config.update("jax_platforms", "cpu")
 
@@ -58,6 +71,7 @@ from multiverso_tpu import core, telemetry  # noqa: E402
 from multiverso_tpu.ops import table_kernels as tk  # noqa: E402
 from multiverso_tpu.tables import (KVTable, MatrixTable,  # noqa: E402
                                    SparseMatrixTable)
+from multiverso_tpu.tables.hashing import shard_lane_slices  # noqa: E402
 
 # sizes: kv (capacity, batch, value_dim, slots), rows (rows, cols, n),
 # coo (rows, cols, nnz), iters per timed engine loop
@@ -194,14 +208,115 @@ def bench_coo(mode: str) -> dict:
     }
 
 
+def bench_sharded() -> dict:
+    """The sharded lane: a data=1 × model=2 mesh, comparing the
+    per-shard lane-sliced Pallas engine against the FLAT XLA engine on
+    the same mesh (GSPMD-partitioned — exactly the dispatch the sharded
+    engine replaces). Returns {} when fewer than 2 devices exist."""
+    if len(jax.devices()) < 2:
+        return {}
+    core.shutdown()
+    core.init(devices=jax.devices()[:2], data_parallel=1,
+              model_parallel=2)
+    rng = np.random.default_rng(7)
+    n, d = SIZES["kv_batch"], SIZES["value_dim"]
+    keys = rng.choice(np.arange(1, 8 * n, dtype=np.uint64), size=n,
+                      replace=False)
+    deltas = rng.integers(-3, 4, size=(n, d)).astype(np.float32)
+
+    kv = {}
+    for mode in ("xla", "pallas"):
+        t = _with_mode(mode, lambda: KVTable(
+            SIZES["kv_capacity"], value_dim=d,
+            slots_per_bucket=SIZES["slots"], updater="adagrad",
+            name=f"bench_kv_sh_{mode}"))
+        prep = t.prepare_add(keys, deltas)    # layout follows the engine
+        carry = [t.keys, t.values, t.state]
+
+        def probe_once():
+            k, v, s, _ = t._probe_update(carry[0], carry[1], carry[2],
+                                         prep.buckets, prep.query,
+                                         prep.deltas, prep.valid,
+                                         prep.option)
+            carry[0], carry[1], carry[2] = k, v, s
+            jax.block_until_ready(k)
+
+        dt = _timed(probe_once, SIZES["iters"])
+        kv[mode] = {"ops_s": SIZES["iters"] / dt,
+                    "engine": t._probe_update.engine,
+                    "layout": t._probe_update.layout,
+                    "final": (np.asarray(carry[0]),
+                              np.asarray(carry[1]))}
+    for a, b in zip(kv["xla"]["final"], kv["pallas"]["final"]):
+        assert np.array_equal(a, b), "sharded kv probe engines diverged"
+
+    nnz = SIZES["coo_nnz"]
+    rows = np.sort(rng.integers(0, SIZES["rows"], size=nnz)) \
+        .astype(np.int32)
+    cols = rng.integers(0, SIZES["coo_cols"], size=nnz).astype(np.int32)
+    vals = rng.integers(-2, 3, size=nnz).astype(np.int32)
+    coo = {}
+    for mode in ("xla", "pallas"):
+        t = _with_mode(mode, lambda: SparseMatrixTable(
+            SIZES["rows"], SIZES["coo_cols"], dtype="int32",
+            updater="default", name=f"bench_coo_sh_{mode}"))
+        if t._coo_scatter_add.layout == "sharded":
+            rps = t._rows_per_shard
+            shard_ids = rows // rps
+            (sr, sc, sv), valid, _ = shard_lane_slices(
+                shard_ids, t._shards,
+                [(rows - shard_ids * rps).astype(np.int32), cols, vals],
+                [np.int32(rps - 1), np.int32(0), np.int32(0)])
+            ops = (sr, sc, sv, valid)
+        else:
+            ops = (rows, cols, vals)
+        carry = [t.param]
+
+        def coo_once():
+            carry[0] = t._coo_scatter_add(carry[0], *ops)
+            jax.block_until_ready(carry[0])
+
+        dt = _timed(coo_once, SIZES["iters"])
+        coo[mode] = {"ops_s": SIZES["iters"] / dt,
+                     "engine": t._coo_scatter_add.engine,
+                     "layout": t._coo_scatter_add.layout,
+                     "final": np.asarray(carry[0])[:SIZES["rows"]]}
+    assert np.array_equal(coo["xla"]["final"], coo["pallas"]["final"]), \
+        "sharded coo scatter engines diverged"
+
+    return {
+        "sharded_model_shards": 2,
+        "kv_engine_sharded": kv["pallas"]["engine"],
+        "kv_layout_sharded": kv["pallas"]["layout"],
+        "coo_engine_sharded": coo["pallas"]["engine"],
+        "coo_layout_sharded": coo["pallas"]["layout"],
+        "kv_probe_ops_per_sec_xla_sharded":
+            round(kv["xla"]["ops_s"], 2),
+        "kv_probe_ops_per_sec_pallas_sharded":
+            round(kv["pallas"]["ops_s"], 2),
+        "kv_probe_speedup_pallas_sharded_vs_xla":
+            round(kv["pallas"]["ops_s"] / kv["xla"]["ops_s"], 3),
+        "coo_scatter_ops_per_sec_xla_sharded":
+            round(coo["xla"]["ops_s"], 2),
+        "coo_scatter_ops_per_sec_pallas_sharded":
+            round(coo["pallas"]["ops_s"], 2),
+        "coo_scatter_speedup_pallas_sharded_vs_xla":
+            round(coo["pallas"]["ops_s"] / coo["xla"]["ops_s"], 3),
+    }
+
+
 def main() -> None:
-    core.init()
+    # flat lanes pinned to ONE device: the flat engines' numbers must
+    # not shift with host device count (the sharded lane re-inits)
+    core.init(devices=jax.devices()[:1], data_parallel=1,
+              model_parallel=1)
     telemetry.beat()
     interpret = jax.default_backend() == "cpu"
 
     kv = {m: bench_kv(m) for m in ("xla", "pallas")}
     rowsb = {m: bench_rows(m) for m in ("xla", "pallas")}
     coo = {m: bench_coo(m) for m in ("xla", "pallas")}
+    sharded = bench_sharded()
 
     # parity guard: a wrong kernel must fail loudly, not win the bench
     for a, b in zip(kv["xla"]["final"], kv["pallas"]["final"]):
@@ -259,6 +374,7 @@ def main() -> None:
             coo["xla"]["bytes_per_op_model"],
         "kernels_fallbacks": fallbacks,
     }
+    line.update(sharded)        # {} on single-device hosts
     out = os.environ.get("MVTPU_KERNEL_BENCH_JSON",
                          "table_kernels_bench.json")
     with open(out, "w") as f:

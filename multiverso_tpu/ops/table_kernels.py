@@ -48,20 +48,33 @@ Selection layer (:func:`select_kernel`): every kernel registers as an
   tier-1 also exercises the interpreted kernels.
 - ``xla``: force the existing XLA implementations.
 
-Sharded tables (mesh.size > 1) always fall back to XLA
-(``reason=sharded``): a bare ``pallas_call`` has no SPMD partitioning
-rule, and the cross-chip gather/scatter is XLA's job (use the
-functional forms below inside ``shard_map`` for per-shard kernels). Any
-Pallas failure at lowering/compile time falls back to XLA permanently
-for that kernel (``reason=error``), logged once — correctness over
-speed. Fallbacks are observable: ``kernels.fallbacks`` counter plus the
-per-engine ``profile.calls{fn=...}`` / ``profile.calls{fn=....pallas}``
-dispatch counts (every engine stays under ``profiled_jit``).
+Sharded tables (mesh.size > 1) run the SAME kernels per shard inside
+``shard_map``: a bare ``pallas_call`` has no SPMD partitioning rule, so
+each model-axis shard runs its own VMEM-resident grid over only its
+local buckets/rows. Host prep sorts by shard-then-bucket/row and hands
+the engine per-shard lane slices (``tables/hashing.shard_lane_slices``
+— dense, contiguous, pow2-padded lane rows with non-local lanes as
+masked padding), so there are NO cross-shard collectives inside any
+kernel; the one global interaction the KV contract needs (the
+all-or-nothing overflow drop) is a scalar sum of per-shard counts
+BETWEEN a probe-only kernel and a commit kernel. A table that registers
+no sharded Pallas form keeps XLA (``reason=sharded``); a layout the
+slicer can't shard falls back as ``reason=sharded_unsupported_layout``.
+Any Pallas failure at lowering/compile time falls back to XLA
+permanently for that kernel (``reason=error``), logged once (per
+kernel and mesh shape) — correctness over speed. Fallbacks are
+observable: ``kernels.fallbacks`` counter plus the per-engine
+``profile.calls{fn=...}`` / ``profile.calls{fn=....pallas}`` dispatch
+counts (every engine stays under ``profiled_jit``).
 
 Functional forms (:func:`gather_rows`, :func:`row_scatter_add`,
 :func:`coo_scatter_add`) are traceable inside an outer jit — fused
 supersteps pick up the same kernels by calling them from their bodies
-(re-exported by ``tables/superstep.py``).
+(re-exported by ``tables/superstep.py``). Under a
+:func:`kernel_mesh_scope` (installed by ``FusedSuperstep`` around its
+dispatch) they shard too — masked-lane ``shard_map`` wrappers rather
+than lane slices, because per-shard lane counts are dynamic inside a
+trace.
 
 This module imports NO table classes (it sits below the table layer);
 shared hashing helpers live in ``tables/hashing.py``.
@@ -69,6 +82,8 @@ shared hashing helpers live in ``tables/hashing.py``.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import functools
 import os
 from typing import Any, Callable, Optional
@@ -77,15 +92,24 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
 
 from multiverso_tpu.telemetry import metrics as _metrics
 from multiverso_tpu.updaters import AddOption
 from multiverso_tpu.utils import log
+from multiverso_tpu.utils.jax_compat import shard_map
 
 LANES = 128
 
 _MODES = ("auto", "xla", "pallas")
 _WARNED: set = set()
+
+
+class UnsupportedShardingLayout(Exception):
+    """A sharded Pallas build met a layout the per-shard lane slicer
+    can't express (e.g. a leading dim not divisible by the model-axis
+    shard count). ``select_kernel`` counts it as
+    ``reason=sharded_unsupported_layout`` and keeps XLA."""
 
 
 def kernel_mode() -> str:
@@ -107,17 +131,36 @@ def interpret_mode() -> bool:
     return jax.default_backend() == "cpu"
 
 
+def _mesh_axes(mesh: Any) -> tuple:
+    """((axis, size), ...) of a mesh, () when unknowable — the log and
+    latch key ingredient."""
+    try:
+        return tuple(dict(mesh.shape).items()) if mesh is not None else ()
+    except Exception:
+        return ()
+
+
 def _note_fallback(name: str, reason: str,
-                   exc: Optional[BaseException] = None) -> None:
-    """Count (always) + log (once per reason) a pallas→xla fallback."""
+                   exc: Optional[BaseException] = None,
+                   mesh: Any = None) -> None:
+    """Count (always) + log (once per (kernel, reason, mesh shape)) a
+    pallas→xla fallback. The log latch used to be process-wide per
+    reason, so one sharded table's fallback silenced every later
+    kernel's line — including the evidence that a later single-chip (or
+    differently-shaped) mesh took a DIFFERENT decision. Keying the
+    latch per (kernel, reason, mesh shape) keeps one line per distinct
+    story; the counter is never latched."""
     _metrics.registry().counter("kernels.fallbacks", kernel=name,
                                 reason=reason).inc()
-    if ("fallback", reason) not in _WARNED:
-        _WARNED.add(("fallback", reason))
-        log.warn("kernel engine: %s falling back to XLA (reason=%s%s); "
-                 "further %s fallbacks counted in kernels.fallbacks "
-                 "without this log line", name, reason,
-                 f": {exc!r}" if exc is not None else "", reason)
+    axes = _mesh_axes(mesh)
+    key = ("fallback", name, reason, axes)
+    if key not in _WARNED:
+        _WARNED.add(key)
+        mesh_s = ",".join(f"{a}={s}" for a, s in axes) or "unmeshed"
+        log.warn("kernel engine: %s falling back to XLA (reason=%s, "
+                 "mesh=%s%s); further %s fallbacks counted in "
+                 "kernels.fallbacks without this log line", name, reason,
+                 mesh_s, f": {exc!r}" if exc is not None else "", reason)
 
 
 class KernelEngine:
@@ -128,10 +171,18 @@ class KernelEngine:
     micro-bench read."""
 
     def __init__(self, name: str, xla: Callable,
-                 pallas: Optional[Callable] = None) -> None:
+                 pallas: Optional[Callable] = None,
+                 layout: str = "flat") -> None:
         self.name = name
         self._xla = xla
         self._pallas = pallas
+        #: operand layout the engine expects: "flat" (whole-batch
+        #: arrays) or "sharded" (per-shard (shards, L, ...) lane slices
+        #: from tables/hashing.shard_lane_slices). Fixed at selection
+        #: time — a sharded engine's runtime XLA fallback is the
+        #: lane-slice-accepting adapter, so the layout survives the
+        #: fallback and host prep never has to re-shape mid-stream.
+        self.layout = layout
 
     @property
     def engine(self) -> str:
@@ -158,28 +209,51 @@ class KernelEngine:
 
 def select_kernel(name: str, *, xla: Callable,
                   pallas: Optional[Callable[[], Callable]] = None,
+                  pallas_sharded: Optional[Callable[[], Callable]] = None,
+                  xla_sharded: Optional[Callable[[], Callable]] = None,
                   mesh: Any = None) -> KernelEngine:
     """Register one hot-path kernel behind the engine knob.
 
     ``xla`` is the already-built (profiled_jit) XLA implementation;
-    ``pallas`` is a zero-arg FACTORY for the Pallas implementation,
-    built only when selected (tables on the default CPU path pay
-    nothing). ``mesh`` (when given) gates selection: sharded meshes
-    keep XLA.
+    ``pallas`` is a zero-arg FACTORY for the flat Pallas
+    implementation, built only when selected (tables on the default CPU
+    path pay nothing). On a sharded ``mesh`` (size > 1) selection goes
+    to ``pallas_sharded`` instead — the shard_map-wrapped per-shard
+    engine whose operands are the lane slices of
+    ``tables/hashing.shard_lane_slices`` — with ``xla_sharded`` (a
+    factory for an adapter accepting the SAME lane-sliced operands) as
+    its runtime-fallback target; both are built only when the sharded
+    engine wins. A sharded mesh with no ``pallas_sharded`` keeps XLA
+    (``reason=sharded``); a ``pallas_sharded`` build that raises
+    :class:`UnsupportedShardingLayout` keeps XLA as
+    ``reason=sharded_unsupported_layout``.
     """
     mode = kernel_mode()
-    if mode == "xla" or pallas is None:
-        return KernelEngine(name, xla)
-    if mesh is not None and getattr(mesh, "size", 1) > 1:
-        _note_fallback(name, "sharded")
+    sharded = mesh is not None and getattr(mesh, "size", 1) > 1
+    if mode == "xla" or (pallas is None and pallas_sharded is None):
         return KernelEngine(name, xla)
     if mode == "auto" and jax.default_backend() == "cpu":
-        _note_fallback(name, "cpu")
+        _note_fallback(name, "cpu", mesh=mesh)
         return KernelEngine(name, xla)
+    if sharded:
+        if pallas_sharded is None:
+            _note_fallback(name, "sharded", mesh=mesh)
+            return KernelEngine(name, xla)
+        try:
+            built = pallas_sharded()
+            fallback = xla_sharded() if xla_sharded is not None else xla
+        except UnsupportedShardingLayout as e:
+            _note_fallback(name, "sharded_unsupported_layout", e,
+                           mesh=mesh)
+            return KernelEngine(name, xla)
+        except Exception as e:
+            _note_fallback(name, "error", e, mesh=mesh)
+            return KernelEngine(name, xla)
+        return KernelEngine(name, fallback, built, layout="sharded")
     try:
         built = pallas()
     except Exception as e:       # a build-time failure is also a fallback
-        _note_fallback(name, "error", e)
+        _note_fallback(name, "error", e, mesh=mesh)
         return KernelEngine(name, xla)
     return KernelEngine(name, xla, built)
 
@@ -256,6 +330,78 @@ def build_kv_lookup(*, slots: int, value_dim: int, default_value: float,
 # -- KV fused probe + updater apply + scatter ------------------------------
 
 
+def _probe_lane(row, q, valid_l, claims, *, slots: int):
+    """Probe one lane against its resident bucket row — the lane math
+    shared by the fused two-pass kernel (pass 0) and the sharded
+    probe-only kernel. Picks the matching lane, else the (claims+1)-th
+    empty lane of the ORIGINAL row — the claims counter is the
+    run-local scan that replaces the XLA path's global argsort rank
+    (equivalent count: claims == min(rank, n_empty), and both miss past
+    n_empty). Returns ``(slot (1, 1), claim_inc, over_inc)``;
+    ``slot == slots`` encodes a dropped lane."""
+    match = (row == q[:, None, :]).all(-1)            # (1, S)
+    matched = match.any(axis=1, keepdims=True)        # (1, 1)
+    lane_iota = jax.lax.broadcasted_iota(jnp.int32, (1, slots), 1)
+    empty = (row == jnp.uint32(0xFFFFFFFF)).all(-1)   # (1, S)
+    tri = (jax.lax.broadcasted_iota(jnp.int32, (slots, slots), 0)
+           <= jax.lax.broadcasted_iota(jnp.int32, (slots, slots), 1)
+           ).astype(jnp.float32)
+    ecs = jnp.dot(empty.astype(jnp.float32), tri,
+                  preferred_element_type=jnp.float32)  # incl. cumsum
+    hit = empty & (ecs == (claims + 1).astype(jnp.float32))
+    placed = hit.any(axis=1, keepdims=True)
+    new = valid_l & ~matched
+    oh = jnp.where(matched, match, hit) & valid_l      # (1, S)
+    ok = (matched | placed) & valid_l
+    slot = jnp.sum(jnp.where(oh, lane_iota, 0), axis=1, keepdims=True)
+    slot = jnp.where(ok, slot, jnp.int32(slots))
+    claim_inc = (new & placed)[0, 0].astype(jnp.int32)
+    over_inc = (new & ~placed)[0, 0].astype(jnp.int32)
+    return slot, claim_inc, over_inc
+
+
+def _apply_write(oh, q, d, opt_row, vals_in, state_in, keys_out,
+                 vals_out, state_out, *, vdim: int, updater: Any,
+                 state_treedef: Any):
+    """Masked one-hot updater apply into the resident (aliased) bucket
+    block — the write math shared by the fused kernel (pass 1) and the
+    sharded commit kernel. An all-False ``oh`` (1, S) drops the write;
+    old values read the PRE-batch inputs (dup keys per batch are
+    rejected upstream, so each slot is written at most once)."""
+    keys_out[...] = jnp.where(oh[:, :, None], q[:, None, :],
+                              keys_out[...])
+    if vdim:
+        ohv = oh[:, :, None]
+        old = jnp.where(ohv, vals_in[...], 0).sum(axis=1)       # (1, D)
+        old_state = [jnp.where(ohv, s[...], 0).sum(axis=1)
+                     for s in state_in]
+    else:
+        old = jnp.where(oh, vals_in[...], 0).sum(axis=1,
+                                                 keepdims=True)
+        old_state = [jnp.where(oh, s[...], 0).sum(axis=1,
+                                                  keepdims=True)
+                     for s in state_in]
+    opt = AddOption(learning_rate=opt_row[0, 0], momentum=opt_row[0, 1],
+                    rho=opt_row[0, 2], lam=opt_row[0, 3],
+                    step=opt_row[0, 4])
+    upd, new_state = updater.apply(
+        old, jax.tree.unflatten(state_treedef, old_state), d, opt)
+    new_leaves = jax.tree.leaves(new_state)
+    if vdim:
+        vals_out[...] = jnp.where(
+            oh[:, :, None], upd[:, None, :].astype(vals_out.dtype),
+            vals_out[...])
+        for so, ns in zip(state_out, new_leaves):
+            so[...] = jnp.where(oh[:, :, None],
+                                ns[:, None, :].astype(so.dtype),
+                                so[...])
+    else:
+        vals_out[...] = jnp.where(oh, upd.astype(vals_out.dtype),
+                                  vals_out[...])
+        for so, ns in zip(state_out, new_leaves):
+            so[...] = jnp.where(oh, ns.astype(so.dtype), so[...])
+
+
 def _kv_probe_kernel(*refs, slots: int, vdim: int, nstate: int,
                      updater: Any, state_treedef: Any):
     """Two-pass sequential grid over (pass, lane) — see module doc.
@@ -292,36 +438,17 @@ def _kv_probe_kernel(*refs, slots: int, vdim: int, nstate: int,
 
     row = keys_in[...]                                # (1, S, 2) uint32
     q = q_ref[...]                                    # (1, 2)
-    match = (row == q[:, None, :]).all(-1)            # (1, S)
-    matched = match.any(axis=1, keepdims=True)        # (1, 1)
     valid_l = v_ref[...] > 0                          # (1, 1)
     lane_iota = jax.lax.broadcasted_iota(jnp.int32, (1, slots), 1)
 
     @pl.when(p == 0)
     def _():
-        # probe: matching lane, else the (claims+1)-th empty lane of the
-        # ORIGINAL row — the claims counter is the run-local scan that
-        # replaces the XLA path's global argsort rank (equivalent count:
-        # claims == min(rank, n_empty), and both miss past n_empty)
-        empty = (row == jnp.uint32(0xFFFFFFFF)).all(-1)   # (1, S)
-        tri = (jax.lax.broadcasted_iota(jnp.int32, (slots, slots), 0)
-               <= jax.lax.broadcasted_iota(jnp.int32, (slots, slots), 1)
-               ).astype(jnp.float32)
-        ecs = jnp.dot(empty.astype(jnp.float32), tri,
-                      preferred_element_type=jnp.float32)  # incl. cumsum
         claims = claims_ref[0]
-        hit = empty & (ecs == (claims + 1).astype(jnp.float32))
-        placed = hit.any(axis=1, keepdims=True)
-        new = valid_l & ~matched
-        oh = jnp.where(matched, match, hit) & valid_l      # (1, S)
-        ok = (matched | placed) & valid_l
-        slot = jnp.sum(jnp.where(oh, lane_iota, 0), axis=1,
-                       keepdims=True)
-        slot = jnp.where(ok, slot, jnp.int32(slots))
+        slot, claim_inc, over_inc = _probe_lane(row, q, valid_l, claims,
+                                                slots=slots)
         slot_ref[i, 0] = slot[0, 0]
-        claims_ref[0] = claims + (new & placed)[0, 0].astype(jnp.int32)
-        nover_ref[0, 0] = nover_ref[0, 0] \
-            + (new & ~placed)[0, 0].astype(jnp.int32)
+        claims_ref[0] = claims + claim_inc
+        nover_ref[0, 0] = nover_ref[0, 0] + over_inc
 
     @pl.when(p == 1)
     def _():
@@ -330,39 +457,9 @@ def _kv_probe_kernel(*refs, slots: int, vdim: int, nstate: int,
         slot = slot_ref[i, 0]
         good = jnp.logical_and(slot < slots, nover_ref[0, 0] == 0)
         oh = (lane_iota == slot) & good                   # (1, S)
-        keys_out[...] = jnp.where(oh[:, :, None], q[:, None, :],
-                                  keys_out[...])
-        if vdim:
-            ohv = oh[:, :, None]
-            old = jnp.where(ohv, vals_in[...], 0).sum(axis=1)   # (1, D)
-            old_state = [jnp.where(ohv, s[...], 0).sum(axis=1)
-                         for s in state_in]
-        else:
-            old = jnp.where(oh, vals_in[...], 0).sum(axis=1,
-                                                     keepdims=True)
-            old_state = [jnp.where(oh, s[...], 0).sum(axis=1,
-                                                      keepdims=True)
-                         for s in state_in]
-        o = o_ref[...]                                    # (1, 8) f32
-        opt = AddOption(learning_rate=o[0, 0], momentum=o[0, 1],
-                        rho=o[0, 2], lam=o[0, 3], step=o[0, 4])
-        upd, new_state = updater.apply(
-            old, jax.tree.unflatten(state_treedef, old_state),
-            d_ref[...], opt)
-        new_leaves = jax.tree.leaves(new_state)
-        if vdim:
-            vals_out[...] = jnp.where(
-                oh[:, :, None], upd[:, None, :].astype(vals_out.dtype),
-                vals_out[...])
-            for so, ns in zip(state_out, new_leaves):
-                so[...] = jnp.where(oh[:, :, None],
-                                    ns[:, None, :].astype(so.dtype),
-                                    so[...])
-        else:
-            vals_out[...] = jnp.where(oh, upd.astype(vals_out.dtype),
-                                      vals_out[...])
-            for so, ns in zip(state_out, new_leaves):
-                so[...] = jnp.where(oh, ns.astype(so.dtype), so[...])
+        _apply_write(oh, q, d_ref[...], o_ref[...], vals_in, state_in,
+                     keys_out, vals_out, state_out, vdim=vdim,
+                     updater=updater, state_treedef=state_treedef)
 
 
 def build_kv_probe_update(*, slots: int, value_dim: int, updater: Any,
@@ -584,6 +681,451 @@ def build_coo_scatter_add(*, num_cols: int, tiles: int,
     return coo
 
 
+# -- sharded engines: per-shard grids under shard_map ----------------------
+#
+# Each model-axis shard runs the SAME per-lane kernels over ONLY its
+# local rows/buckets. Operands arrive as the (shards, L, ...) lane
+# slices of tables/hashing.shard_lane_slices — shard s's grid walks row
+# s, a dense bucket/row-sorted lane range whose non-local tail is
+# masked padding — so no kernel ever communicates across shards. The
+# one global interaction the KV contract needs (ANY overflow voids the
+# WHOLE batch) is a scalar jnp.sum of per-shard overflow counts between
+# the probe and commit shard_maps, outside any kernel.
+
+
+def _kv_probe_only_kernel(bkt, keys_ref, q_ref, v_ref, slot_ref,
+                          nover_ref, claims_ref, *, slots: int):
+    """Sharded probe pass: one grid step per LOCAL lane, emitting the
+    claimed slot per lane plus this shard's overflow count. The commit
+    decision (the all-or-nothing drop) needs the GLOBAL count, so the
+    write-back lives in :func:`_kv_commit_kernel`, gated on the scalar
+    sum the wrapper computes between the two shard_maps."""
+    i = pl.program_id(0)
+    new_run = jnp.logical_or(
+        i == 0, bkt[i] != bkt[jnp.maximum(i - 1, 0)])
+
+    @pl.when(i == 0)
+    def _():
+        nover_ref[0, 0] = jnp.int32(0)
+
+    @pl.when(new_run)
+    def _():
+        claims_ref[0] = jnp.int32(0)
+
+    claims = claims_ref[0]
+    slot, claim_inc, over_inc = _probe_lane(
+        keys_ref[...], q_ref[...], v_ref[...] > 0, claims, slots=slots)
+    slot_ref[0, 0] = slot[0, 0]
+    claims_ref[0] = claims + claim_inc
+    nover_ref[0, 0] = nover_ref[0, 0] + over_inc
+
+
+def _kv_commit_kernel(*refs, slots: int, vdim: int, nstate: int,
+                      updater: Any, state_treedef: Any):
+    """Sharded commit pass: masked one-hot writes of the slots claimed
+    by :func:`_kv_probe_only_kernel`, gated on the replicated GLOBAL
+    overflow count (gate != 0 → the whole batch is a no-op and every
+    visited bucket writes back its pre-batch rows bit-identically)."""
+    bkt = refs[0]
+    keys_in, vals_in = refs[1], refs[2]
+    state_in = refs[3:3 + nstate]
+    q_ref, d_ref, slot_ref, gate_ref, o_ref = refs[3 + nstate:8 + nstate]
+    keys_out, vals_out = refs[8 + nstate], refs[9 + nstate]
+    state_out = refs[10 + nstate:10 + 2 * nstate]
+
+    i = pl.program_id(0)
+    new_run = jnp.logical_or(
+        i == 0, bkt[i] != bkt[jnp.maximum(i - 1, 0)])
+
+    @pl.when(new_run)
+    def _():
+        keys_out[...] = keys_in[...]
+        vals_out[...] = vals_in[...]
+        for si, so in zip(state_in, state_out):
+            so[...] = si[...]
+
+    slot = slot_ref[0, 0]
+    good = jnp.logical_and(slot < slots, gate_ref[0, 0] == 0)
+    lane_iota = jax.lax.broadcasted_iota(jnp.int32, (1, slots), 1)
+    oh = (lane_iota == slot) & good
+    _apply_write(oh, q_ref[...], d_ref[...], o_ref[...], vals_in,
+                 state_in, keys_out, vals_out, state_out, vdim=vdim,
+                 updater=updater, state_treedef=state_treedef)
+
+
+def build_kv_probe_update_sharded(*, slots: int, value_dim: int,
+                                  updater: Any, state_template: Any,
+                                  interpret: bool, mesh: Any, axis: str,
+                                  num_buckets: int) -> Callable:
+    """(keys, values, state, buckets, query, deltas, valid, option) ->
+    (keys, values, state, n_over) with the LANE-SLICED operand layout:
+    ``buckets`` (shards, L) LOCAL bucket ids sorted per shard,
+    ``query`` (shards, L, 2), ``deltas`` (shards, L[, D]), ``valid``
+    (shards, L) — ``KVTable.prepare_add`` emits them through
+    ``shard_lane_slices``. Probe and commit are separate per-shard
+    kernels with the global overflow sum between them (module doc)."""
+    shards = int(dict(mesh.shape)[axis])
+    if num_buckets % shards:
+        raise UnsupportedShardingLayout(
+            f"num_buckets={num_buckets} not divisible by {shards} "
+            f"{axis!r}-axis shards")
+    vdim = int(value_dim)
+    treedef = jax.tree.structure(state_template)
+    nstate = len(jax.tree.leaves(state_template))
+    probe_kern = functools.partial(_kv_probe_only_kernel, slots=slots)
+    commit_kern = functools.partial(
+        _kv_commit_kernel, slots=slots, vdim=vdim, nstate=nstate,
+        updater=updater, state_treedef=treedef)
+    kspec = P(axis, None, None)
+    vspec = P(axis, None, None) if vdim else P(axis, None)
+    lanes2 = P(axis, None)
+    lanes3 = P(axis, None, None)
+    rep2 = P(None, None)
+
+    def probe_update(keys_arr, values_arr, state, buckets, query,
+                     deltas, valid, option):
+        L = buckets.shape[1]
+        state_leaves = jax.tree.leaves(state)
+        d3 = deltas.reshape(shards, L, vdim) if vdim \
+            else deltas.reshape(shards, L, 1)
+        v3 = valid.astype(jnp.int32).reshape(shards, L, 1)
+        opt = jnp.zeros((1, 8), jnp.float32)
+        opt = opt.at[0, 0].set(option.learning_rate)
+        opt = opt.at[0, 1].set(option.momentum)
+        opt = opt.at[0, 2].set(option.rho)
+        opt = opt.at[0, 3].set(option.lam)
+        opt = opt.at[0, 4].set(option.step.astype(jnp.float32))
+
+        lane = lambda i, bkt: (i, 0)
+        const = lambda i, bkt: (0, 0)
+
+        def probe_body(keys_blk, bkt_blk, q_blk, v_blk):
+            grid_spec = pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(L,),
+                in_specs=[pl.BlockSpec((1, slots, 2),
+                                       lambda i, bkt: (bkt[i], 0, 0),
+                                       memory_space=pltpu.VMEM),
+                          pl.BlockSpec((1, 2), lane,
+                                       memory_space=pltpu.VMEM),
+                          pl.BlockSpec((1, 1), lane,
+                                       memory_space=pltpu.VMEM)],
+                out_specs=[pl.BlockSpec((1, 1), lane,
+                                        memory_space=pltpu.VMEM),
+                           pl.BlockSpec((1, 1), const,
+                                        memory_space=pltpu.VMEM)],
+                scratch_shapes=[pltpu.SMEM((1,), jnp.int32)],
+            )
+            slot, nover = pl.pallas_call(
+                probe_kern, grid_spec=grid_spec,
+                out_shape=[jax.ShapeDtypeStruct((L, 1), jnp.int32),
+                           jax.ShapeDtypeStruct((1, 1), jnp.int32)],
+                interpret=interpret,
+            )(bkt_blk[0], keys_blk, q_blk[0], v_blk[0])
+            return slot[None], nover[None]
+
+        slot, nover = shard_map(
+            probe_body, mesh=mesh,
+            in_specs=(kspec, lanes2, lanes3, lanes3),
+            out_specs=(lanes3, lanes3), check_vma=False,
+        )(keys_arr, buckets, query, v3)
+        # the ONE global interaction: the all-or-nothing overflow gate
+        n_over = jnp.sum(nover).astype(jnp.int32)
+        gate = n_over.reshape(1, 1)
+
+        def commit_body(keys_blk, vals_blk, *rest):
+            state_blks = rest[:nstate]
+            bkt_blk, q_blk, d_blk, slot_blk, gate_blk, opt_blk = \
+                rest[nstate:]
+            if vdim:
+                vblk = (1, slots, vdim)
+                vmap = lambda i, bkt: (bkt[i], 0, 0)
+            else:
+                vblk = (1, slots)
+                vmap = lambda i, bkt: (bkt[i], 0)
+            kblk = pl.BlockSpec((1, slots, 2),
+                                lambda i, bkt: (bkt[i], 0, 0),
+                                memory_space=pltpu.VMEM)
+            vsp = pl.BlockSpec(vblk, vmap, memory_space=pltpu.VMEM)
+            grid_spec = pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(L,),
+                in_specs=(
+                    [kblk, vsp] + [vsp] * nstate
+                    + [pl.BlockSpec((1, 2), lane,
+                                    memory_space=pltpu.VMEM),
+                       pl.BlockSpec((1, d_blk.shape[-1]), lane,
+                                    memory_space=pltpu.VMEM),
+                       pl.BlockSpec((1, 1), lane,
+                                    memory_space=pltpu.VMEM),
+                       pl.BlockSpec((1, 1), const,
+                                    memory_space=pltpu.VMEM),
+                       pl.BlockSpec((1, 8), const,
+                                    memory_space=pltpu.VMEM)]),
+                out_specs=[kblk, vsp] + [vsp] * nstate,
+            )
+            aliases = {1 + j: j for j in range(2 + nstate)}
+            outs = pl.pallas_call(
+                commit_kern, grid_spec=grid_spec,
+                out_shape=(
+                    [jax.ShapeDtypeStruct(keys_blk.shape,
+                                          keys_blk.dtype),
+                     jax.ShapeDtypeStruct(vals_blk.shape,
+                                          vals_blk.dtype)]
+                    + [jax.ShapeDtypeStruct(s.shape, s.dtype)
+                       for s in state_blks]),
+                input_output_aliases=aliases,
+                interpret=interpret,
+            )(bkt_blk[0], keys_blk, vals_blk, *state_blks, q_blk[0],
+              d_blk[0], slot_blk[0], gate_blk, opt_blk)
+            return tuple(outs)
+
+        outs = shard_map(
+            commit_body, mesh=mesh,
+            in_specs=(kspec, vspec) + (vspec,) * nstate
+            + (lanes2, lanes3, lanes3, lanes3, rep2, rep2),
+            out_specs=(kspec, vspec) + (vspec,) * nstate,
+            check_vma=False,
+        )(keys_arr, values_arr, *state_leaves, buckets, query, d3,
+          slot, gate, opt)
+        new_keys, new_vals = outs[0], outs[1]
+        new_state = jax.tree.unflatten(treedef,
+                                       list(outs[2:2 + nstate]))
+        return new_keys, new_vals, new_state, n_over
+
+    return probe_update
+
+
+def build_kv_lookup_sharded(*, slots: int, value_dim: int,
+                            default_value: float, interpret: bool,
+                            mesh: Any, axis: str,
+                            num_buckets: int) -> Callable:
+    """(keys, values, query, buckets, inv) -> (picked, found) with the
+    lane-sliced layout: ``query`` (shards, L, 2) / ``buckets``
+    (shards, L) local ids, plus ``inv`` — flat ``shard*L + pos``
+    indices unpermuting the per-shard lane rows back to caller order
+    (``KVTable.get_jax`` builds all three). Wraps the flat lookup
+    kernel per shard."""
+    shards = int(dict(mesh.shape)[axis])
+    if num_buckets % shards:
+        raise UnsupportedShardingLayout(
+            f"num_buckets={num_buckets} not divisible by {shards} "
+            f"{axis!r}-axis shards")
+    vdim = int(value_dim)
+    inner = build_kv_lookup(slots=slots, value_dim=value_dim,
+                            default_value=default_value,
+                            interpret=interpret)
+    kspec = P(axis, None, None)
+    vspec = P(axis, None, None) if vdim else P(axis, None)
+    lanes2 = P(axis, None)
+    lanes3 = P(axis, None, None)
+
+    def body(keys_blk, vals_blk, q_blk, bkt_blk):
+        picked, found = inner(keys_blk, vals_blk, q_blk[0], bkt_blk[0])
+        return picked[None], found[None]
+
+    sm = shard_map(body, mesh=mesh,
+                   in_specs=(kspec, vspec, lanes3, lanes2),
+                   out_specs=(lanes3 if vdim else lanes2, lanes2),
+                   check_vma=False)
+
+    def lookup(keys_arr, values_arr, query, buckets, inv):
+        picked, found = sm(keys_arr, values_arr, query, buckets)
+        flat = picked.reshape(-1, vdim) if vdim else picked.reshape(-1)
+        return (jnp.take(flat, inv, axis=0),
+                jnp.take(found.reshape(-1), inv, axis=0))
+
+    return lookup
+
+
+def build_row_gather_sharded(*, num_cols: int, tiles: int,
+                             interpret: bool, mesh: Any, axis: str,
+                             lead: int) -> Callable:
+    """(param, ids, inv) -> rows [len(inv), num_cols]: per-shard local
+    gathers of the lane-sliced ``ids`` (shards, L) of LOCAL row ids,
+    unpermuted by the flat ``inv`` map."""
+    shards = int(dict(mesh.shape)[axis])
+    if lead % shards:
+        raise UnsupportedShardingLayout(
+            f"lead={lead} not divisible by {shards} "
+            f"{axis!r}-axis shards")
+    inner = build_row_gather(num_cols=num_cols, tiles=tiles,
+                             interpret=interpret)
+    pspec = P(axis, None, None) if tiles else P(axis, None)
+
+    def body(p_blk, ids_blk):
+        return inner(p_blk, ids_blk[0])[None]
+
+    sm = shard_map(body, mesh=mesh, in_specs=(pspec, P(axis, None)),
+                   out_specs=P(axis, None, None), check_vma=False)
+
+    def gather(param, ids, inv):
+        rows = sm(param, ids)
+        return jnp.take(rows.reshape(-1, num_cols), inv, axis=0)
+
+    return gather
+
+
+def _row_scatter_masked_kernel(ids_ref, p_ref, d_ref, v_ref, o_ref):
+    i = pl.program_id(0)
+    first = jnp.logical_or(
+        i == 0, ids_ref[i] != ids_ref[jnp.maximum(i - 1, 0)])
+
+    @pl.when(first)
+    def _():
+        o_ref[...] = p_ref[...]
+    ok = v_ref[0, 0] > 0
+    o_ref[...] = jnp.where(
+        ok,
+        o_ref[...] + d_ref[...].reshape(o_ref.shape).astype(o_ref.dtype),
+        o_ref[...])
+
+
+def build_row_scatter_add_masked(*, num_cols: int, tiles: int,
+                                 interpret: bool) -> Callable:
+    """(param, ids, deltas, valid) -> param — the sorted row
+    scatter-add with a per-lane write gate. Invalid lanes still walk
+    the grid (their row copies through bit-exact), so foreign/padding
+    lanes can ride a shard's dense lane range: the shard_map builder
+    and the in-trace functional form both wrap THIS kernel."""
+    blk, imap = _row_block(tiles, num_cols)
+
+    def scatter_add(param, ids, deltas, valid):
+        n = ids.shape[0]
+        lane = lambda i, ids: (i, 0)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n,),
+            in_specs=[pl.BlockSpec(blk, imap, memory_space=pltpu.VMEM),
+                      pl.BlockSpec((1, num_cols), lane,
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((1, 1), lane,
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(blk, imap, memory_space=pltpu.VMEM),
+        )
+        return pl.pallas_call(
+            _row_scatter_masked_kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(param.shape, param.dtype),
+            input_output_aliases={1: 0},
+            interpret=interpret,
+        )(ids, param, deltas.reshape(n, num_cols),
+          valid.astype(jnp.int32).reshape(n, 1))
+
+    return scatter_add
+
+
+def build_row_scatter_add_sharded(*, num_cols: int, tiles: int,
+                                  interpret: bool, mesh: Any, axis: str,
+                                  lead: int) -> Callable:
+    """(param, ids, deltas, valid) -> param with lane-sliced operands
+    (shards, L[, C]) of LOCAL row ids: each shard scatter-adds only its
+    valid lanes into its local row block."""
+    shards = int(dict(mesh.shape)[axis])
+    if lead % shards:
+        raise UnsupportedShardingLayout(
+            f"lead={lead} not divisible by {shards} "
+            f"{axis!r}-axis shards")
+    inner = build_row_scatter_add_masked(num_cols=num_cols, tiles=tiles,
+                                         interpret=interpret)
+    pspec = P(axis, None, None) if tiles else P(axis, None)
+
+    def body(p_blk, ids_blk, d_blk, v_blk):
+        return inner(p_blk, ids_blk[0], d_blk[0], v_blk[0])
+
+    sm = shard_map(body, mesh=mesh,
+                   in_specs=(pspec, P(axis, None), P(axis, None, None),
+                             P(axis, None)),
+                   out_specs=pspec, check_vma=False)
+
+    def scatter_add(param, ids, deltas, valid):
+        return sm(param, ids, deltas, valid)
+
+    return scatter_add
+
+
+def _coo_masked_kernel(rows_ref, p_ref, c_ref, v_ref, m_ref, o_ref, *,
+                       tiles: int, num_cols: int):
+    i = pl.program_id(0)
+    first = jnp.logical_or(
+        i == 0, rows_ref[i] != rows_ref[jnp.maximum(i - 1, 0)])
+
+    @pl.when(first)
+    def _():
+        o_ref[...] = p_ref[...]
+    col = c_ref[0, 0]
+    if tiles:
+        kc = jax.lax.broadcasted_iota(jnp.int32, (1, tiles, LANES), 1)
+        kl = jax.lax.broadcasted_iota(jnp.int32, (1, tiles, LANES), 2)
+        oh = (kc * LANES + kl) == col
+    else:
+        oh = jax.lax.broadcasted_iota(jnp.int32, (1, num_cols), 1) == col
+    ok = m_ref[0, 0] > 0
+    o_ref[...] = jnp.where(
+        ok,
+        o_ref[...] + jnp.where(oh, v_ref[0, 0].astype(o_ref.dtype), 0),
+        o_ref[...])
+
+
+def build_coo_scatter_add_masked(*, num_cols: int, tiles: int,
+                                 interpret: bool) -> Callable:
+    """(param, rows, cols, vals, valid) -> param — the sorted COO
+    scatter-add with a per-lane write gate (see
+    :func:`build_row_scatter_add_masked` for why masked lanes walk)."""
+    blk, imap = _row_block(tiles, num_cols)
+    kern = functools.partial(_coo_masked_kernel, tiles=tiles,
+                             num_cols=num_cols)
+
+    def coo(param, rows, cols, vals, valid):
+        n = rows.shape[0]
+        lane = lambda i, ids: (i, 0)
+        lane_spec = pl.BlockSpec((1, 1), lane, memory_space=pltpu.VMEM)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n,),
+            in_specs=[pl.BlockSpec(blk, imap, memory_space=pltpu.VMEM),
+                      lane_spec, lane_spec, lane_spec],
+            out_specs=pl.BlockSpec(blk, imap, memory_space=pltpu.VMEM),
+        )
+        return pl.pallas_call(
+            kern, grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(param.shape, param.dtype),
+            input_output_aliases={1: 0},
+            interpret=interpret,
+        )(rows, param, cols.reshape(n, 1), vals.reshape(n, 1),
+          valid.astype(jnp.int32).reshape(n, 1))
+
+    return coo
+
+
+def build_coo_scatter_add_sharded(*, num_cols: int, tiles: int,
+                                  interpret: bool, mesh: Any, axis: str,
+                                  lead: int) -> Callable:
+    """(param, rows, cols, vals, valid) -> param with lane-sliced
+    operands (shards, L) of LOCAL row ids."""
+    shards = int(dict(mesh.shape)[axis])
+    if lead % shards:
+        raise UnsupportedShardingLayout(
+            f"lead={lead} not divisible by {shards} "
+            f"{axis!r}-axis shards")
+    inner = build_coo_scatter_add_masked(num_cols=num_cols, tiles=tiles,
+                                         interpret=interpret)
+    pspec = P(axis, None, None) if tiles else P(axis, None)
+    lanes2 = P(axis, None)
+
+    def body(p_blk, r_blk, c_blk, v_blk, m_blk):
+        return inner(p_blk, r_blk[0], c_blk[0], v_blk[0], m_blk[0])
+
+    sm = shard_map(body, mesh=mesh,
+                   in_specs=(pspec, lanes2, lanes2, lanes2, lanes2),
+                   out_specs=pspec, check_vma=False)
+
+    def coo(param, rows, cols, vals, valid):
+        return sm(param, rows, cols, vals, valid)
+
+    return coo
+
+
 # -- functional forms for superstep bodies ---------------------------------
 #
 # Traceable inside an outer jit (a bare pallas_call is a first-class
@@ -593,6 +1135,42 @@ def build_coo_scatter_add(*, num_cols: int, tiles: int,
 # trace, so `auto` only picks Pallas off-CPU. Scatter inputs are sorted
 # in-trace (a batch-sized argsort — still far smaller than the XLA
 # scatter's full sorted-segment machinery over table rows).
+#
+# Under a kernel_mesh_scope (FusedSuperstep installs one around its
+# dispatch) the forms shard: masked-lane shard_map wrappers rather than
+# host lane slices, because per-shard lane counts are dynamic inside a
+# trace. Foreign lanes map to the shard's LAST local row, masked off by
+# the write gate of the masked kernels; gathers psum masked partial
+# rows across the model axis (the one collective, outside the kernel).
+
+
+_KERNEL_MESH: contextvars.ContextVar = contextvars.ContextVar(
+    "mvtpu_kernel_mesh", default=None)
+
+
+@contextlib.contextmanager
+def kernel_mesh_scope(mesh: Any, axis: str):
+    """Tell the functional forms which mesh/model-axis the enclosing
+    dispatch shards tables over. ``FusedSuperstep`` wraps its jitted
+    dispatch in this scope; a body tracing :func:`gather_rows` /
+    :func:`row_scatter_add` / :func:`coo_scatter_add` inside it gets
+    the sharded wrappers (on single-device meshes the scope is a
+    no-op)."""
+    token = _KERNEL_MESH.set((mesh, axis))
+    try:
+        yield
+    finally:
+        _KERNEL_MESH.reset(token)
+
+
+def _scope_mesh():
+    scope = _KERNEL_MESH.get()
+    if scope is None:
+        return None
+    mesh, _axis = scope
+    if getattr(mesh, "size", 1) <= 1:
+        return None
+    return scope
 
 
 def _functional_pallas() -> bool:
@@ -618,6 +1196,102 @@ def _cached(builder: Callable, num_cols: int, tiles: int,
     return builder(num_cols=num_cols, tiles=tiles, interpret=interpret)
 
 
+def _sharded_gather_rows(param, ids, mesh, axis):
+    """In-trace sharded gather: each shard gathers its local hits
+    (foreign lanes read row 0, masked to zero) and the masked partial
+    rows psum across the model axis — outside any kernel."""
+    num_cols, tiles = _layout(param)
+    shards = int(dict(mesh.shape)[axis])
+    if param.shape[0] % shards:
+        _note_fallback("fn.gather_rows", "sharded_unsupported_layout",
+                       mesh=mesh)
+        rows = jnp.take(param, ids, axis=0)
+        return rows.reshape(ids.shape[0], num_cols)
+    rps = param.shape[0] // shards
+    inner = _cached(build_row_gather, num_cols, tiles, interpret_mode())
+    pspec = P(axis, None, None) if tiles else P(axis, None)
+
+    def body(p_blk, ids_blk):
+        s = jax.lax.axis_index(axis)
+        lo = s * rps
+        mine = (ids_blk >= lo) & (ids_blk < lo + rps)
+        lids = jnp.where(mine, ids_blk - lo, 0).astype(jnp.int32)
+        rows = inner(p_blk, lids)
+        return jax.lax.psum(jnp.where(mine[:, None], rows, 0), axis)
+
+    sm = shard_map(body, mesh=mesh, in_specs=(pspec, P(None)),
+                   out_specs=P(None, None), check_vma=False)
+    return sm(param, ids.astype(jnp.int32))
+
+
+def _sharded_row_scatter_add(param, ids, deltas, mesh, axis):
+    """In-trace sharded scatter-add: sorted lanes, foreign lanes mapped
+    to the shard's LAST local row and masked off by the write gate (a
+    no-op run only re-copies the pre-batch row, so a later real run of
+    that row stays correct)."""
+    num_cols, tiles = _layout(param)
+    shards = int(dict(mesh.shape)[axis])
+    if param.shape[0] % shards:
+        _note_fallback("fn.row_scatter_add",
+                       "sharded_unsupported_layout", mesh=mesh)
+        d = deltas.reshape((ids.shape[0],) + param.shape[1:])
+        return param.at[ids].add(d.astype(param.dtype))
+    rps = param.shape[0] // shards
+    inner = _cached(build_row_scatter_add_masked, num_cols, tiles,
+                    interpret_mode())
+    pspec = P(axis, None, None) if tiles else P(axis, None)
+    order = jnp.argsort(ids, stable=True)
+    sids = jnp.take(ids, order).astype(jnp.int32)
+    sdel = jnp.take(deltas.reshape(ids.shape[0], num_cols), order,
+                    axis=0)
+
+    def body(p_blk, ids_blk, d_blk):
+        s = jax.lax.axis_index(axis)
+        lo = s * rps
+        mine = (ids_blk >= lo) & (ids_blk < lo + rps)
+        lids = jnp.where(mine, ids_blk - lo, rps - 1).astype(jnp.int32)
+        return inner(p_blk, lids, d_blk, mine.astype(jnp.int32))
+
+    sm = shard_map(body, mesh=mesh,
+                   in_specs=(pspec, P(None), P(None, None)),
+                   out_specs=pspec, check_vma=False)
+    return sm(param, sids, sdel)
+
+
+def _sharded_coo_scatter_add(param, rows, cols, vals, mesh, axis):
+    """In-trace sharded COO scatter-add — same foreign-lane mapping as
+    :func:`_sharded_row_scatter_add`."""
+    num_cols, tiles = _layout(param)
+    shards = int(dict(mesh.shape)[axis])
+    if param.shape[0] % shards:
+        _note_fallback("fn.coo_scatter_add",
+                       "sharded_unsupported_layout", mesh=mesh)
+        if tiles:
+            return param.at[rows, cols // LANES, cols % LANES].add(
+                vals.astype(param.dtype))
+        return param.at[rows, cols].add(vals.astype(param.dtype))
+    rps = param.shape[0] // shards
+    inner = _cached(build_coo_scatter_add_masked, num_cols, tiles,
+                    interpret_mode())
+    pspec = P(axis, None, None) if tiles else P(axis, None)
+    order = jnp.argsort(rows, stable=True)
+    srows = jnp.take(rows, order).astype(jnp.int32)
+    scols = jnp.take(cols, order).astype(jnp.int32)
+    svals = jnp.take(vals, order)
+
+    def body(p_blk, r_blk, c_blk, v_blk):
+        s = jax.lax.axis_index(axis)
+        lo = s * rps
+        mine = (r_blk >= lo) & (r_blk < lo + rps)
+        lrows = jnp.where(mine, r_blk - lo, rps - 1).astype(jnp.int32)
+        return inner(p_blk, lrows, c_blk, v_blk, mine.astype(jnp.int32))
+
+    sm = shard_map(body, mesh=mesh,
+                   in_specs=(pspec, P(None), P(None), P(None)),
+                   out_specs=pspec, check_vma=False)
+    return sm(param, srows, scols, svals)
+
+
 def gather_rows(param, ids):
     """Row gather ``param[ids]`` → ``[n, num_cols]`` through the
     selected engine (superstep-body form)."""
@@ -625,6 +1299,9 @@ def gather_rows(param, ids):
     if not _functional_pallas():
         rows = jnp.take(param, ids, axis=0)
         return rows.reshape(ids.shape[0], num_cols)
+    scope = _scope_mesh()
+    if scope is not None:
+        return _sharded_gather_rows(param, ids, *scope)
     fn = _cached(build_row_gather, num_cols, tiles, interpret_mode())
     return fn(param, ids.astype(jnp.int32))
 
@@ -636,6 +1313,9 @@ def row_scatter_add(param, ids, deltas):
     if not _functional_pallas():
         d = deltas.reshape((ids.shape[0],) + param.shape[1:])
         return param.at[ids].add(d.astype(param.dtype))
+    scope = _scope_mesh()
+    if scope is not None:
+        return _sharded_row_scatter_add(param, ids, deltas, *scope)
     order = jnp.argsort(ids, stable=True)
     fn = _cached(build_row_scatter_add, num_cols, tiles,
                  interpret_mode())
@@ -653,6 +1333,9 @@ def coo_scatter_add(param, rows, cols, vals):
             return param.at[rows, cols // LANES, cols % LANES].add(
                 vals.astype(param.dtype))
         return param.at[rows, cols].add(vals.astype(param.dtype))
+    scope = _scope_mesh()
+    if scope is not None:
+        return _sharded_coo_scatter_add(param, rows, cols, vals, *scope)
     order = jnp.argsort(rows, stable=True)
     fn = _cached(build_coo_scatter_add, num_cols, tiles,
                  interpret_mode())
@@ -662,8 +1345,14 @@ def coo_scatter_add(param, rows, cols, vals):
 
 
 __all__ = [
-    "KernelEngine", "build_coo_scatter_add", "build_kv_lookup",
-    "build_kv_probe_update", "build_row_gather", "build_row_scatter_add",
-    "coo_scatter_add", "gather_rows", "interpret_mode", "kernel_mode",
-    "row_scatter_add", "select_kernel",
+    "KernelEngine", "UnsupportedShardingLayout",
+    "build_coo_scatter_add", "build_coo_scatter_add_masked",
+    "build_coo_scatter_add_sharded", "build_kv_lookup",
+    "build_kv_lookup_sharded", "build_kv_probe_update",
+    "build_kv_probe_update_sharded", "build_row_gather",
+    "build_row_gather_sharded", "build_row_scatter_add",
+    "build_row_scatter_add_masked", "build_row_scatter_add_sharded",
+    "coo_scatter_add", "gather_rows", "interpret_mode",
+    "kernel_mesh_scope", "kernel_mode", "row_scatter_add",
+    "select_kernel",
 ]

@@ -45,3 +45,49 @@ def _join_keys(split: np.ndarray) -> np.ndarray:
     """(..., 2) uint32 [hi, lo] → (...,) uint64."""
     return (split[..., 0].astype(np.uint64) << np.uint64(32)) \
         | split[..., 1].astype(np.uint64)
+
+
+def shard_lane_slices(shard_ids: np.ndarray, shards: int, arrays,
+                      pads):
+    """Slice one shard-sorted lane batch into per-shard lane rows.
+
+    The substrate of the sharded kernel engine (and the layout ROADMAP
+    item 4's resharding re-derives): each model-axis shard's Pallas grid
+    runs over ONE dense, contiguous lane range — its row of the returned
+    ``(shards, L, ...)`` arrays — with non-local lanes appearing only as
+    masked padding at the row's tail. ``L`` is the power-of-two bucket
+    (:func:`_bucket`) of the largest per-shard lane count, so the
+    compiled-signature set stays bounded exactly like the flat path's
+    batch bucketing.
+
+    ``shard_ids`` must be sorted ascending (tables get this for free:
+    bucket/row ownership is contiguous equal blocks, so the existing
+    stable sort by bucket/row IS a sort by shard-then-bucket/row, and
+    each shard's lanes keep their original relative order — the
+    bit-parity argument for the per-bucket/per-row run scans).
+
+    ``arrays`` is a sequence of ``(n, ...)`` lane arrays (local ids,
+    queries, deltas, ...), ``pads`` the per-array scalar fill for the
+    padding lanes. Returns ``(sliced, valid, pos)``: ``sliced[k]`` of
+    shape ``(shards, L) + arrays[k].shape[1:]`` with
+    ``sliced[k][shard_ids[i], pos[i]] == arrays[k][i]``; ``valid`` the
+    ``(shards, L)`` real-lane mask; ``pos`` the per-lane position within
+    its shard row (the inverse map callers build gather unpermutes
+    from: flat index ``shard_ids[i] * L + pos[i]``).
+    """
+    shard_ids = np.asarray(shard_ids)
+    n = len(shard_ids)
+    if n and (np.diff(shard_ids) < 0).any():
+        raise ValueError("shard_lane_slices needs shard-sorted lanes")
+    counts = np.bincount(shard_ids, minlength=shards)
+    L = _bucket(int(counts.max(initial=1)))
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    pos = np.arange(n) - starts[shard_ids]
+    sliced = []
+    for arr, pad in zip(arrays, pads):
+        out = np.full((shards, L) + arr.shape[1:], pad, dtype=arr.dtype)
+        out[shard_ids, pos] = arr
+        sliced.append(out)
+    valid = np.zeros((shards, L), bool)
+    valid[shard_ids, pos] = True
+    return sliced, valid, pos

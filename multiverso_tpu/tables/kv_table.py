@@ -48,7 +48,8 @@ from multiverso_tpu.tables.base import (Handle, Table, _register,
 # engine); re-imported here so historical `from kv_table import ...`
 # call sites keep working
 from multiverso_tpu.tables.hashing import (EMPTY_KEY, _bucket, _hash_u64,
-                                           _join_keys, _split_keys)
+                                           _join_keys, _split_keys,
+                                           shard_lane_slices)
 from multiverso_tpu.telemetry.profiling import profiled_jit
 from multiverso_tpu.updaters import (AddOption, get_updater,
                                      resolve_default_option)
@@ -70,13 +71,17 @@ class PreparedKVAdd:
     """One Add batch with host prep done and operands staged on device
     (H2D already issued): the unit the async staging pipeline hands
     between its prepare thread and the dispatching thread."""
-    buckets: Any        # device int32 [b]   (b = pow2 bucket of n)
-    query: Any          # device uint32 [b, 2]
-    deltas: Any         # device [b(, D)]
-    valid: Any          # device bool [b]    (first n lanes real)
+    buckets: Any        # device int32 [b]   (b = pow2 bucket of n);
+    #                     sharded layout: int32 [shards, L] LOCAL ids
+    query: Any          # device uint32 [b, 2]   (sharded: [shards, L, 2])
+    deltas: Any         # device [b(, D)]        (sharded: [shards, L(, D)])
+    valid: Any          # device bool [b]        (sharded: [shards, L])
     option: AddOption   # device-leaved (resolved at prepare time)
     elems: int
     nbytes: int
+    #: operand layout this batch was prepped for — must match the
+    #: engine's ``KernelEngine.layout`` ("flat" | "sharded")
+    layout: str = "flat"
 
 
 class KVTable:
@@ -114,6 +119,12 @@ class KVTable:
         buckets = -(-capacity // self.slots)
         self.num_buckets = -(-buckets // shards) * shards
         self.capacity = self.num_buckets * self.slots
+        self._shards = shards
+        # bucket→shard ownership is contiguous equal blocks (shard s
+        # owns [s*bps, (s+1)*bps)), so a sort by bucket IS a sort by
+        # shard-then-bucket — the invariant the sharded lane slicer and
+        # the per-shard Pallas grids both stand on
+        self._buckets_per_shard = self.num_buckets // shards
 
         kv_shape = (self.num_buckets, self.slots)
         val_shape = kv_shape + ((value_dim,) if value_dim else ())
@@ -228,15 +239,46 @@ class KVTable:
             return jnp.sum(~(keys_arr == jnp.uint32(0xFFFFFFFF))
                            .all(-1))
 
+        # the sharded XLA adapters: lane-sliced (shards, L, ...) operands
+        # flattened shard-major with bucket ids globalized (local +
+        # s*bps). Shard-major flattening of the per-shard bucket-sorted
+        # slices stays GLOBALLY bucket-sorted (each shard's padding
+        # parks on its local max bucket bps-1 → global (s+1)*bps-1,
+        # still below the next shard's first bucket), so the XLA
+        # argsort-rank tie-break sees the same lane order as the flat
+        # path and the results are bit-identical. These are both the
+        # runtime-fallback target of the sharded Pallas engine and the
+        # MVTPU_KERNELS=xla comparison lane the parity tests drive.
+        bps = self._buckets_per_shard
+        offs = jnp.arange(self._shards, dtype=jnp.int32)[:, None] * bps
+
+        def lookup_sharded(keys_arr, values_arr, query, buckets, inv):
+            gb = (buckets + offs).reshape(-1)
+            picked, found = lookup(keys_arr, values_arr,
+                                   query.reshape(-1, 2), gb)
+            return (jnp.take(picked, inv, axis=0),
+                    jnp.take(found, inv, axis=0))
+
+        def probe_update_sharded(keys_arr, values_arr, state, buckets,
+                                 query, deltas, valid, option):
+            shards, lanes = buckets.shape
+            gb = (buckets + offs).reshape(-1)
+            d = deltas.reshape((shards * lanes,) + deltas.shape[2:])
+            return probe_update(keys_arr, values_arr, state, gb,
+                                query.reshape(-1, 2), d,
+                                valid.reshape(-1), option)
+
         # profiled: profile.calls{fn=kv.lookup/kv.apply.<name>} are the
         # Get/Add dispatch counts the client pipeline's coalescing and
-        # caching claims are asserted against. Both paths register
+        # caching claims are asserted against. All paths register
         # behind the kernel engine (MVTPU_KERNELS): the XLA closures
         # above stay the fallback, the Pallas engine (same signatures,
         # bit-equal results — tests/test_table_kernels.py) keeps each
         # bucket's slot rows in VMEM and replaces the batch-wide argsort
-        # with the in-kernel per-bucket scan. The Pallas engine's
-        # dispatches land on profile.calls{fn=....pallas}.
+        # with the in-kernel per-bucket scan; on a multi-device mesh the
+        # sharded forms run the same per-shard grids under shard_map.
+        # The Pallas engine's dispatches land on
+        # profile.calls{fn=....pallas}.
         self._lookup = tk.select_kernel(
             f"kv.lookup.{self.name}",
             xla=profiled_jit(
@@ -248,6 +290,18 @@ class KVTable:
                     default_value=self.default_value,
                     interpret=tk.interpret_mode()),
                 name=f"kv.lookup.{self.name}.pallas",
+                out_shardings=(replicated, replicated)),
+            pallas_sharded=lambda: profiled_jit(
+                tk.build_kv_lookup_sharded(
+                    slots=self.slots, value_dim=self.value_dim,
+                    default_value=self.default_value,
+                    interpret=tk.interpret_mode(), mesh=self.mesh,
+                    axis=core.MODEL_AXIS,
+                    num_buckets=self.num_buckets),
+                name=f"kv.lookup.{self.name}.pallas",
+                out_shardings=(replicated, replicated)),
+            xla_sharded=lambda: profiled_jit(
+                lookup_sharded, name=f"kv.lookup.{self.name}",
                 out_shardings=(replicated, replicated)),
             mesh=self.mesh)
         self._probe_update = tk.select_kernel(
@@ -263,6 +317,22 @@ class KVTable:
                     updater=self.updater, state_template=self.state,
                     interpret=tk.interpret_mode()),
                 name=f"kv.apply.{self.name}.pallas",
+                donate_argnums=(0, 1, 2),
+                out_shardings=(self._key_sharding, self._val_sharding,
+                               state_sh, scalar_sh)),
+            pallas_sharded=lambda: profiled_jit(
+                tk.build_kv_probe_update_sharded(
+                    slots=self.slots, value_dim=self.value_dim,
+                    updater=self.updater, state_template=self.state,
+                    interpret=tk.interpret_mode(), mesh=self.mesh,
+                    axis=core.MODEL_AXIS,
+                    num_buckets=self.num_buckets),
+                name=f"kv.apply.{self.name}.pallas",
+                donate_argnums=(0, 1, 2),
+                out_shardings=(self._key_sharding, self._val_sharding,
+                               state_sh, scalar_sh)),
+            xla_sharded=lambda: profiled_jit(
+                probe_update_sharded, name=f"kv.apply.{self.name}",
                 donate_argnums=(0, 1, 2),
                 out_shardings=(self._key_sharding, self._val_sharding,
                                state_sh, scalar_sh)),
@@ -348,6 +418,8 @@ class KVTable:
         n = len(keys)
         elems = n * max(self.value_dim, 1)
         self._record_op("get", elems, elems * self.dtype.itemsize)
+        if self._lookup.layout == "sharded":
+            return self._get_jax_sharded(keys, n)
         b = _bucket(n)
         query = np.full((b, 2), 0xFFFFFFFF, np.uint32)
         query[:n] = _split_keys(keys)
@@ -358,6 +430,34 @@ class KVTable:
             core.place(query, mesh=self.mesh),
             core.place(buckets, mesh=self.mesh))
         if b != n:      # padding lanes (sentinel query) sliced away
+            vals, found = vals[:n], found[:n]
+        return vals, found
+
+    def _get_jax_sharded(self, keys: np.ndarray, n: int):
+        """Lane-sliced Get prep for the sharded engine: sort lanes by
+        owning shard, hand each shard its dense row of local bucket ids
+        + queries, and an ``inv`` map (flat ``shard*L + pos`` indices,
+        pow2-padded) that unpermutes the per-shard results back to
+        caller order."""
+        bps = self._buckets_per_shard
+        lane_buckets = self._buckets_of(keys)
+        shard_ids = lane_buckets // bps
+        order = np.argsort(shard_ids, kind="stable")
+        sshard = shard_ids[order]
+        local = (lane_buckets[order] - sshard * bps).astype(np.int32)
+        (sl_local, sl_query), _valid, pos = shard_lane_slices(
+            sshard, self._shards, [local, _split_keys(keys[order])],
+            [np.int32(bps - 1), np.uint32(0xFFFFFFFF)])
+        lanes = sl_local.shape[1]
+        inv = np.zeros(_bucket(n), np.int32)
+        inv[order] = (sshard * lanes + pos).astype(np.int32)
+        mput = lambda a: core.place(
+            a, P(core.MODEL_AXIS, *([None] * (a.ndim - 1))),
+            mesh=self.mesh)
+        vals, found = self._lookup(
+            self.keys, self.values, mput(sl_query), mput(sl_local),
+            core.place(inv, mesh=self.mesh))
+        if len(inv) != n:
             vals, found = vals[:n], found[:n]
         return vals, found
 
@@ -411,6 +511,29 @@ class KVTable:
         keys = keys[order]
         deltas = deltas[order]
         lane_buckets = lane_buckets[order]
+        if self._probe_update.layout == "sharded":
+            # bucket ownership is contiguous equal blocks, so the sort
+            # above already grouped lanes by owning shard (in shard
+            # order) with each shard's lanes bucket-sorted — exactly
+            # what shard_lane_slices and the per-shard grids need
+            bps = self._buckets_per_shard
+            shard_ids = lane_buckets // bps
+            local = (lane_buckets - shard_ids * bps).astype(np.int32)
+            (sl_local, sl_query, sl_deltas), valid, _pos = \
+                shard_lane_slices(
+                    shard_ids, self._shards,
+                    [local, _split_keys(keys), deltas],
+                    [np.int32(bps - 1), np.uint32(0xFFFFFFFF), 0])
+            opt = (option or self.default_option).as_jax(self.mesh)
+            mput = lambda a: core.place(
+                a, P(core.MODEL_AXIS, *([None] * (a.ndim - 1))),
+                mesh=self.mesh)
+            return PreparedKVAdd(
+                buckets=mput(sl_local), query=mput(sl_query),
+                deltas=mput(sl_deltas), valid=mput(valid), option=opt,
+                elems=int(deltas.size),
+                nbytes=int(deltas.size) * self.dtype.itemsize,
+                layout="sharded")
         b = _bucket(n)
         query = np.full((b, 2), 0xFFFFFFFF, np.uint32)
         query[:n] = _split_keys(keys)

@@ -31,11 +31,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from multiverso_tpu import core
 from multiverso_tpu.ops import table_kernels as tk
 from multiverso_tpu.tables.base import Handle, Table
 # _bucket lives in tables/hashing.py now (shared with the kernel
 # engine); re-imported here for historical import sites
-from multiverso_tpu.tables.hashing import _bucket
+from multiverso_tpu.tables.hashing import _bucket, shard_lane_slices
 from multiverso_tpu.telemetry.profiling import profiled_jit
 from multiverso_tpu.updaters import AddOption
 
@@ -68,6 +69,12 @@ class MatrixTable(Table):
         self._scratch_row = self.padded_shape[0] - 1
         assert self._scratch_row >= self.logical_shape[0], \
             "scratch row must live in the padded area"
+        # row→shard ownership is contiguous equal blocks over the model
+        # axis (base padding makes the lead divisible), so a sort by
+        # row id IS a sort by shard-then-row — the sharded lane
+        # slicer's precondition
+        self._shards = self.mesh.shape[core.MODEL_AXIS]
+        self._rows_per_shard = self.padded_shape[0] // self._shards
         self._build_jits()
 
     # base class hook: reserve at least one padding row for scatter scratch
@@ -109,11 +116,33 @@ class MatrixTable(Table):
                 state, new_st, st_rows)
             return param, state
 
+        # sharded XLA adapters: lane-sliced (shards, L, ...) operands
+        # with LOCAL row ids globalized (local + s*rps). Invalid lanes
+        # redirect to the global scratch row — the masked Pallas
+        # kernels gate those writes instead, so the logical rows stay
+        # bit-identical across engines (the scratch row is garbage by
+        # contract on every path). These serve as both the sharded
+        # engine's runtime-fallback target and the MVTPU_KERNELS=xla
+        # parity lane.
+        rps = self._rows_per_shard
+        offs = jnp.arange(self._shards, dtype=jnp.int32)[:, None] * rps
+
+        def gather_sharded(param, ids, inv):
+            rows = jnp.take(param, (ids + offs).reshape(-1), axis=0)
+            return jnp.take(rows, inv, axis=0)
+
+        def scatter_add_sharded(param, ids, deltas, valid):
+            gids = jnp.where(valid, ids + offs,
+                             self._scratch_row).reshape(-1)
+            d = deltas.reshape(-1, self.num_cols)
+            return param.at[gids].add(d.astype(param.dtype))
+
         # profiled: profile.calls{fn=table.{gather,scatter_add,
         # apply_rows}.<name>} count the row-path dispatches the client
         # pipeline's row coalescing / caching are measured against.
         # Gather and scatter-add register behind the kernel engine
-        # (MVTPU_KERNELS) with the XLA closures above as fallback;
+        # (MVTPU_KERNELS) with the XLA closures above as fallback
+        # (per-shard shard_map grids on multi-device meshes);
         # apply_rows (stateful row updates) stays XLA-only.
         self._gather_rows = tk.select_kernel(
             f"table.gather.{self.name}",
@@ -125,6 +154,16 @@ class MatrixTable(Table):
                                     interpret=tk.interpret_mode()),
                 name=f"table.gather.{self.name}.pallas",
                 out_shardings=replicated),
+            pallas_sharded=lambda: profiled_jit(
+                tk.build_row_gather_sharded(
+                    num_cols=self.num_cols, tiles=0,
+                    interpret=tk.interpret_mode(), mesh=self.mesh,
+                    axis=core.MODEL_AXIS, lead=self.padded_shape[0]),
+                name=f"table.gather.{self.name}.pallas",
+                out_shardings=replicated),
+            xla_sharded=lambda: profiled_jit(
+                gather_sharded, name=f"table.gather.{self.name}",
+                out_shardings=replicated),
             mesh=self.mesh)
         self._scatter_add = tk.select_kernel(
             f"table.scatter_add.{self.name}",
@@ -135,6 +174,17 @@ class MatrixTable(Table):
                 tk.build_row_scatter_add(num_cols=self.num_cols, tiles=0,
                                          interpret=tk.interpret_mode()),
                 name=f"table.scatter_add.{self.name}.pallas",
+                donate_argnums=(0,)),
+            pallas_sharded=lambda: profiled_jit(
+                tk.build_row_scatter_add_sharded(
+                    num_cols=self.num_cols, tiles=0,
+                    interpret=tk.interpret_mode(), mesh=self.mesh,
+                    axis=core.MODEL_AXIS, lead=self.padded_shape[0]),
+                name=f"table.scatter_add.{self.name}.pallas",
+                donate_argnums=(0,)),
+            xla_sharded=lambda: profiled_jit(
+                scatter_add_sharded,
+                name=f"table.scatter_add.{self.name}",
                 donate_argnums=(0,)),
             mesh=self.mesh)
         self._gather_apply_scatter = profiled_jit(
@@ -167,24 +217,70 @@ class MatrixTable(Table):
         out_d[:n] = deltas
         return out_ids, mask, n, out_d
 
+    def _pad_ids_sharded(self, ids: np.ndarray,
+                         deltas: Optional[np.ndarray] = None, *,
+                         sort: bool = False):
+        """Lane-slice prep for the sharded engines: group lanes by
+        owning shard (scatters sort by GLOBAL row id, which implies it
+        and keeps each shard's lanes row-sorted for the run-scan
+        kernels) and slice into per-shard rows of LOCAL ids via
+        ``shard_lane_slices``. Padding lanes carry the shard's max
+        local id (keeps in-shard sortedness; their writes are masked).
+        Returns ``(local_ids, valid, inv, n[, deltas])`` with the
+        lane-sliced (shards, L, ...) layout; ``inv`` is the pow2-padded
+        flat ``shard*L + pos`` map gathers unpermute through."""
+        rps = self._rows_per_shard
+        if len(ids) > 1:
+            key = ids if sort else ids // rps
+            order = np.argsort(key, kind="stable")
+            ids = ids[order]
+            if deltas is not None:
+                deltas = deltas[order]
+        else:
+            order = np.arange(len(ids))
+        shard_ids = ids // rps
+        local = (ids - shard_ids * rps).astype(np.int32)
+        arrays, pads = [local], [np.int32(rps - 1)]
+        if deltas is not None:
+            arrays.append(deltas)
+            pads.append(0)
+        sliced, valid, pos = shard_lane_slices(shard_ids, self._shards,
+                                               arrays, pads)
+        n = len(ids)
+        lanes = sliced[0].shape[1]
+        inv = np.zeros(_bucket(n), np.int32)
+        inv[order] = (shard_ids * lanes + pos).astype(np.int32)
+        if deltas is None:
+            return sliced[0], valid, inv, n
+        return sliced[0], valid, inv, n, sliced[1]
+
     # -- row API -----------------------------------------------------------
+
+    def _gather_dispatch(self, ids: np.ndarray):
+        """One gather dispatch in whichever operand layout the selected
+        engine wants; returns the device rows future (first n real)."""
+        if self._gather_rows.layout == "sharded":
+            sl_ids, _valid, inv, n = self._pad_ids_sharded(ids)
+            return self._gather_rows(self.param, sl_ids, inv)[:n]
+        padded, _, n = self._pad_ids(ids)
+        return self._gather_rows(self.param, padded)[:n]
 
     def get_rows(self, row_ids) -> np.ndarray:
         """Fetch a list of rows (``MatrixWorkerTable::Get(row_ids, ...)``)."""
         ids = np.asarray(row_ids, dtype=np.int32)
         self._check_ids(ids)
-        padded, _, n = self._pad_ids(ids)
+        n = len(ids)
         self._record_op("get", n * self.num_cols,
                         n * self.num_cols * self.dtype.itemsize)
-        return np.asarray(self._gather_rows(self.param, padded))[:n]
+        return np.asarray(self._gather_dispatch(ids))
 
     def get_rows_async(self, row_ids) -> Handle:
         ids = np.asarray(row_ids, dtype=np.int32)
         self._check_ids(ids)
-        padded, _, n = self._pad_ids(ids)
+        n = len(ids)
         self._record_op("get", n * self.num_cols,
                         n * self.num_cols * self.dtype.itemsize)
-        return Handle(self._gather_rows(self.param, padded)[:n])
+        return Handle(self._gather_dispatch(ids))
 
     def add_rows(self, row_ids, deltas, option: Optional[AddOption] = None,
                  sync: bool = False) -> Handle:
@@ -203,15 +299,20 @@ class MatrixTable(Table):
                              f"({len(ids)}, {self.num_cols})")
         self._record_op("add", deltas.size,
                         deltas.size * self.dtype.itemsize)
-        if self.updater.name == "default":
-            padded, _, _, pd = self._pad_ids(ids, deltas, sort=True)
-            self.param = self._scatter_add(self.param, padded, pd)
-        elif self.updater.name == "sgd":
-            # stateless: scatter-add of -lr*delta, duplicate-safe
-            padded, _, _, pd = self._pad_ids(ids, deltas, sort=True)
-            lr = float(option.learning_rate if option is not None
-                       else self.default_option.learning_rate)
-            self.param = self._scatter_add(self.param, padded, -lr * pd)
+        if self.updater.name in ("default", "sgd"):
+            if self.updater.name == "sgd":
+                # stateless: scatter-add of -lr*delta, duplicate-safe
+                lr = float(option.learning_rate if option is not None
+                           else self.default_option.learning_rate)
+                deltas = -lr * deltas
+            if self._scatter_add.layout == "sharded":
+                sl_ids, valid, _inv, _n, sl_d = self._pad_ids_sharded(
+                    ids, deltas, sort=True)
+                self.param = self._scatter_add(self.param, sl_ids, sl_d,
+                                               valid)
+            else:
+                padded, _, _, pd = self._pad_ids(ids, deltas, sort=True)
+                self.param = self._scatter_add(self.param, padded, pd)
         else:
             if len(np.unique(ids)) != len(ids):
                 raise ValueError(

@@ -43,7 +43,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from multiverso_tpu import core
 from multiverso_tpu.ops import table_kernels as tk
 from multiverso_tpu.tables.base import Handle
-from multiverso_tpu.tables.hashing import _bucket
+from multiverso_tpu.tables.hashing import _bucket, shard_lane_slices
 from multiverso_tpu.tables.matrix_table import MatrixTable
 from multiverso_tpu.telemetry.profiling import profiled_jit
 from multiverso_tpu.updaters import AddOption
@@ -117,6 +117,22 @@ class SparseMatrixTable(MatrixTable):
             d3 = deltas.reshape(ids.shape[0], c, LANES)
             return param.at[ids].add(d3.astype(param.dtype))
 
+        # sharded XLA adapters over the tiled layout (lane-sliced local
+        # ids globalized; invalid lanes → global scratch row — see
+        # matrix_table.py for the parity argument)
+        rps = self._rows_per_shard
+        offs = jnp.arange(self._shards, dtype=jnp.int32)[:, None] * rps
+
+        def gather_sharded(param, ids, inv):
+            rows = jnp.take(param, (ids + offs).reshape(-1), axis=0)
+            return jnp.take(rows.reshape(-1, n_cols), inv, axis=0)
+
+        def scatter_add_sharded(param, ids, deltas, valid):
+            gids = jnp.where(valid, ids + offs,
+                             self._scratch_row).reshape(-1)
+            d3 = deltas.reshape(-1, c, LANES)
+            return param.at[gids].add(d3.astype(param.dtype))
+
         # tiled layouts re-register behind the kernel engine with
         # tiles=c (one logical row = one (8,128) tile — the layout the
         # Pallas row kernels want)
@@ -130,6 +146,16 @@ class SparseMatrixTable(MatrixTable):
                                     interpret=tk.interpret_mode()),
                 name=f"table.gather.{self.name}.pallas",
                 out_shardings=replicated),
+            pallas_sharded=lambda: profiled_jit(
+                tk.build_row_gather_sharded(
+                    num_cols=n_cols, tiles=c,
+                    interpret=tk.interpret_mode(), mesh=self.mesh,
+                    axis=core.MODEL_AXIS, lead=self.padded_shape[0]),
+                name=f"table.gather.{self.name}.pallas",
+                out_shardings=replicated),
+            xla_sharded=lambda: profiled_jit(
+                gather_sharded, name=f"table.gather.{self.name}",
+                out_shardings=replicated),
             mesh=self.mesh)
         self._scatter_add = tk.select_kernel(
             f"table.scatter_add.{self.name}",
@@ -140,6 +166,17 @@ class SparseMatrixTable(MatrixTable):
                 tk.build_row_scatter_add(num_cols=n_cols, tiles=c,
                                          interpret=tk.interpret_mode()),
                 name=f"table.scatter_add.{self.name}.pallas",
+                donate_argnums=(0,)),
+            pallas_sharded=lambda: profiled_jit(
+                tk.build_row_scatter_add_sharded(
+                    num_cols=n_cols, tiles=c,
+                    interpret=tk.interpret_mode(), mesh=self.mesh,
+                    axis=core.MODEL_AXIS, lead=self.padded_shape[0]),
+                name=f"table.scatter_add.{self.name}.pallas",
+                donate_argnums=(0,)),
+            xla_sharded=lambda: profiled_jit(
+                scatter_add_sharded,
+                name=f"table.scatter_add.{self.name}",
                 donate_argnums=(0,)),
             mesh=self.mesh)
         # _gather_apply_scatter is unreachable: stateless updaters only
@@ -154,6 +191,28 @@ class SparseMatrixTable(MatrixTable):
         else:
             def coo_scatter_add(param, rows, cols, vals):
                 return param.at[rows, cols].add(vals.astype(param.dtype))
+
+        # sharded XLA adapter: lane-sliced (shards, L) COO triples with
+        # local row ids; invalid lanes → global scratch row. Shard-major
+        # flattening of the row-sorted batch stays globally sorted, so
+        # duplicate (row, col) pairs accumulate in the same order as the
+        # flat scatter — bit-parity with the Pallas run scans.
+        rps = self._rows_per_shard
+        offs = jnp.arange(self._shards, dtype=jnp.int32)[:, None] * rps
+
+        if self.tiled:
+            def coo_sharded(param, rows, cols, vals, valid):
+                gr = jnp.where(valid, rows + offs,
+                               self._scratch_row).reshape(-1)
+                fc = cols.reshape(-1)
+                return param.at[gr, fc // LANES, fc % LANES].add(
+                    vals.reshape(-1).astype(param.dtype))
+        else:
+            def coo_sharded(param, rows, cols, vals, valid):
+                gr = jnp.where(valid, rows + offs,
+                               self._scratch_row).reshape(-1)
+                return param.at[gr, cols.reshape(-1)].add(
+                    vals.reshape(-1).astype(param.dtype))
 
         # profiled: the COO Add dispatch count (client coalescing of
         # sparse adds is asserted against profile.calls on this name).
@@ -171,6 +230,17 @@ class SparseMatrixTable(MatrixTable):
                     num_cols=self.num_cols, tiles=self.tiles,
                     interpret=tk.interpret_mode()),
                 name=f"table.coo_scatter_add.{self.name}.pallas",
+                donate_argnums=(0,)),
+            pallas_sharded=lambda: profiled_jit(
+                tk.build_coo_scatter_add_sharded(
+                    num_cols=self.num_cols, tiles=self.tiles,
+                    interpret=tk.interpret_mode(), mesh=self.mesh,
+                    axis=core.MODEL_AXIS, lead=self.padded_shape[0]),
+                name=f"table.coo_scatter_add.{self.name}.pallas",
+                donate_argnums=(0,)),
+            xla_sharded=lambda: profiled_jit(
+                coo_sharded,
+                name=f"table.coo_scatter_add.{self.name}",
                 donate_argnums=(0,)),
             mesh=self.mesh)
 
@@ -241,16 +311,31 @@ class SparseMatrixTable(MatrixTable):
         # padding (the max row id) keeps the array sorted
         order = np.argsort(rows, kind="stable")
         rows, cols, values = rows[order], cols[order], values[order]
-        b = _bucket(n)
-        prows = np.full(b, self._scratch_row, dtype=np.int32)
-        pcols = np.zeros(b, dtype=np.int32)
-        pvals = np.zeros(b, dtype=values.dtype)
-        prows[:n], pcols[:n], pvals[:n] = rows, cols, values
         if self.updater.name == "sgd":
             lr = float(option.learning_rate if option is not None
                        else self.default_option.learning_rate)
-            pvals = -lr * pvals
-        self.param = self._coo_scatter_add(self.param, prows, pcols, pvals)
+            values = -lr * values
+        if self._coo_scatter_add.layout == "sharded":
+            # row ownership is contiguous equal blocks, so the row sort
+            # above IS a shard sort; padding lanes take each shard's max
+            # local row (keeps the in-shard run scan sorted) and are
+            # masked out of the write-back
+            rps = self._rows_per_shard
+            shard_ids = rows // rps
+            local = (rows - shard_ids * rps).astype(np.int32)
+            (sl_rows, sl_cols, sl_vals), valid, _pos = shard_lane_slices(
+                shard_ids, self._shards, [local, cols, values],
+                [np.int32(rps - 1), np.int32(0), 0])
+            self.param = self._coo_scatter_add(
+                self.param, sl_rows, sl_cols, sl_vals, valid)
+        else:
+            b = _bucket(n)
+            prows = np.full(b, self._scratch_row, dtype=np.int32)
+            pcols = np.zeros(b, dtype=np.int32)
+            pvals = np.zeros(b, dtype=values.dtype)
+            prows[:n], pcols[:n], pvals[:n] = rows, cols, values
+            self.param = self._coo_scatter_add(self.param, prows, pcols,
+                                               pvals)
         handle = Handle(table=self, generation=self._bump_step())
         if sync:
             handle.wait()

@@ -45,7 +45,10 @@ re-exported :func:`gather_rows` / :func:`row_scatter_add` /
 ``jnp.take`` / ``.at[].add`` — they are traceable inside the fused jit
 and route through the same ``MVTPU_KERNELS``-selected Pallas/XLA engine
 as the plain table Get/Add paths, so a fused superstep picks up the
-kernel engine with no other change.
+kernel engine with no other change. On sharded meshes the dispatch runs
+under :func:`kernel_mesh_scope`, so those functional kernels shard_map
+their Pallas grids over the model axis (masked-lane form — lane counts
+are dynamic inside a trace, so no host-side lane slicing here).
 """
 
 from __future__ import annotations
@@ -53,6 +56,9 @@ from __future__ import annotations
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
+
+from multiverso_tpu import core
+from multiverso_tpu.ops import table_kernels as tk
 
 # re-exported for superstep bodies (see module docstring): the
 # engine-selected, trace-safe gather/scatter kernels
@@ -123,8 +129,12 @@ class FusedSuperstep:
                      for t, o in zip(self.tables, options))
         params = tuple(t.param for t in self.tables)
         states = tuple(t.state for t in self.tables)
-        new_params, new_states, new_locals, aux = self._run(
-            params, states, locals_, opts, *inputs)
+        # sharded meshes: the scope tells the in-trace functional kernels
+        # which mesh/axis to shard_map their Pallas grids over (tracing
+        # sees only abstract values — the mesh can't be inferred there)
+        with tk.kernel_mesh_scope(self.tables[0].mesh, core.MODEL_AXIS):
+            new_params, new_states, new_locals, aux = self._run(
+                params, states, locals_, opts, *inputs)
         for t, p, s in zip(self.tables, new_params, new_states):
             t.param = p
             t.state = s

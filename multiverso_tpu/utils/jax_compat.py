@@ -3,7 +3,14 @@
 ``shard_map`` moved from ``jax.experimental.shard_map`` (jax < 0.6,
 ``check_rep=``) to top-level ``jax.shard_map`` (``check_vma=``). Every
 in-repo user goes through this wrapper so the codebase carries the new
-spelling while still importing on the older jax this image ships."""
+spelling while still importing on the older jax this image ships.
+
+The sharded kernel engine (``ops/table_kernels.py``) wraps every
+per-shard Pallas grid in this shard_map with ``check_vma=False``:
+interpret-mode pallas_call with scalar prefetch + input/output aliasing
+does not carry the varying-manual-axes annotations the checker wants,
+and the kernels are closed over per-shard operands by construction (no
+cross-shard collectives inside the body)."""
 
 from __future__ import annotations
 

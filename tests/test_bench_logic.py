@@ -441,6 +441,46 @@ def test_text_tail_handles_bytes_str_none(bench_mod):
     assert bench._text_tail("x" * 50, 10) == "x" * 10
 
 
+def test_kernel_bench_capture_parses_with_sharded_metrics(tmp_path):
+    """The ``make kernel-bench`` lane through the driver's capture
+    contract: the TINY run's LAST stdout JSON line must parse non-null
+    and carry the sharded-lane metrics ``tools/bench_diff.py`` watches
+    (TINY forces 2 virtual CPU devices, so the model=2 shard_map lane
+    always runs) — with both sharded sections actually on the
+    lane-sliced Pallas engine and zero engine fallbacks."""
+    import subprocess
+    env = dict(os.environ, MVTPU_KERNEL_BENCH_TINY="1",
+               MVTPU_KERNEL_BENCH_JSON=str(tmp_path / "tk.json"))
+    # the bench pins its own XLA_FLAGS device-count before importing
+    # jax; the conftest's 8-device flag must not leak in and skew it
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "table_kernels.py")],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    parsed = None
+    for ln in proc.stdout.splitlines():     # driver: last complete line
+        try:
+            doc = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(doc, dict):
+            parsed = doc
+    assert parsed is not None, "bench emitted no JSON metric line"
+    for key in ("kv_probe_ops_per_sec_pallas_sharded",
+                "coo_scatter_ops_per_sec_pallas_sharded",
+                "kv_probe_ops_per_sec_xla_sharded",
+                "coo_scatter_ops_per_sec_xla_sharded"):
+        assert parsed.get(key, 0) > 0, f"missing sharded metric {key}"
+    assert parsed["kv_engine_sharded"] == "pallas"
+    assert parsed["coo_engine_sharded"] == "pallas"
+    assert parsed["kv_layout_sharded"] == "sharded"
+    assert parsed["coo_layout_sharded"] == "sharded"
+    assert parsed["kernels_fallbacks"] == 0
+    assert parsed["parity_checked"] is True
+
+
 def test_probe_chip_deterministic_rc_failure_exits_early(bench_mod,
                                                          monkeypatch):
     """A quick nonzero probe exit (chip absent / fell back to CPU) is

@@ -23,10 +23,19 @@ from multiverso_tpu.tables import (KVTable, MatrixTable,
 
 @pytest.fixture()
 def mesh1(devices):
-    """Single-device mesh: the shape the Pallas engine selects on (a
-    bare pallas_call has no SPMD partitioning rule — sharded meshes
-    keep XLA)."""
+    """Single-device mesh: the flat Pallas engine's shape (whole-batch
+    grids, no shard_map wrapper — sharded meshes select the per-shard
+    lane-sliced engine instead, see TestShardedParity)."""
     m = core.init(devices=devices[:1], data_parallel=1, model_parallel=1)
+    yield m
+    core.shutdown()
+
+
+@pytest.fixture()
+def mesh_mp2(devices):
+    """Cheapest sharded mesh (model=2): two interpret-mode per-shard
+    grids per dispatch — the sharded-engine workhorse fixture."""
+    m = core.init(devices=devices[:2], data_parallel=1, model_parallel=2)
     yield m
     core.shutdown()
 
@@ -209,17 +218,83 @@ class TestSelection:
         assert t._probe_update.engine == "xla"
         assert self._fallbacks(name, "cpu") == before
 
-    def test_sharded_mesh_keeps_xla(self, mesh8, monkeypatch):
+    def test_sharded_mesh_selects_sharded_pallas(self, mesh8,
+                                                 monkeypatch):
+        """The acceptance criterion: on a dp×mp mesh every table kernel
+        dispatches Pallas under shard_map — reason=sharded stays ZERO."""
         monkeypatch.setenv("MVTPU_KERNELS", "pallas")
         name = "kv.apply.kv_sharded"
         before = self._fallbacks(name, "sharded")
         t = KVTable(64, updater="default", mesh=mesh8, name="kv_sharded")
-        assert t._probe_update.engine == "xla"
-        assert self._fallbacks(name, "sharded") == before + 1
-        # the XLA path still works end-to-end on the sharded mesh
+        assert t._probe_update.engine == "pallas"
+        assert t._probe_update.layout == "sharded"
+        assert t._lookup.engine == "pallas"
+        assert t._lookup.layout == "sharded"
+        assert self._fallbacks(name, "sharded") == before
+        # ...and works end-to-end on the sharded mesh
         t.add(np.asarray([3], np.uint64), np.asarray([1.0], np.float32),
               sync=True)
         assert len(t) == 1
+
+    def test_sharded_no_factory_counts_reason_sharded(self, mesh_mp2,
+                                                      monkeypatch):
+        """A sharded mesh with no sharded Pallas factory keeps XLA under
+        the ORIGINAL reason label."""
+        monkeypatch.setenv("MVTPU_KERNELS", "pallas")
+        before = self._fallbacks("unit.nosharded", "sharded")
+        eng = tk.select_kernel("unit.nosharded", xla=lambda: "x",
+                               pallas=lambda: (lambda: "p"),
+                               mesh=mesh_mp2)
+        assert eng.engine == "xla" and eng.layout == "flat"
+        assert self._fallbacks("unit.nosharded", "sharded") == before + 1
+
+    def test_unsupported_layout_reason_split(self, mesh_mp2,
+                                             monkeypatch):
+        """A sharded factory refusing the layout gets its OWN reason
+        label (satellite: sharded vs sharded_unsupported_layout)."""
+        monkeypatch.setenv("MVTPU_KERNELS", "pallas")
+
+        def bad_factory():
+            raise tk.UnsupportedShardingLayout("lead % shards != 0")
+
+        before = self._fallbacks("unit.badlayout",
+                                 "sharded_unsupported_layout")
+        eng = tk.select_kernel("unit.badlayout", xla=lambda: "x",
+                               pallas=lambda: (lambda: "p"),
+                               pallas_sharded=bad_factory,
+                               mesh=mesh_mp2)
+        assert eng.engine == "xla" and eng.layout == "flat"
+        assert self._fallbacks("unit.badlayout",
+                               "sharded_unsupported_layout") == before + 1
+
+    def test_fallback_log_latched_per_mesh_shape(self, devices,
+                                                 monkeypatch):
+        """Satellite: the fallback log latch keys on (kernel, reason,
+        mesh shape) — a second mesh SHAPE logs its own line (with the
+        mesh axis names), a repeat of the same shape stays silent, and
+        the counter never latches."""
+        monkeypatch.setenv("MVTPU_KERNELS", "pallas")
+        logged = []
+        monkeypatch.setattr(tk.log, "warn",
+                            lambda fmt, *a: logged.append(fmt % a))
+        name = "unit.latch"
+        before = self._fallbacks(name, "sharded")
+        shapes = [(1, 2), (2, 2), (1, 2)]       # third repeats the first
+        lines = []
+        for dp, mp in shapes:
+            m = core.init(devices=devices[:dp * mp], data_parallel=dp,
+                          model_parallel=mp)
+            logged.clear()
+            tk.select_kernel(name, xla=lambda: "x",
+                             pallas=lambda: (lambda: "p"), mesh=m)
+            lines.append([s for s in logged if "falling back" in s])
+            core.shutdown()
+        assert len(lines[0]) == 1
+        assert "data=1" in lines[0][0] and "model=2" in lines[0][0]
+        assert len(lines[1]) == 1               # new shape → new line
+        assert "data=2" in lines[1][0]
+        assert len(lines[2]) == 0               # repeat shape → latched
+        assert self._fallbacks(name, "sharded") == before + 3
 
     def test_pallas_dispatches_counted_on_pallas_profile(self, mesh1,
                                                          monkeypatch):
@@ -264,6 +339,181 @@ class TestSelection:
     def test_unknown_mode_is_auto(self, monkeypatch):
         monkeypatch.setenv("MVTPU_KERNELS", "turbo")
         assert tk.kernel_mode() == "auto"
+
+
+class TestShardedParity:
+    """Per-shard lane-sliced Pallas engines vs the flat XLA oracle on
+    real multi-device CPU meshes (dp-only, mp-only, dp×mp). The XLA
+    table runs the FLAT whole-batch path (GSPMD-partitioned), so these
+    compare two genuinely different lowerings; parity must be bit-exact
+    on the logical contents."""
+
+    def test_kv_sharded_fuzz_and_dispatch(self, mesh8, monkeypatch):
+        """dp×mp mesh: randomized add/lookup stream with cross-batch
+        duplicate keys landing on different shards; every dispatch must
+        hit the sharded Pallas engine (profile.calls{fn=....pallas})
+        with reason=sharded at zero."""
+        rng = np.random.default_rng(17)
+        tx, tp = _engine_pair(monkeypatch, lambda m: KVTable(
+            512, value_dim=3, slots_per_bucket=8, updater="adagrad",
+            mesh=mesh8, name=f"kvsh_{m}"))
+        assert tp._probe_update.layout == "sharded"
+        assert tx._probe_update.layout == "flat"
+        reg = telemetry.registry()
+        pal_calls = reg.counter("profile.calls",
+                                fn="kv.apply.kvsh_pallas.pallas")
+        shard_fb = reg.counter("kernels.fallbacks",
+                               kernel="kv.apply.kvsh_pallas",
+                               reason="sharded")
+        p0, f0 = pal_calls.value, shard_fb.value
+        universe = np.arange(1, 300, dtype=np.uint64)
+        steps = 4
+        for _ in range(steps):
+            n = int(rng.integers(1, 20))       # non-pow2: padding lanes
+            keys = rng.choice(universe, size=n, replace=False)
+            deltas = rng.integers(-4, 5, size=(n, 3)).astype(np.float32)
+            tx.add(keys, deltas)
+            tp.add(keys, deltas)
+        tx.wait()
+        tp.wait()
+        _assert_kv_equal(tx, tp, "(sharded adagrad)")
+        assert len(tx) == len(tp)
+        q = rng.choice(np.arange(1, 600, dtype=np.uint64), size=19,
+                       replace=True)
+        vx, fx = tx.get(q)
+        vp, fp = tp.get(q)
+        assert np.array_equal(fx, fp)
+        assert np.array_equal(vx, vp)
+        assert pal_calls.value == p0 + steps   # every add went Pallas
+        assert shard_fb.value == f0            # reason=sharded stayed 0
+
+    def test_kv_sharded_overflow_atomicity(self, mesh_mp2, monkeypatch):
+        """A bucket overflow on ONE shard must drop the whole batch on
+        EVERY shard (the global n_over gates each shard's commit)."""
+        tx, tp = _engine_pair(monkeypatch, lambda m: KVTable(
+            64, slots_per_bucket=1, updater="default", mesh=mesh_mp2,
+            name=f"kvsho_{m}"))
+        assert tp._probe_update.layout == "sharded"
+        bks = np.asarray(tx._buckets_of(np.arange(1, 4000,
+                                                  dtype=np.uint64)))
+        b0 = bks[0]
+        bps = tx.num_buckets // 2
+        same = 1 + np.flatnonzero(bks == b0)        # same bucket as key 1
+        other = 1 + np.flatnonzero(bks // bps != b0 // bps)  # other shard
+        assert len(same) >= 3 and len(other) >= 2
+        for t in (tx, tp):
+            t.add(np.asarray([same[0], other[0]], np.uint64),
+                  np.asarray([5.0, 9.0], np.float32), sync=True)
+        # batch: one matched lane + 2 overflowing + a fine other-shard key
+        batch = np.asarray(list(same[:3]) + [other[1]], np.uint64)
+        d = np.asarray([1.0, 2.0, 3.0, 4.0], np.float32)
+        for t in (tx, tp):
+            t.add(batch, d)
+            with pytest.raises(RuntimeError, match="overflowed"):
+                t.wait()
+        _assert_kv_equal(tx, tp, "(sharded post-overflow)")
+        vq = np.asarray([same[0], other[0], other[1]], np.uint64)
+        for t in (tx, tp):
+            v, f = t.get(vq)
+            assert v[0] == 5.0 and v[1] == 9.0     # pre-batch intact
+            assert not f[2]       # other-shard lane dropped with batch
+            assert len(t) == 2
+
+    @pytest.mark.parametrize("updater", ["default", "sgd"])
+    def test_rows_sharded_fuzz(self, mesh_mp2, monkeypatch, updater):
+        rng = np.random.default_rng(23)
+        tx, tp = _engine_pair(monkeypatch, lambda m: MatrixTable(
+            60, 12, updater=updater, mesh=mesh_mp2,
+            name=f"rowsh_{updater}_{m}"))
+        assert tp._scatter_add.layout == "sharded"
+        assert tp._gather_rows.layout == "sharded"
+        for _ in range(3):
+            n = int(rng.integers(1, 40))
+            ids = rng.integers(0, 60, size=n)      # duplicates ok
+            deltas = rng.integers(-5, 6, size=(n, 12)).astype(np.float32)
+            tx.add_rows(ids, deltas)
+            tp.add_rows(ids, deltas)
+        assert np.array_equal(tx.get(), tp.get())
+        q = rng.integers(0, 60, size=13)           # duplicates ok
+        assert np.array_equal(tx.get_rows(q), tp.get_rows(q))
+
+    @pytest.mark.parametrize("num_cols,tiled", [(40, False),
+                                                (256, True)])
+    def test_coo_sharded_fuzz(self, mesh_mp2, monkeypatch, num_cols,
+                              tiled):
+        rng = np.random.default_rng(num_cols)
+        tx, tp = _engine_pair(monkeypatch, lambda m: SparseMatrixTable(
+            30, num_cols, dtype="int32", updater="default", tiled=tiled,
+            mesh=mesh_mp2, name=f"coosh_{num_cols}_{m}"))
+        assert tp._coo_scatter_add.layout == "sharded"
+        for _ in range(3):
+            n = int(rng.integers(1, 50))
+            rows = rng.integers(0, 30, size=n)
+            cols = rng.integers(0, num_cols, size=n)
+            vals = rng.integers(-4, 5, size=n).astype(np.int32)
+            tx.add_sparse(rows, cols, vals)        # duplicate (r,c) ok
+            tp.add_sparse(rows, cols, vals)
+        assert np.array_equal(tx.get(), tp.get())
+        ix, cx, vx = tx.get_rows_sparse([0, 5, 7])
+        ip, cp, vp = tp.get_rows_sparse([0, 5, 7])
+        assert np.array_equal(ix, ip)
+        assert np.array_equal(cx, cp)
+        assert np.array_equal(vx, vp)
+
+    def test_tiled_rows_sharded_parity(self, mesh_mp2, monkeypatch):
+        """Tiled storage's sharded re-registration (tiles=C/128)."""
+        rng = np.random.default_rng(29)
+        tx, tp = _engine_pair(monkeypatch, lambda m: SparseMatrixTable(
+            24, 256, dtype="int32", updater="default", tiled=True,
+            mesh=mesh_mp2, name=f"coosh_rows_{m}"))
+        assert tp._scatter_add.layout == "sharded"
+        ids = rng.integers(0, 24, size=9)
+        deltas = rng.integers(0, 7, size=(9, 256)).astype(np.int32)
+        tx.add_rows(ids, deltas)
+        tp.add_rows(ids, deltas)
+        assert np.array_equal(tx.get(), tp.get())
+        q = rng.integers(0, 24, size=5)
+        assert np.array_equal(tx.get_rows(q), tp.get_rows(q))
+
+    def test_superstep_sharded_functional_kernels(self, mesh8,
+                                                  monkeypatch):
+        """A fused body's functional gather/scatter kernels run the
+        masked-lane shard_map form under kernel_mesh_scope on a dp×mp
+        mesh and match the XLA oracle."""
+        from multiverso_tpu.tables import superstep as ss
+
+        def build(mode):
+            monkeypatch.setenv("MVTPU_KERNELS", mode)
+            t = MatrixTable(48, 8, updater="default", mesh=mesh8,
+                            name=f"sssh_{mode}")
+
+            def body(params, states, locals_, options, ids, deltas,
+                     rows, cols, vals):
+                (p,) = params
+                g = ss.gather_rows(p, ids)
+                p = ss.row_scatter_add(p, ids, g * 0.5 + deltas)
+                p = ss.coo_scatter_add(p, rows, cols, vals)
+                return (p,), states, locals_, g.sum()
+
+            return t, make_superstep([t], body, name=f"sssh_{mode}")
+
+        rng = np.random.default_rng(31)
+        ids = rng.integers(0, 48, size=16).astype(np.int32)
+        deltas = rng.normal(size=(16, 8)).astype(np.float32)
+        rows = rng.integers(0, 48, size=16).astype(np.int32)
+        cols = rng.integers(0, 8, size=16).astype(np.int32)
+        vals = rng.integers(-3, 4, size=16).astype(np.float32)
+        outs = {}
+        for mode in ("xla", "pallas"):
+            t, step = build(mode)
+            t.add_rows(ids[:4], deltas[:4], sync=True)
+            args = [core.place(a, mesh=t.mesh)
+                    for a in (ids, deltas, rows, cols, vals)]
+            _, aux = step((), *args)
+            t.wait()
+            outs[mode] = (t.get(), float(aux))
+        assert np.array_equal(outs["xla"][0], outs["pallas"][0])
+        assert outs["xla"][1] == outs["pallas"][1]
 
 
 class TestSuperstepBodies:

@@ -56,7 +56,12 @@ DEFAULT_WATCH = ("value", "e2e_words_per_sec", "lda_doc_tokens_per_sec",
                  # COO scatter dispatch rates — the server-side hot
                  # path's metrics of record
                  "kv_probe_ops_per_sec_pallas",
-                 "coo_scatter_ops_per_sec_pallas")
+                 "coo_scatter_ops_per_sec_pallas",
+                 # ...and the sharded-mesh lane (model=2 shard_map
+                 # engines vs flat GSPMD XLA): the per-shard Pallas
+                 # dispatch rates the sharded engine ships for
+                 "kv_probe_ops_per_sec_pallas_sharded",
+                 "coo_scatter_ops_per_sec_pallas_sharded")
 
 
 def _flatten(prefix: str, obj, out: Dict[str, float]) -> None:
@@ -254,13 +259,21 @@ def selftest() -> int:
             "metric": "kv_probe_ops_per_sec_pallas", "value": 900.0,
             "unit": "dispatch/s", "kv_probe_ops_per_sec_pallas": 900.0,
             "kv_probe_ops_per_sec_xla": 500.0,
-            "coo_scatter_ops_per_sec_pallas": 1200.0})
+            "coo_scatter_ops_per_sec_pallas": 1200.0,
+            "kv_probe_ops_per_sec_pallas_sharded": 700.0,
+            "coo_scatter_ops_per_sec_pallas_sharded": 1100.0})
         tk_doc = json.loads(json.dumps(json.load(open(tk_old))))
         tk_doc["coo_scatter_ops_per_sec_pallas"] = 300.0    # -75%
         tk_bad = put("tk_bad.json", tk_doc)
         assert main([tk_old, tk_old]) == 0, "identical kernel line passes"
         assert main([tk_old, tk_bad]) == 1, \
             "pallas COO throughput regression must fail"
+        # the sharded-lane twins are watched too
+        sh_doc = json.loads(json.dumps(json.load(open(tk_old))))
+        sh_doc["kv_probe_ops_per_sec_pallas_sharded"] = 100.0  # -86%
+        sh_bad = put("sh_bad.json", sh_doc)
+        assert main([tk_old, sh_bad]) == 1, \
+            "sharded pallas probe regression must fail"
         # unusable inputs exit 2, not a traceback
         hung = put("hung.json", {"rc": 124, "tail": "...", "parsed": None})
         assert main([hung, raw_ok]) == 2, "no parsed line -> exit 2"
