@@ -25,6 +25,11 @@
 #                   TINY forces 2 virtual CPU devices so it always
 #                   runs; drop MVTPU_KERNEL_BENCH_TINY for real sizes
 #                   on TPU; emits table_kernels_bench.json)
+#   make serve-smoke - serving/observability smoke: tiny serving bench
+#                   (8 client threads, one dispatcher) in-process with
+#                   an ephemeral statusz server + SLO rule armed, then
+#                   scrape /metrics /healthz /statusz /trace over HTTP
+#                   and assert non-null serving p50/p99/p999
 #   make chaos    - the chaos lane: fault-injection test subset
 #                   (ft subsystem + overwrite crash-window fuzz) plus a
 #                   CLI checkpoint/resume smoke under an active
@@ -37,7 +42,8 @@ OLD ?= BENCH_r04.json
 NEW ?= BENCH_r05.json
 
 .PHONY: test dryrun bench bench-dryrun bench-diff bench-diff-selftest \
-	client-bench ckpt-bench kernel-bench chaos fuzz lint native ci
+	client-bench ckpt-bench kernel-bench serve-smoke chaos fuzz lint \
+	native ci
 
 fuzz:
 	$(PY) tests/deep_fuzz.py
@@ -65,6 +71,9 @@ ckpt-bench:
 
 kernel-bench:
 	MVTPU_KERNEL_BENCH_TINY=1 $(PY) benchmarks/table_kernels.py
+
+serve-smoke:
+	$(PY) tools/serve_smoke.py
 
 # the chaos lane: recovery paths exercised under injected faults —
 # the ft test subset, the overwrite crash-window fuzz, and an app CLI
@@ -100,4 +109,4 @@ native:
 	$(MAKE) -C native
 
 ci: lint bench-diff-selftest native test dryrun bench-dryrun \
-	client-bench ckpt-bench kernel-bench chaos
+	client-bench ckpt-bench kernel-bench serve-smoke chaos

@@ -1,0 +1,189 @@
+"""Serving-grade load bench: tail latency of the client pipeline under
+multi-threaded load.
+
+The training benches measure throughput of ONE hot loop; a parameter
+server's other life is SERVING — many worker threads issuing mixed
+get/add traffic and caring about the p99, not the mean. This bench
+drives that shape while honoring the repo's threading contract:
+
+- N client threads (>= 8 by default) generate mixed whole-table gets
+  (``CachedView``) and KV adds (``CoalescingBuffer``) and measure each
+  op SUBMIT -> COMPLETE,
+- ONE dispatcher thread owns every table dispatch (multi-device
+  collective programs must all launch from a single thread — two
+  threads dispatching concurrently interleave the per-device rendezvous
+  and deadlock the backend), fed by a plain request queue,
+- latencies land in ``serving.latency.seconds`` (the log-spaced
+  LATENCY_BUCKETS histogram), and the summary publishes
+  ``serving_p50_ms`` / ``serving_p99_ms`` / ``serving_p999_ms`` gauges
+  through the registry — the SLO monitor's own quantile math, so the
+  bench and a production ``MVTPU_SLO=serving.latency.p99<...`` rule can
+  never disagree.
+
+Emits ONE final JSON line in the bench metric-line shape (flat numeric
+keys — ``tools/bench_diff.py`` compares runs; ``serving_p99_ms`` is a
+LOWER-is-better watch) and writes the same document to
+``serving_bench.json`` (override: ``MVTPU_SERVING_BENCH_JSON``).
+
+``MVTPU_SERVING_TINY=1`` shrinks sizes for the CI smoke run and pins
+the CPU platform (keeps the >= 8 client threads — the concurrency is
+the point).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+TINY = os.environ.get("MVTPU_SERVING_TINY", "").lower() \
+    not in ("", "0", "false")
+CPU = TINY or os.environ.get("MVTPU_SERVING_CPU", "").lower() \
+    not in ("", "0", "false")
+
+if CPU:
+    # must precede any backend touch; a wedged TPU tunnel would hang
+    # the smoke run at import otherwise (tests/conftest.py hazard)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from multiverso_tpu import client, core, telemetry  # noqa: E402
+from multiverso_tpu.tables import ArrayTable, KVTable  # noqa: E402
+
+# sizes: client threads, ops per thread, kv batch, table n
+SIZES = dict(threads=8, ops=40, keys=128, value_dim=8, table_n=1 << 14,
+             coalesce_k=8, staleness=4)
+if TINY:
+    SIZES = dict(threads=8, ops=8, keys=32, value_dim=4,
+                 table_n=1 << 10, coalesce_k=4, staleness=4)
+
+OP_TIMEOUT_S = 120.0        # a blown timeout IS the deadlock detector
+
+
+class _Op:
+    __slots__ = ("kind", "keys", "deltas", "done")
+
+    def __init__(self, kind, keys=None, deltas=None):
+        self.kind = kind
+        self.keys = keys
+        self.deltas = deltas
+        self.done = threading.Event()
+
+
+def _dispatcher(reqq: "queue.Queue", view, buf) -> None:
+    """THE dispatch thread: every table program launches here."""
+    while True:
+        op = reqq.get()
+        if op is None:
+            return
+        try:
+            if op.kind == "get":
+                view.get()
+            else:
+                buf.add_kv(op.keys, op.deltas)
+        finally:
+            op.done.set()
+
+
+def _client(tid: int, reqq: "queue.Queue", hist, errors: list) -> None:
+    rng = np.random.default_rng(1000 + tid)
+    b, d = SIZES["keys"], SIZES["value_dim"]
+    for i in range(SIZES["ops"]):
+        if i % 3 == 0:
+            op = _Op("get")
+        else:
+            keys = rng.choice(np.arange(1, 4 * b, dtype=np.uint64),
+                              size=b, replace=False)
+            op = _Op("add", keys,
+                     rng.normal(size=(b, d)).astype(np.float32))
+        t0 = time.perf_counter()
+        reqq.put(op)
+        if not op.done.wait(OP_TIMEOUT_S):
+            errors.append(f"client {tid}: op {i} ({op.kind}) timed out "
+                          f"after {OP_TIMEOUT_S}s — dispatch deadlock?")
+            return
+        hist.observe(time.perf_counter() - t0)
+        telemetry.counter("serving.ops", op=op.kind).inc()
+
+
+def main() -> None:
+    core.init()
+    telemetry.beat()
+    dense = ArrayTable(SIZES["table_n"], "float32", name="serve_dense")
+    kv = KVTable(SIZES["keys"] * 16, value_dim=SIZES["value_dim"],
+                 name="serve_kv")
+    # warmup: compile the signatures once so the measured tail is the
+    # serving path, not XLA compilation
+    dense.add(np.ones(SIZES["table_n"], np.float32))
+    dense.get()
+    w = np.arange(1, SIZES["keys"] + 1, dtype=np.uint64)
+    kv.add(w, np.zeros((SIZES["keys"], SIZES["value_dim"]), np.float32))
+    kv.wait()
+
+    view = client.CachedView(dense, max_staleness=SIZES["staleness"])
+    buf = client.CoalescingBuffer(kv, max_deltas=SIZES["coalesce_k"])
+    hist = telemetry.histogram("serving.latency.seconds",
+                               telemetry.LATENCY_BUCKETS)
+    reqq: "queue.Queue" = queue.Queue()
+    errors: list = []
+
+    disp = threading.Thread(target=_dispatcher, name="serve-dispatch",
+                            args=(reqq, view, buf), daemon=True)
+    disp.start()
+    clients = [threading.Thread(target=_client, name=f"serve-client{i}",
+                                args=(i, reqq, hist, errors),
+                                daemon=True)
+               for i in range(SIZES["threads"])]
+    t0 = time.perf_counter()
+    for c in clients:
+        c.start()
+    for c in clients:
+        c.join(timeout=OP_TIMEOUT_S * (SIZES["ops"] + 1))
+    dt = time.perf_counter() - t0
+    reqq.put(None)
+    disp.join(timeout=OP_TIMEOUT_S)
+    buf.flush()
+    kv.wait()
+    view.close()
+    if errors or any(c.is_alive() for c in clients) or disp.is_alive():
+        for e in errors:
+            print(e, file=sys.stderr)
+        raise SystemExit("serving bench: deadlock or timeout (see "
+                         "above)")
+
+    n_ops = SIZES["threads"] * SIZES["ops"]
+    p50, p99, p999 = hist.p50, hist.p99, hist.p999
+    assert p50 is not None, "no latencies recorded"
+    for name, v in (("serving_p50_ms", p50), ("serving_p99_ms", p99),
+                    ("serving_p999_ms", p999)):
+        telemetry.gauge(name).set(round(v * 1e3, 6))
+    # headline "value" stays higher-is-better (the generic watch);
+    # the serving_pXX_ms keys are the LOWER-is-better watches
+    line = {
+        "metric": "serving_ops_per_sec",
+        "value": round(n_ops / dt, 2),
+        "unit": "ops/s",
+        "tiny": TINY,
+        "serving_p50_ms": round(p50 * 1e3, 3),
+        "serving_p99_ms": round(p99 * 1e3, 3),
+        "serving_p999_ms": round(p999 * 1e3, 3),
+        "serving_ops_per_sec": round(n_ops / dt, 2),
+        "serving_threads": SIZES["threads"],
+        "serving_ops": n_ops,
+    }
+    out = os.environ.get("MVTPU_SERVING_BENCH_JSON",
+                         "serving_bench.json")
+    with open(out, "w") as f:
+        json.dump(line, f, indent=1)
+    print(json.dumps(line), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1586,6 +1586,9 @@ class LightLDA:
             telemetry.step_timeline(
                 "lda", it, tokens=self.num_tokens,
                 dispatch_s=time.perf_counter() - t_sweep)
+            telemetry.histogram(
+                "app.step.seconds", telemetry.LATENCY_BUCKETS,
+                app="lda").observe(time.perf_counter() - t_sweep)
             telemetry.beat()    # flight recorder: a heartbeat per sweep
             self._sweep_done = it + 1
             if self.run_ckpt is not None:

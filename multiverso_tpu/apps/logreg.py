@@ -335,6 +335,9 @@ class LogisticRegression:
             telemetry.step_timeline(
                 "logreg", step_no, samples=S * c.minibatch_size,
                 dispatch_s=time.perf_counter() - t_step)
+            telemetry.histogram(
+                "app.step.seconds", telemetry.LATENCY_BUCKETS,
+                app="logreg").observe(time.perf_counter() - t_step)
             telemetry.beat()
             step_no += 1
             losses.extend(lg)
@@ -347,6 +350,9 @@ class LogisticRegression:
             telemetry.step_timeline(
                 "logreg", step_no, samples=len(idx),
                 dispatch_s=time.perf_counter() - t_step)
+            telemetry.histogram(
+                "app.step.seconds", telemetry.LATENCY_BUCKETS,
+                app="logreg").observe(time.perf_counter() - t_step)
             telemetry.beat()
             step_no += 1
             losses.append(loss)

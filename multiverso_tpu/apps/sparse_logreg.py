@@ -236,6 +236,10 @@ class SparseLogisticRegression:
                 telemetry.step_timeline(
                     "sparse_logreg", step_no, samples=len(idx),
                     dispatch_s=time.perf_counter() - t_step)
+                telemetry.histogram(
+                    "app.step.seconds", telemetry.LATENCY_BUCKETS,
+                    app="sparse_logreg").observe(
+                    time.perf_counter() - t_step)
                 telemetry.beat()
                 step_no += 1
             loss = float(np.mean(losses))

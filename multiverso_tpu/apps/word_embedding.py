@@ -566,6 +566,9 @@ class WordEmbedding:
                                   core.place(lrs, mesh=self.mesh))
         telemetry.step_timeline("w2v", call_no, pairs=s * c.batch_size,
                                 dispatch_s=time.perf_counter() - t_step)
+        telemetry.histogram(
+            "app.step.seconds", telemetry.LATENCY_BUCKETS,
+            app="w2v").observe(time.perf_counter() - t_step)
         telemetry.beat()    # flight recorder: one heartbeat per dispatch
         self._step_no += s
         return loss

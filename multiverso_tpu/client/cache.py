@@ -32,11 +32,13 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Optional, Tuple
 
 import numpy as np
 
 from multiverso_tpu.telemetry import metrics as telemetry
+from multiverso_tpu.telemetry import trace as tracing
 from multiverso_tpu.utils.async_buffer import ASyncBuffer
 
 
@@ -62,19 +64,25 @@ class CachedView:
         self._lock = threading.Lock()
         self._closed = False
         lbl = f"{table.table_id}:{table.name}"
+        self._lbl = lbl
         self._m_hits = telemetry.counter("client.cache.hits", table=lbl)
         self._m_misses = telemetry.counter("client.cache.misses",
                                            table=lbl)
         self._m_staleness = telemetry.gauge("client.cache.staleness",
                                             table=lbl)
+        self._h_get = telemetry.histogram(
+            "client.get.seconds", telemetry.LATENCY_BUCKETS, table=lbl)
         # a view never serves nothing: first snapshot is synchronous
         self._gen, self._val = self._sync_snapshot()
-        # refresh pipeline: (generation, device future) handed to the
-        # worker, which only WAITS and copies (no program dispatch)
-        self._req: "queue.Queue[Optional[Tuple[int, Any]]]" = queue.Queue()
+        # refresh pipeline: (generation, device future, trace link)
+        # handed to the worker, which only WAITS and copies (no program
+        # dispatch)
+        self._req: "queue.Queue[Optional[Tuple[int, Any, Any]]]" = \
+            queue.Queue()
         self._inflight = False
         self._buf: Optional[ASyncBuffer] = (
-            ASyncBuffer(self._fill) if background else None)
+            ASyncBuffer(self._fill, name=f"view:{lbl}")
+            if background else None)
         table._attach_view(self)
 
     # -- snapshot machinery -----------------------------------------------
@@ -94,8 +102,12 @@ class CachedView:
         item = self._req.get()
         if item is None:                # close() sentinel
             return None
-        gen, fut = item
-        return gen, np.asarray(fut)
+        gen, fut, token = item
+        # the D2H wait chains to whatever request triggered the refresh
+        with tracing.adopt(token):
+            with tracing.span("client.d2h_wait", table=self._lbl,
+                              gen=gen):
+                return gen, np.asarray(fut)
 
     def _on_table_update(self) -> None:
         """Table hook, invoked on the table's dispatch thread right
@@ -109,7 +121,7 @@ class CachedView:
             return
         fut = self._table.get_jax()     # async dispatch, this thread
         self._inflight = True
-        self._req.put((gen, fut))
+        self._req.put((gen, fut, tracing.link()))
 
     def _absorb(self, snap: Optional[Tuple[int, np.ndarray]]) -> None:
         self._inflight = False
@@ -135,26 +147,34 @@ class CachedView:
         generations of the table. Non-blocking on the hit path; a read
         past the bound blocks on the in-flight refresh (or snapshots
         synchronously)."""
-        with self._lock:
-            cur = self._table.generation
-            if self._inflight and self._buf is not None:
-                snap = self._buf.poll()     # absorb a finished refresh
-                if snap is not None:
-                    self._absorb(snap)
-            stale = cur - self._gen
-            self._m_staleness.set(max(stale, 0))
-            if stale <= self.max_staleness:
-                self._m_hits.inc()
+        t0 = time.monotonic()
+        try:
+            with tracing.request("client.get", table=self._lbl), \
+                    self._lock:
+                cur = self._table.generation
+                if self._inflight and self._buf is not None:
+                    snap = self._buf.poll()  # absorb finished refresh
+                    if snap is not None:
+                        self._absorb(snap)
+                stale = cur - self._gen
+                self._m_staleness.set(max(stale, 0))
+                if stale <= self.max_staleness:
+                    self._m_hits.inc()
+                    return self._val
+                self._m_misses.inc()
+                if self._inflight and self._buf is not None:
+                    with tracing.span("client.d2h_wait",
+                                      table=self._lbl):
+                        self._absorb(self._buf.get())  # blocking wait
+                if cur - self._gen > self.max_staleness:
+                    # in-flight refresh was older than needed (or none
+                    # was running): snapshot here, on the reading
+                    # thread — for single-dispatcher apps this IS the
+                    # dispatch thread
+                    self._absorb(self._sync_snapshot())
                 return self._val
-            self._m_misses.inc()
-            if self._inflight and self._buf is not None:
-                self._absorb(self._buf.get())   # blocking D2H wait
-            if cur - self._gen > self.max_staleness:
-                # in-flight refresh was older than needed (or none was
-                # running): snapshot here, on the reading thread — for
-                # single-dispatcher apps this IS the dispatch thread
-                self._absorb(self._sync_snapshot())
-            return self._val
+        finally:
+            self._h_get.observe(time.monotonic() - t0)
 
     def refresh(self) -> np.ndarray:
         """Force an up-to-date snapshot (staleness 0 as of the call)."""

@@ -42,6 +42,7 @@ from typing import Any, List, Optional
 import numpy as np
 
 from multiverso_tpu.telemetry import metrics as telemetry
+from multiverso_tpu.telemetry import trace as tracing
 from multiverso_tpu.updaters import AddOption
 
 
@@ -55,9 +56,13 @@ class PendingHandle:
     this delta's flush has been applied.
     """
 
-    def __init__(self, buffer: "CoalescingBuffer", ticket: int) -> None:
+    def __init__(self, buffer: "CoalescingBuffer", ticket: int,
+                 request_id: Optional[str] = None) -> None:
         self._buffer = buffer
         self._ticket = ticket
+        #: request id minted by the buffered add this handle tracks —
+        #: ``wait()`` re-enters that request's trace tree
+        self.request_id = request_id
 
     def flushed(self) -> bool:
         """True once the flush carrying this delta has been dispatched."""
@@ -72,10 +77,15 @@ class PendingHandle:
         return h is not None and h.done()
 
     def wait(self) -> Any:
-        self._buffer.flush_through(self._ticket)
-        h = self._buffer._last_handle
-        assert h is not None
-        return h.wait()
+        # re-enter this delta's request scope: the wait span (and the
+        # flush it may force) chain to the add that minted the id
+        with tracing.adopt((self.request_id, None)
+                           if self.request_id else None):
+            with tracing.span("client.wait"):
+                self._buffer.flush_through(self._ticket)
+                h = self._buffer._last_handle
+                assert h is not None
+                return h.wait()
 
     def result(self) -> Any:
         return self.wait()
@@ -121,12 +131,20 @@ class CoalescingBuffer:
         self._flush_gen = 0
         self._last_handle = None
         lbl = f"{table.table_id}:{table.name}"
+        self._lbl = lbl
         self._m_flushes = telemetry.counter("client.coalesce.flushes",
                                             table=lbl)
         self._m_deltas = telemetry.counter("client.coalesce.deltas",
                                            table=lbl)
         self._m_bytes = telemetry.counter("client.coalesce.bytes",
                                           table=lbl)
+        self._h_flush = telemetry.histogram(
+            "client.flush.seconds", telemetry.LATENCY_BUCKETS, table=lbl)
+        # occupancy as a queue gauge: buffered-delta count + group age
+        self._qg = telemetry.QueueGauges(f"coalesce:{lbl}")
+        # request ids riding the open group (stamped onto the flush
+        # span — a coalesced flush serves MANY requests)
+        self._req_ids: List[str] = []
         table._attach_coalescer(self)
 
     # -- state -------------------------------------------------------------
@@ -162,6 +180,12 @@ class CoalescingBuffer:
         self._bytes += int(nbytes)
         self._m_deltas.inc()
         self._m_bytes.inc(int(nbytes))
+        rid = tracing.current_request()
+        if rid is not None:
+            self._req_ids.append(rid)
+        self._qg.sample(self._count,
+                        time.monotonic() - self._first_ts
+                        if self._first_ts is not None else 0.0)
         return self._flush_gen
 
     def _maybe_flush_locked(self) -> None:
@@ -181,7 +205,8 @@ class CoalescingBuffer:
         """Buffer a whole-table dense delta (``Table.add`` shape rules:
         logical or padded)."""
         arr = np.asarray(delta, dtype=self._table.dtype)
-        with self._lock:
+        with tracing.request("client.add", table=self._lbl,
+                             kind="dense") as rid, self._lock:
             self._start_group("dense", option)
             if self._acc is None:
                 self._acc = arr.copy()
@@ -193,7 +218,7 @@ class CoalescingBuffer:
                 self._acc += arr
             ticket = self._buffered(arr.nbytes)
             self._maybe_flush_locked()
-            return PendingHandle(self, ticket)
+            return PendingHandle(self, ticket, rid)
 
     def add_kv(self, keys: Any, deltas: Any,
                option: Optional[AddOption] = None) -> PendingHandle:
@@ -204,13 +229,14 @@ class CoalescingBuffer:
         if len(deltas) != len(keys):
             raise ValueError(f"deltas length {len(deltas)} != keys "
                              f"length {len(keys)}")
-        with self._lock:
+        with tracing.request("client.add", table=self._lbl,
+                             kind="kv") as rid, self._lock:
             self._start_group("kv", option)
             self._ids.append(keys)
             self._deltas.append(deltas)
             ticket = self._buffered(deltas.nbytes)
             self._maybe_flush_locked()
-            return PendingHandle(self, ticket)
+            return PendingHandle(self, ticket, rid)
 
     def add_rows(self, row_ids: Any, deltas: Any,
                  option: Optional[AddOption] = None) -> PendingHandle:
@@ -222,13 +248,14 @@ class CoalescingBuffer:
         if deltas.shape != (len(ids), self._table.num_cols):
             raise ValueError(f"deltas shape {deltas.shape} != "
                              f"({len(ids)}, {self._table.num_cols})")
-        with self._lock:
+        with tracing.request("client.add", table=self._lbl,
+                             kind="rows") as rid, self._lock:
             self._start_group("rows", option)
             self._ids.append(ids)
             self._deltas.append(deltas)
             ticket = self._buffered(deltas.nbytes)
             self._maybe_flush_locked()
-            return PendingHandle(self, ticket)
+            return PendingHandle(self, ticket, rid)
 
     def add_sparse(self, rows: Any, cols: Any, values: Any,
                    option: Optional[AddOption] = None) -> PendingHandle:
@@ -240,14 +267,15 @@ class CoalescingBuffer:
         if not (rows.shape == cols.shape == values.shape) \
                 or rows.ndim != 1:
             raise ValueError("COO arrays must be same-length 1-D")
-        with self._lock:
+        with tracing.request("client.add", table=self._lbl,
+                             kind="coo") as rid, self._lock:
             self._start_group("coo", option)
             # flat (row, col) key — split back at flush
             self._ids.append(rows * self._table.num_cols + cols)
             self._deltas.append(values)
             ticket = self._buffered(values.nbytes)
             self._maybe_flush_locked()
-            return PendingHandle(self, ticket)
+            return PendingHandle(self, ticket, rid)
 
     # -- flush -------------------------------------------------------------
 
@@ -265,26 +293,34 @@ class CoalescingBuffer:
         if self._count == 0:
             return None
         kind, opt = self._kind, self._option
-        if kind == "dense":
-            handle = self._table.add(self._acc, opt)
-        elif kind == "kv":
-            uniq, summed = self._summed_unique()
-            handle = self._table.add(uniq, summed, opt)
-        elif kind == "rows":
-            uniq, summed = self._summed_unique()
-            handle = self._table.add_rows(uniq.astype(np.int32), summed,
-                                          opt)
-        else:   # coo
-            uniq, summed = self._summed_unique()
-            ncols = self._table.num_cols
-            handle = self._table.add_sparse(
-                (uniq // ncols).astype(np.int32),
-                (uniq % ncols).astype(np.int32), summed, opt)
+        t0 = time.monotonic()
+        # one flush serves MANY requests: the span lists every request
+        # id that buffered into this group
+        with tracing.span("client.flush", table=self._lbl, kind=kind,
+                          n=self._count, reqs=list(self._req_ids)):
+            if kind == "dense":
+                handle = self._table.add(self._acc, opt)
+            elif kind == "kv":
+                uniq, summed = self._summed_unique()
+                handle = self._table.add(uniq, summed, opt)
+            elif kind == "rows":
+                uniq, summed = self._summed_unique()
+                handle = self._table.add_rows(uniq.astype(np.int32),
+                                              summed, opt)
+            else:   # coo
+                uniq, summed = self._summed_unique()
+                ncols = self._table.num_cols
+                handle = self._table.add_sparse(
+                    (uniq // ncols).astype(np.int32),
+                    (uniq % ncols).astype(np.int32), summed, opt)
+        self._h_flush.observe(time.monotonic() - t0)
         self._acc = None
         self._ids, self._deltas = [], []
+        self._req_ids = []
         self._count = 0
         self._bytes = 0
         self._first_ts = None
+        self._qg.sample(0, 0.0)
         self._flush_gen += 1
         self._last_handle = handle
         self._m_flushes.inc()

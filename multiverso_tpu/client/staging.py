@@ -25,6 +25,7 @@ import threading
 from typing import Any, Iterable, Optional, Tuple
 
 from multiverso_tpu.telemetry import metrics as telemetry
+from multiverso_tpu.telemetry import trace as tracing
 from multiverso_tpu.updaters import AddOption
 
 
@@ -55,10 +56,12 @@ class KVStagingWriter:
         self._last_handle = None
         self._closed = False
         lbl = f"{table.table_id}:{table.name}"
+        self._lbl = lbl
         self._m_batches = telemetry.counter("client.stage.batches",
                                             table=lbl)
         self._m_inflight = telemetry.gauge("client.stage.inflight",
                                            table=lbl)
+        self._qg = telemetry.QueueGauges(f"stage:{lbl}")
         self._thread = threading.Thread(target=self._work, daemon=True)
         self._thread.start()
 
@@ -67,21 +70,32 @@ class KVStagingWriter:
             item = self._req.get()
             if item is None:
                 return
-            keys, deltas, option = item
+            keys, deltas, option, token = item
+            self._qg.on_take()
             try:
-                prepared = self._table.prepare_add(keys, deltas, option)
-                self._ready.put((prepared, None))
+                # the off-thread prep chains to the add that submitted it
+                with tracing.adopt(token):
+                    with tracing.span("client.stage_prepare",
+                                      table=self._lbl):
+                        prepared = self._table.prepare_add(keys, deltas,
+                                                           option)
+                self._ready.put((prepared, None, token))
             except BaseException as exc:    # surfaces on the caller side
-                self._ready.put((None, exc))
+                self._ready.put((None, exc, token))
 
     def _land(self, item: Tuple) -> None:
         """Dispatch one prepared batch on the caller's thread."""
-        prepared, exc = item
+        prepared, exc, token = item
         self._inflight -= 1
         self._m_inflight.set(self._inflight)
         if exc is not None:
             raise exc
-        self._last_handle = self._table.add_prepared(prepared)
+        # the dispatch chains to the batch's ORIGINAL request, not to
+        # whichever later add happened to drain it
+        with tracing.adopt(token):
+            with tracing.span("client.stage_dispatch",
+                              table=self._lbl):
+                self._last_handle = self._table.add_prepared(prepared)
 
     def add(self, keys: Any, deltas: Any,
             option: Optional[AddOption] = None) -> None:
@@ -89,20 +103,23 @@ class KVStagingWriter:
         dispatch on the next add/flush once its H2D lands)."""
         if self._closed:
             raise RuntimeError("KVStagingWriter already closed")
-        self._req.put((keys, deltas,
-                       option if option is not None else self._option))
-        self._inflight += 1
-        self._m_batches.inc()
-        self._m_inflight.set(self._inflight)
-        # dispatch whatever prep already finished (non-blocking) ...
-        while True:
-            try:
-                self._land(self._ready.get_nowait())
-            except queue.Empty:
-                break
-        # ... then apply the depth bound (blocking)
-        while self._inflight > self._depth:
-            self._land(self._ready.get())
+        with tracing.request("client.stage_add", table=self._lbl):
+            self._req.put((keys, deltas,
+                           option if option is not None
+                           else self._option, tracing.link()))
+            self._qg.on_put()
+            self._inflight += 1
+            self._m_batches.inc()
+            self._m_inflight.set(self._inflight)
+            # dispatch whatever prep already finished (non-blocking) ...
+            while True:
+                try:
+                    self._land(self._ready.get_nowait())
+                except queue.Empty:
+                    break
+            # ... then apply the depth bound (blocking)
+            while self._inflight > self._depth:
+                self._land(self._ready.get())
 
     def flush(self):
         """Drain the pipeline; returns the last dispatched batch's table

@@ -140,6 +140,14 @@ def init(argv: Optional[Sequence[str]] = None, *,
         from multiverso_tpu.ft.chaos import chaos_from_env
         chaos_from_env()
 
+        # observability rides init the same way: MVTPU_STATUSZ_PORT
+        # arms the live introspection server, MVTPU_SLO the tail-
+        # latency monitor (both idempotent across re-inits)
+        from multiverso_tpu.telemetry.slo import maybe_slo_monitor
+        from multiverso_tpu.telemetry.statusz import maybe_statusz
+        maybe_statusz()
+        maybe_slo_monitor()
+
         devs = list(devices) if devices is not None else jax.devices()
         dp = data_parallel if data_parallel is not None \
             else configure.get_flag("data_parallel")

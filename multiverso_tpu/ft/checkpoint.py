@@ -67,6 +67,7 @@ from multiverso_tpu.ft.chaos import chaos_point
 from multiverso_tpu.ft.retry import RetryPolicy, io_retry_policy
 from multiverso_tpu.io import open_stream
 from multiverso_tpu.telemetry import metrics as telemetry
+from multiverso_tpu.telemetry import trace as tracing
 from multiverso_tpu.utils import log
 
 RUN_MAGIC = "multiverso_tpu.run_ckpt.v1"
@@ -169,6 +170,7 @@ class RunCheckpointManager:
         self._error: Optional[BaseException] = None
         self._q: "queue.Queue[Optional[Tuple[int, list]]]" = \
             queue.Queue(maxsize=2)      # backpressure: at most 2 queued
+        self._qg = telemetry.QueueGauges("ckpt")
         self._worker: Optional[threading.Thread] = None
         if background:
             self._worker = threading.Thread(
@@ -229,6 +231,7 @@ class RunCheckpointManager:
             self._write_generation(*job)
         else:
             self._q.put(job)
+            self._qg.on_put()
         self._last_saved_step = step
 
     def _table_export(self, t: Any) -> Callable[[], tuple]:
@@ -269,6 +272,7 @@ class RunCheckpointManager:
             job = self._q.get()
             if job is None:
                 return
+            self._qg.on_take()
             try:
                 self._write_generation(*job)
             except BaseException as exc:   # surfaced on next save/flush
@@ -286,37 +290,45 @@ class RunCheckpointManager:
         tables_map: Dict[str, str] = {}
         app_file: Optional[str] = None
         total = 0
-        for name, fname, finish in entries:
-            manifest, payload = finish()    # blocking D2H waits here
-            nbytes = int(sum(a.nbytes for a in payload.values()))
-            savez_stream(os.path.join(gen_dir, fname), manifest, payload)
-            files[fname] = nbytes
-            total += nbytes
-            if name:
-                tables_map[name] = fname
-            else:
-                app_file = fname
-        manifest = {
-            "magic": RUN_MAGIC,
-            "step": step,
-            "fingerprint": self.fingerprint,
-            "tables": tables_map,
-            "app": app_file,
-            "files": files,
-            "unix_time": time.time(),
-            "host": telemetry.host_index(),
-        }
-        # the commit: manifest lands atomically (temp+rename), LAST —
-        # everything before this point is an incomplete generation the
-        # resume scan ignores
-        chaos_point("ckpt.commit")
-        payload_json = json.dumps(manifest, indent=1).encode()
+        with tracing.span("ckpt.write", step=step,
+                          n_entries=len(entries)):
+            for name, fname, finish in entries:
+                manifest, payload = finish()  # blocking D2H waits here
+                nbytes = int(sum(a.nbytes for a in payload.values()))
+                savez_stream(os.path.join(gen_dir, fname), manifest,
+                             payload)
+                files[fname] = nbytes
+                total += nbytes
+                if name:
+                    tables_map[name] = fname
+                else:
+                    app_file = fname
+            manifest = {
+                "magic": RUN_MAGIC,
+                "step": step,
+                "fingerprint": self.fingerprint,
+                "tables": tables_map,
+                "app": app_file,
+                "files": files,
+                "unix_time": time.time(),
+                "host": telemetry.host_index(),
+            }
+            # the commit: manifest lands atomically (temp+rename), LAST
+            # — everything before this point is an incomplete
+            # generation the resume scan ignores
+            chaos_point("ckpt.commit")
+            payload_json = json.dumps(manifest, indent=1).encode()
 
-        def commit():
-            with open_stream(os.path.join(gen_dir, MANIFEST_NAME),
-                             "wb") as s:
-                s.write(payload_json)
-        self._policy.call(commit)
+            def commit():
+                with open_stream(os.path.join(gen_dir, MANIFEST_NAME),
+                                 "wb") as s:
+                    s.write(payload_json)
+            tc = time.monotonic()
+            with tracing.span("ckpt.commit", step=step):
+                self._policy.call(commit)
+            telemetry.histogram("ckpt.commit.seconds",
+                                telemetry.LATENCY_BUCKETS).observe(
+                time.monotonic() - tc)
         dt = time.perf_counter() - t0
         telemetry.counter("ckpt.store.ops").inc()
         telemetry.histogram("ckpt.store.seconds").observe(dt)

@@ -95,6 +95,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 from multiverso_tpu.telemetry import metrics as _metrics
+from multiverso_tpu.telemetry import trace as _trace
 from multiverso_tpu.updaters import AddOption
 from multiverso_tpu.utils import log
 from multiverso_tpu.utils.jax_compat import shard_map
@@ -183,6 +184,19 @@ class KernelEngine:
         #: lane-slice-accepting adapter, so the layout survives the
         #: fallback and host prep never has to re-shape mid-stream.
         self.layout = layout
+        self._note_selected()
+
+    def _note_selected(self, prev: Optional[str] = None) -> None:
+        """Publish the live selection as a gauge (the /statusz kernel
+        table); a runtime fallback flips the old label off so the
+        statusz view shows ONE live engine per kernel."""
+        if prev is not None:
+            _metrics.registry().gauge(
+                "kernels.selected", kernel=self.name, engine=prev,
+                layout=self.layout).set(0)
+        _metrics.registry().gauge(
+            "kernels.selected", kernel=self.name, engine=self.engine,
+            layout=self.layout).set(1)
 
     @property
     def engine(self) -> str:
@@ -190,16 +204,23 @@ class KernelEngine:
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
         if self._pallas is None:
-            return self._xla(*args, **kwargs)
+            with _trace.span(f"kernel.{self.name}", engine="xla",
+                             layout=self.layout):
+                return self._xla(*args, **kwargs)
         try:
-            return self._pallas(*args, **kwargs)
+            with _trace.span(f"kernel.{self.name}", engine="pallas",
+                             layout=self.layout):
+                return self._pallas(*args, **kwargs)
         except Exception as e:
             # lowering/compile failures surface here BEFORE execution
             # (so the donated operands are still alive for the retry);
             # flip to XLA for good — correctness over metrics
             self._pallas = None
             _note_fallback(self.name, "error", e)
-            return self._xla(*args, **kwargs)
+            self._note_selected(prev="pallas")
+            with _trace.span(f"kernel.{self.name}", engine="xla",
+                             layout=self.layout):
+                return self._xla(*args, **kwargs)
 
     # AOT passthrough, matching _ProfiledJit's debugging surface
     def lower(self, *args: Any, **kwargs: Any):

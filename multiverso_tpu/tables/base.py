@@ -30,6 +30,7 @@ from __future__ import annotations
 import io
 import json
 import threading
+import time
 import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -42,6 +43,7 @@ from multiverso_tpu import core
 from multiverso_tpu.ft.chaos import chaos_point
 from multiverso_tpu.io import open_stream
 from multiverso_tpu.telemetry import metrics as telemetry
+from multiverso_tpu.telemetry import trace as tracing
 from multiverso_tpu.telemetry.profiling import profiled_jit
 from multiverso_tpu.updaters import (AddOption, Updater, get_updater,
                                      resolve_default_option)
@@ -308,6 +310,13 @@ class Table:
         # (tables that never checkpoint pay nothing)
         self._export_copy = None
         self.table_id = _register(self)
+        lbl = f"{self.table_id}:{self.name}"
+        # tail-latency histograms over the dispatch paths (the SLO
+        # monitor's table.{get,add}.p99 targets)
+        self._h_get = telemetry.histogram(
+            "table.get.seconds", telemetry.LATENCY_BUCKETS, table=lbl)
+        self._h_add = telemetry.histogram(
+            "table.add.seconds", telemetry.LATENCY_BUCKETS, table=lbl)
         log.debug("table %r id=%d shape=%s padded=%s updater=%s", name,
                   self.table_id, self.logical_shape, self.padded_shape,
                   self.updater.name)
@@ -431,10 +440,15 @@ class Table:
         zero-copy view would be invalidated by the next update.
         """
         chaos_point("table.get")
-        elems = int(np.prod(self.logical_shape)) if self.logical_shape \
-            else 1
-        self._record_op("get", elems, elems * self.dtype.itemsize)
-        return self._snapshot(self.param)
+        t0 = time.monotonic()
+        with tracing.span("table.get",
+                          table=f"{self.table_id}:{self.name}"):
+            elems = int(np.prod(self.logical_shape)) \
+                if self.logical_shape else 1
+            self._record_op("get", elems, elems * self.dtype.itemsize)
+            out = self._snapshot(self.param)
+        self._h_get.observe(time.monotonic() - t0)
+        return out
 
     def get(self) -> np.ndarray:
         """Whole-table fetch to host (``WorkerTable::Get``)."""
@@ -456,32 +470,38 @@ class Table:
         blocking Add.
         """
         chaos_point("table.add")
-        if isinstance(delta, jax.Array):
-            if delta.shape == self.logical_shape \
-                    and self.logical_shape != self.padded_shape:
-                pad = [(0, p - l) for p, l in zip(self.padded_shape,
-                                                  delta.shape)]
-                delta = jnp.pad(delta, pad)
-            elif delta.shape != self.padded_shape:
-                if delta.shape != self.logical_shape:
-                    raise ValueError(
-                        f"table {self.name!r}: delta shape {delta.shape} != "
-                        f"table shape {self.logical_shape}")
-        else:
-            delta = self._pad(np.asarray(delta))
-        if self.storage_shape != self.padded_shape:
-            # re-tiled storage layouts (SparseMatrixTable tiled=True):
-            # same elements, physical tile-aligned shape
-            delta = delta.reshape(self.storage_shape)
-        elems = int(np.prod(self.logical_shape)) if self.logical_shape \
-            else 1
-        self._record_op("add", elems, elems * self.dtype.itemsize)
-        opt = self._resolve_option(option)
-        self.param, self.state = self._apply(self.param, self.state,
-                                             delta, opt)
-        handle = Handle(table=self, generation=self._bump_step())
-        if sync:
-            handle.wait()
+        t0 = time.monotonic()
+        with tracing.span("table.add",
+                          table=f"{self.table_id}:{self.name}",
+                          sync=sync):
+            if isinstance(delta, jax.Array):
+                if delta.shape == self.logical_shape \
+                        and self.logical_shape != self.padded_shape:
+                    pad = [(0, p - l) for p, l in zip(self.padded_shape,
+                                                      delta.shape)]
+                    delta = jnp.pad(delta, pad)
+                elif delta.shape != self.padded_shape:
+                    if delta.shape != self.logical_shape:
+                        raise ValueError(
+                            f"table {self.name!r}: delta shape "
+                            f"{delta.shape} != table shape "
+                            f"{self.logical_shape}")
+            else:
+                delta = self._pad(np.asarray(delta))
+            if self.storage_shape != self.padded_shape:
+                # re-tiled storage layouts (SparseMatrixTable
+                # tiled=True): same elements, tile-aligned shape
+                delta = delta.reshape(self.storage_shape)
+            elems = int(np.prod(self.logical_shape)) \
+                if self.logical_shape else 1
+            self._record_op("add", elems, elems * self.dtype.itemsize)
+            opt = self._resolve_option(option)
+            self.param, self.state = self._apply(self.param, self.state,
+                                                 delta, opt)
+            handle = Handle(table=self, generation=self._bump_step())
+            if sync:
+                handle.wait()
+        self._h_add.observe(time.monotonic() - t0)
         return handle
 
     add_async = add

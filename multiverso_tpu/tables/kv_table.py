@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from functools import partial
 from typing import Any, Optional, Tuple
 
@@ -50,6 +51,8 @@ from multiverso_tpu.tables.base import (Handle, Table, _register,
 from multiverso_tpu.tables.hashing import (EMPTY_KEY, _bucket, _hash_u64,
                                            _join_keys, _split_keys,
                                            shard_lane_slices)
+from multiverso_tpu.telemetry import metrics as telemetry
+from multiverso_tpu.telemetry import trace as tracing
 from multiverso_tpu.telemetry.profiling import profiled_jit
 from multiverso_tpu.updaters import (AddOption, get_updater,
                                      resolve_default_option)
@@ -151,6 +154,11 @@ class KVTable:
         # checkpoint-export copier, built lazily on the first export
         self._export_copy = None
         self.table_id = _register(self)  # type: ignore[arg-type]
+        lbl = f"{self.table_id}:{self.name}"
+        self._h_get = telemetry.histogram(
+            "table.get.seconds", telemetry.LATENCY_BUCKETS, table=lbl)
+        self._h_add = telemetry.histogram(
+            "table.add.seconds", telemetry.LATENCY_BUCKETS, table=lbl)
         log.debug("kv table %r: %d buckets x %d slots (capacity %d)",
                   name, self.num_buckets, self.slots, self.capacity)
 
@@ -416,21 +424,28 @@ class KVTable:
         self._check_overflow()
         keys = self._check_keys(keys)
         n = len(keys)
-        elems = n * max(self.value_dim, 1)
-        self._record_op("get", elems, elems * self.dtype.itemsize)
-        if self._lookup.layout == "sharded":
-            return self._get_jax_sharded(keys, n)
-        b = _bucket(n)
-        query = np.full((b, 2), 0xFFFFFFFF, np.uint32)
-        query[:n] = _split_keys(keys)
-        buckets = np.zeros(b, np.int32)
-        buckets[:n] = self._buckets_of(keys)
-        vals, found = self._lookup(
-            self.keys, self.values,
-            core.place(query, mesh=self.mesh),
-            core.place(buckets, mesh=self.mesh))
-        if b != n:      # padding lanes (sentinel query) sliced away
-            vals, found = vals[:n], found[:n]
+        t0 = time.monotonic()
+        with tracing.span("table.get",
+                          table=f"{self.table_id}:{self.name}", n=n,
+                          engine=self._lookup.engine):
+            elems = n * max(self.value_dim, 1)
+            self._record_op("get", elems, elems * self.dtype.itemsize)
+            if self._lookup.layout == "sharded":
+                out = self._get_jax_sharded(keys, n)
+                self._h_get.observe(time.monotonic() - t0)
+                return out
+            b = _bucket(n)
+            query = np.full((b, 2), 0xFFFFFFFF, np.uint32)
+            query[:n] = _split_keys(keys)
+            buckets = np.zeros(b, np.int32)
+            buckets[:n] = self._buckets_of(keys)
+            vals, found = self._lookup(
+                self.keys, self.values,
+                core.place(query, mesh=self.mesh),
+                core.place(buckets, mesh=self.mesh))
+            if b != n:  # padding lanes (sentinel query) sliced away
+                vals, found = vals[:n], found[:n]
+        self._h_get.observe(time.monotonic() - t0)
         return vals, found
 
     def _get_jax_sharded(self, keys: np.ndarray, n: int):
@@ -558,22 +573,27 @@ class KVTable:
         fused probe+updater program. Must run on the thread that owns
         the table (it swaps the live buffers)."""
         self._poll_overflow()
-        self._record_op("add", prepared.elems, prepared.nbytes)
-        self.keys, self.values, self.state, n_over = \
-            self._probe_update(
-                self.keys, self.values, self.state, prepared.buckets,
-                prepared.query, prepared.deltas, prepared.valid,
-                prepared.option)
-        self._pending_over.append(n_over)
-        with self._option_lock:
-            self.default_option.step += 1
-            self.generation += 1
-            gen = self.generation
-        self._notify_views()
-        handle = Handle(table=self, generation=gen)
-        if sync:
-            handle.wait()
-            self._check_overflow()
+        t0 = time.monotonic()
+        with tracing.span("table.add",
+                          table=f"{self.table_id}:{self.name}",
+                          engine=self._probe_update.engine, sync=sync):
+            self._record_op("add", prepared.elems, prepared.nbytes)
+            self.keys, self.values, self.state, n_over = \
+                self._probe_update(
+                    self.keys, self.values, self.state,
+                    prepared.buckets, prepared.query, prepared.deltas,
+                    prepared.valid, prepared.option)
+            self._pending_over.append(n_over)
+            with self._option_lock:
+                self.default_option.step += 1
+                self.generation += 1
+                gen = self.generation
+            self._notify_views()
+            handle = Handle(table=self, generation=gen)
+            if sync:
+                handle.wait()
+                self._check_overflow()
+        self._h_add.observe(time.monotonic() - t0)
         return handle
 
     def add(self, keys, deltas, option: Optional[AddOption] = None,

@@ -14,9 +14,18 @@ and a report CLI.
   (single-host fallback: local only).
 - :mod:`multiverso_tpu.telemetry.watchdog` — the flight recorder's
   stall side: heartbeat :class:`Watchdog` (+ module-level :func:`beat`)
-  that dumps all-thread stacks, a metrics snapshot, and the trace tail
-  into ``MVTPU_DUMP_DIR`` on a missed deadline, then optionally
-  self-terminates (``MVTPU_WATCHDOG_ACTION``).
+  that dumps all-thread stacks, a metrics snapshot, queue gauges, SLO
+  violations, and the trace tail into ``MVTPU_DUMP_DIR`` on a missed
+  deadline, then optionally self-terminates
+  (``MVTPU_WATCHDOG_ACTION``).
+- :mod:`multiverso_tpu.telemetry.statusz` — live introspection over
+  stdlib HTTP (``MVTPU_STATUSZ_PORT``): ``/metrics`` (Prometheus),
+  ``/healthz`` (watchdog heartbeats), ``/statusz`` (topology, tables,
+  kernel engines, checkpoints, queues), ``/trace`` (span tail).
+- :mod:`multiverso_tpu.telemetry.slo` — declarative tail-latency SLO
+  rules (``MVTPU_SLO=table.add.p99<5ms,...``) evaluated on snapshot
+  cadence; violations counted and escalated through the watchdog
+  warn → dump path.
 - :mod:`multiverso_tpu.telemetry.profiling` — the compile side:
   :func:`profiled_jit` (lowering/compile wall time + XLA cost/memory
   analysis per jitted function), :func:`record_device_memory`
@@ -35,26 +44,47 @@ from multiverso_tpu.telemetry import (aggregate, metrics, profiling,
 from multiverso_tpu.telemetry.aggregate import (fleet_snapshot,
                                                 gather_metrics,
                                                 merge_snapshots)
-from multiverso_tpu.telemetry.metrics import (Counter, Gauge, Histogram,
-                                              MetricRegistry, counter,
+from multiverso_tpu.telemetry.metrics import (LATENCY_BUCKETS, Counter,
+                                              Gauge, Histogram,
+                                              MetricRegistry,
+                                              QueueGauges, counter,
                                               emit, gauge, histogram,
-                                              host_index, registry,
-                                              snapshot, write_snapshot)
+                                              host_index,
+                                              log_spaced_bounds,
+                                              registry, snapshot,
+                                              snapshot_quantile,
+                                              write_snapshot)
 from multiverso_tpu.telemetry.profiling import (profile_window,
                                                 profiled_jit,
                                                 record_device_memory)
-from multiverso_tpu.telemetry.trace import (read_trace, set_trace_file,
-                                            span, step_timeline)
-from multiverso_tpu.telemetry.watchdog import (Watchdog, beat,
+from multiverso_tpu.telemetry.trace import (adopt, current_request,
+                                            link, new_request_id,
+                                            read_trace, request,
+                                            set_trace_file, span,
+                                            step_timeline)
+from multiverso_tpu.telemetry.watchdog import (Watchdog,
+                                               active_watchdogs, beat,
                                                maybe_watchdog)
+# statusz/slo import AFTER the siblings above: they resolve metrics/
+# trace/watchdog through the already-bound package attributes
+from multiverso_tpu.telemetry import slo, statusz
+from multiverso_tpu.telemetry.slo import SloMonitor, maybe_slo_monitor
+from multiverso_tpu.telemetry.statusz import (StatuszServer,
+                                              maybe_statusz,
+                                              publish_fleet)
 
 __all__ = [
-    "aggregate", "metrics", "profiling", "trace", "watchdog",
-    "Counter", "Gauge", "Histogram", "MetricRegistry",
+    "aggregate", "metrics", "profiling", "slo", "statusz", "trace",
+    "watchdog",
+    "Counter", "Gauge", "Histogram", "MetricRegistry", "QueueGauges",
+    "LATENCY_BUCKETS", "log_spaced_bounds", "snapshot_quantile",
     "counter", "gauge", "histogram", "emit", "host_index", "registry",
     "snapshot", "write_snapshot",
     "span", "step_timeline", "set_trace_file", "read_trace",
+    "request", "new_request_id", "current_request", "link", "adopt",
     "gather_metrics", "merge_snapshots", "fleet_snapshot",
-    "Watchdog", "beat", "maybe_watchdog",
+    "Watchdog", "beat", "maybe_watchdog", "active_watchdogs",
+    "SloMonitor", "maybe_slo_monitor",
+    "StatuszServer", "maybe_statusz", "publish_fleet",
     "profiled_jit", "profile_window", "record_device_memory",
 ]

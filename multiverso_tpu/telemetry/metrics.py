@@ -30,16 +30,99 @@ from __future__ import annotations
 
 import bisect
 import json
+import math
 import os
 import threading
 import time
-from typing import Dict, List, Optional, TextIO, Tuple
+from collections import deque
+from typing import Deque, Dict, List, Optional, TextIO, Tuple
 
 SNAPSHOT_KIND = "mvtpu.metrics.v1"
 
 # latency-shaped default bounds (seconds): 100µs .. 100s, half-decade
 DEFAULT_BUCKETS = (1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 1e-1, 3e-1,
                    1.0, 3.0, 10.0, 30.0, 100.0)
+
+
+def log_spaced_bounds(lo: float = 1e-5, hi: float = 100.0,
+                      per_decade: int = 4) -> Tuple[float, ...]:
+    """Geometric (HDR-style) histogram bounds: ``per_decade`` buckets
+    per decade from ``lo`` to ``hi`` inclusive. Deterministic arithmetic
+    so every host of a fleet builds IDENTICAL bounds (cross-host merges
+    require bucket-for-bucket agreement)."""
+    if not (0 < lo < hi) or per_decade < 1:
+        raise ValueError(f"log_spaced_bounds({lo}, {hi}, {per_decade}): "
+                         "need 0 < lo < hi and per_decade >= 1")
+    n = round(math.log10(hi / lo) * per_decade)
+    return tuple(lo * 10.0 ** (i / per_decade) for i in range(n + 1))
+
+
+# tail-latency bounds (seconds): 10µs .. 100s, quarter-decade — tight
+# enough that p999 extraction stays within ~78% relative bucket error,
+# the HDR trade every serving stack makes. New latency histograms use
+# these; DEFAULT_BUCKETS is frozen (pre-existing histograms already
+# merge across hosts on those bounds).
+LATENCY_BUCKETS = log_spaced_bounds(1e-5, 100.0, 4)
+
+
+def quantile_from_counts(bounds, counts, count: int,
+                         q: float) -> Optional[float]:
+    """Quantile ``q`` (0..1) from fixed-bucket state, linearly
+    interpolated within the holding bucket (bucket 0 interpolates from
+    0; the overflow bucket clamps to the last bound — exact values are
+    gone, the bound is the honest answer). ``None`` when empty — a
+    quantile of nothing is not 0. Shared by :meth:`Histogram.quantile`
+    and snapshot-dict consumers (report CLI, SLO monitor, statusz)."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile q={q} outside [0, 1]")
+    if not count:
+        return None
+    rank = q * count
+    acc = 0.0
+    for i, c in enumerate(counts):
+        if not c:
+            continue
+        if acc + c >= rank:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i] if i < len(bounds) else bounds[-1]
+            if hi <= lo:
+                return float(hi)
+            return float(lo + (hi - lo) * max(rank - acc, 0.0) / c)
+        acc += c
+    return float(bounds[-1])
+
+
+def snapshot_quantile(hist: dict, q: float) -> Optional[float]:
+    """:func:`quantile_from_counts` over one snapshot histogram dict
+    (``{"bounds", "counts", "count", "sum"}``)."""
+    return quantile_from_counts(hist["bounds"], hist["counts"],
+                                hist["count"], q)
+
+
+def sink_max_bytes() -> int:
+    """``MVTPU_TRACE_MAX_MB`` as bytes (0/unset/invalid = unbounded):
+    the size cap BOTH JSONL sinks (span trace and metric events) rotate
+    at — a multi-hour serving run must not fill the disk. Read per
+    write so tests (and live operators) can flip it without reopening
+    sinks."""
+    try:
+        mb = float(os.environ.get("MVTPU_TRACE_MAX_MB", "0") or "0")
+    except ValueError:
+        return 0
+    return int(mb * 1e6) if mb > 0 else 0
+
+
+def rotate_jsonl(path: str, f: TextIO) -> TextIO:
+    """Keep-1 rollover: close ``f``, move ``path`` to ``path + ".1"``
+    (clobbering the previous rollover), reopen fresh. Disk ceiling is
+    therefore ~2x the cap; the most recent events are always in
+    ``path``."""
+    f.close()
+    try:
+        os.replace(path, path + ".1")
+    except OSError:
+        pass          # losing the rollover beats losing the live sink
+    return open(path, "a", buffering=1)
 
 LabelItems = Tuple[Tuple[str, str], ...]
 
@@ -143,6 +226,25 @@ class Histogram:
     def mean(self) -> float:
         return self.sum / self.count if self.count else 0.0
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Interpolated quantile (see :func:`quantile_from_counts`);
+        ``None`` while empty."""
+        with self._lock:
+            counts, count = list(self.counts), self.count
+        return quantile_from_counts(self.bounds, counts, count, q)
+
+    @property
+    def p50(self) -> Optional[float]:
+        return self.quantile(0.50)
+
+    @property
+    def p99(self) -> Optional[float]:
+        return self.quantile(0.99)
+
+    @property
+    def p999(self) -> Optional[float]:
+        return self.quantile(0.999)
+
 
 class MetricRegistry:
     """Process-wide typed-metric registry (get-or-create by
@@ -202,6 +304,11 @@ class MetricRegistry:
             if self._jsonl is not None:
                 self._jsonl.write(json.dumps(rec) + "\n")
                 self._jsonl.flush()
+                limit = sink_max_bytes()
+                if limit and self._jsonl_path \
+                        and self._jsonl.tell() >= limit:
+                    self._jsonl = rotate_jsonl(self._jsonl_path,
+                                               self._jsonl)
         return rec
 
     # -- exports ------------------------------------------------------------
@@ -308,3 +415,80 @@ def snapshot() -> dict:
 
 def write_snapshot(path: str) -> dict:
     return _REGISTRY.write_snapshot(path)
+
+
+def snapshot_to_prometheus(snap: dict) -> str:
+    """Render a snapshot DICT (local, merged, or loaded from disk) as
+    Prometheus text by rehydrating it into a throwaway registry — the
+    statusz fleet view and the report CLI share this one inversion of
+    :func:`metric_key`."""
+    reg = MetricRegistry()
+
+    def rehydrate(factory, flat_key: str, **kw):
+        if "{" in flat_key and flat_key.endswith("}"):
+            name, _, rest = flat_key.partition("{")
+            labels = dict(item.split("=", 1)
+                          for item in rest[:-1].split(",") if item)
+            return factory(name, **kw, **labels)
+        return factory(flat_key, **kw)
+
+    for k, v in snap.get("counters", {}).items():
+        rehydrate(reg.counter, k).inc(v)
+    for k, v in snap.get("gauges", {}).items():
+        rehydrate(reg.gauge, k).set(v)
+    for k, h in snap.get("histograms", {}).items():
+        m = rehydrate(reg.histogram, k, bounds=tuple(h["bounds"]))
+        m.counts = list(h["counts"])
+        m.count, m.sum = h["count"], h["sum"]
+    return reg.to_prometheus()
+
+
+class QueueGauges:
+    """Depth + oldest-item age gauges for one named worker queue:
+    ``queue.depth{queue=<name>}`` / ``queue.age_s{queue=<name>}``.
+
+    The shared backpressure instrument of the client pipeline's worker
+    queues (staging writer, ASyncBuffer), the ft checkpoint worker, and
+    the coalescer's occupancy — one name prefix, so the statusz server
+    and watchdog post-mortems can sweep every queue with a gauge-key
+    filter. Age refreshes at the put/take touch points (no timer
+    thread): a queue nobody touches shows its last observed age, and a
+    DRAINED queue always shows 0 — the stall signature (depth > 0, age
+    growing across snapshots) survives that coarseness.
+
+    Producers that track their own occupancy (the coalescer's
+    count/first-add pair) skip the deque and call :meth:`sample`.
+    """
+
+    def __init__(self, name: str) -> None:
+        self.name = str(name)
+        self._lock = threading.Lock()
+        self._entries: Deque[float] = deque()
+        self._depth = gauge("queue.depth", queue=self.name)
+        self._age = gauge("queue.age_s", queue=self.name)
+
+    def _refresh_locked(self) -> None:
+        self._depth.set(len(self._entries))
+        self._age.set(time.monotonic() - self._entries[0]
+                      if self._entries else 0.0)
+
+    def on_put(self) -> None:
+        with self._lock:
+            self._entries.append(time.monotonic())
+            self._refresh_locked()
+
+    def on_take(self) -> None:
+        with self._lock:
+            if self._entries:
+                self._entries.popleft()
+            self._refresh_locked()
+
+    def refresh(self) -> None:
+        """Re-observe age without a put/take (snapshot cadences)."""
+        with self._lock:
+            self._refresh_locked()
+
+    def sample(self, depth: int, age_s: float = 0.0) -> None:
+        """Direct gauge write for self-accounting holders."""
+        self._depth.set(int(depth))
+        self._age.set(max(float(age_s), 0.0))

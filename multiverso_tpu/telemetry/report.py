@@ -78,26 +78,21 @@ def render_snapshot(snap: dict) -> str:
             count, total = h["count"], h["sum"]
             mean = total / count if count else 0.0
             rows.append([k, _num(count), f"{total:.4f}",
-                         f"{mean * 1e3:.3f}", _p50(h)])
+                         f"{mean * 1e3:.3f}", _q_ms(h, 0.5),
+                         _q_ms(h, 0.99)])
         out.append("histograms:\n" + _table(
-            rows, ["name", "count", "sum", "mean_ms", "~p50"]))
+            rows, ["name", "count", "sum", "mean_ms", "p50_ms",
+                   "p99_ms"]))
     if not out:
         return "(empty snapshot)"
     return "\n\n".join(out)
 
 
-def _p50(h: dict) -> str:
-    """Approximate median: the upper bound of the bucket holding the
-    midpoint observation (fixed buckets — exact values are gone)."""
-    if not h["count"]:
-        return "-"
-    half = h["count"] / 2.0
-    acc = 0
-    for bound, c in zip(h["bounds"], h["counts"]):
-        acc += c
-        if acc >= half:
-            return f"<={_num(bound)}"
-    return f">{_num(h['bounds'][-1])}"
+def _q_ms(h: dict, q: float) -> str:
+    """Interpolated quantile as milliseconds ("-" while empty) —
+    bucket-resolution accurate, like every pNN this layer reports."""
+    v = _metrics.snapshot_quantile(h, q)
+    return "-" if v is None else f"{v * 1e3:.3f}"
 
 
 def render_trace(records: List[dict]) -> str:
@@ -180,6 +175,8 @@ def to_chrome_trace(records: List[dict]) -> dict:
             args["span_id"] = r.get("id")
             if r.get("parent") is not None:
                 args["parent"] = r["parent"]
+            if r.get("req") is not None:
+                args["req"] = r["req"]
             events.append({"name": r["name"], "ph": "X", "cat": "span",
                            "ts": float(r["ts"]) * 1e6,
                            "dur": max(float(r.get("dur_s", 0)), 0) * 1e6,
@@ -307,16 +304,7 @@ def main(argv=None) -> int:
             print("--prometheus requires a registry snapshot",
                   file=sys.stderr)
             return 2
-        reg = _metrics.MetricRegistry()
-        for k, v in data.get("counters", {}).items():
-            _rehydrate(reg.counter, k).inc(v)
-        for k, v in data.get("gauges", {}).items():
-            _rehydrate(reg.gauge, k).set(v)
-        for k, h in data.get("histograms", {}).items():
-            m = _rehydrate(reg.histogram, k, bounds=tuple(h["bounds"]))
-            m.counts = list(h["counts"])
-            m.count, m.sum = h["count"], h["sum"]
-        print(reg.to_prometheus(), end="")
+        print(_metrics.snapshot_to_prometheus(data), end="")
         return 0
     if kind == "snapshot":
         print(render_snapshot(data))
@@ -325,16 +313,6 @@ def main(argv=None) -> int:
     else:
         print(render_trace(data))
     return 0
-
-
-def _rehydrate(factory, flat_key: str, **kw):
-    """Invert metric_key(): ``name{k=v,...}`` back to factory args."""
-    if "{" in flat_key and flat_key.endswith("}"):
-        name, _, rest = flat_key.partition("{")
-        labels = dict(item.split("=", 1)
-                      for item in rest[:-1].split(",") if item)
-        return factory(name, **kw, **labels)
-    return factory(flat_key, **kw)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,324 @@
+"""Live introspection server: scrape a RUNNING process instead of
+killing it for a dump.
+
+A stdlib ``http.server`` daemon thread (no web framework, same
+discipline as the rest of the flight recorder), armed by
+``MVTPU_STATUSZ_PORT`` at ``core.init`` (port ``0`` = ephemeral; read
+the bound port back via :func:`server`). Endpoints:
+
+- ``/metrics``  — Prometheus text exposition of the local registry
+  (the existing exporter, now scrape-able live). ``/metrics?fleet=1``
+  serves the fleet view: computed live on single-process runs, or the
+  last snapshot a collective :func:`publish_fleet` call installed on a
+  multi-host run — the HTTP thread must NEVER run ``gather_metrics``
+  itself there (it is a lockstep collective; calling it off the main
+  thread deadlocks the mesh).
+- ``/healthz``  — watchdog heartbeat ages as JSON; HTTP 200 while every
+  armed watchdog's deadline is held, 503 once one is silent past its
+  deadline (the process is about to warn/dump/die with
+  rc=``SELF_TERMINATE_RC`` per its action ladder).
+- ``/statusz``  — run topology (the ``core.*`` gauges), per-table
+  sizes and generations, kernel-engine selections + fallback counters,
+  latest good checkpoint, queue gauges, SLO rules + recent violations.
+- ``/trace``    — tail of the active span trace JSONL (same 64 KB tail
+  a watchdog dump captures — "what was in flight just now").
+
+jax-free BY DESIGN: everything jax-adjacent (tables, topology, the
+ft checkpoint state) is resolved through ``sys.modules`` lookups or
+read back from registry gauges, so the server imports — and serves —
+in a process whose accelerator tunnel is wedged.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import socketserver
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from multiverso_tpu.telemetry import metrics as _metrics
+from multiverso_tpu.telemetry import trace as _trace
+from multiverso_tpu.telemetry import watchdog as _watchdog
+
+STATUSZ_ENV = "MVTPU_STATUSZ_PORT"
+
+_SERVER_LOCK = threading.Lock()
+_SERVER: Optional["StatuszServer"] = None
+
+
+def _process_count() -> int:
+    """jax.process_count() when a runtime is up (sys.modules — never an
+    import), else 1."""
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return int(jax.process_count())
+        except Exception:  # pragma: no cover - uninitialised backend
+            pass
+    return 1
+
+
+def _trace_tail(limit: int = 1 << 16) -> bytes:
+    """Last ``limit`` bytes of the active trace file, torn leading line
+    dropped — the watchdog dump's tail logic, served live."""
+    path = _trace.trace_path()
+    if not path or not os.path.exists(path):
+        return b""
+    try:
+        with open(path, "rb") as src:
+            src.seek(0, os.SEEK_END)
+            start = max(src.tell() - limit, 0)
+            src.seek(start)
+            tail = src.read()
+        if start and b"\n" in tail:
+            tail = tail[tail.find(b"\n") + 1:]
+        return tail
+    except OSError:
+        return b""
+
+
+def _tables_status() -> List[Dict[str, Any]]:
+    """Registered tables via sys.modules (dense Tables and KVTables
+    share table_id/name/generation; sizes differ by kind)."""
+    base = sys.modules.get("multiverso_tpu.tables.base")
+    if base is None:
+        return []
+    out = []
+    try:
+        for i in range(base.num_tables()):
+            t = base.get_table(i)
+            info: Dict[str, Any] = {
+                "id": getattr(t, "table_id", i),
+                "name": getattr(t, "name", "?"),
+                "kind": type(t).__name__,
+                "generation": getattr(t, "generation", None),
+            }
+            for attr in ("logical_shape", "padded_shape", "capacity",
+                         "vdim"):
+                v = getattr(t, attr, None)
+                if v is not None:
+                    info[attr] = list(v) if isinstance(v, tuple) else v
+            dt = getattr(t, "dtype", None)
+            if dt is not None:
+                info["dtype"] = str(dt)
+            out.append(info)
+    except Exception:       # a live registry mutation mid-walk is fine
+        pass
+    return out
+
+
+def _statusz_doc() -> dict:
+    snap = _metrics.snapshot()
+    gauges = snap.get("gauges", {})
+    counters = snap.get("counters", {})
+    latest_ckpt = None
+    ft_ckpt = sys.modules.get("multiverso_tpu.ft.checkpoint")
+    if ft_ckpt is not None:
+        try:
+            latest_ckpt = ft_ckpt.latest_good_checkpoint()
+        except Exception:
+            pass
+    slo = sys.modules.get("multiverso_tpu.telemetry.slo")
+    return {
+        "kind": "mvtpu.statusz.v1",
+        "ts": time.time(),
+        "host": _metrics.host_index(),
+        "pid": os.getpid(),
+        "argv": sys.argv,
+        "topology": {k: v for k, v in gauges.items()
+                     if k.startswith("core.")},
+        "tables": _tables_status(),
+        "kernels": {
+            "selected": {k: v for k, v in gauges.items()
+                         if k.startswith("kernels.")},
+            "fallbacks": {k: v for k, v in counters.items()
+                          if k.startswith("kernels.fallbacks")},
+        },
+        "queues": {k: v for k, v in gauges.items()
+                   if k.startswith("queue.")},
+        "latest_checkpoint": latest_ckpt,
+        "watchdogs": _watchdog.active_watchdogs(),
+        "slo": {
+            "rules": [r.raw for r in slo.active_rules()]
+            if slo is not None else [],
+            "recent_violations": slo.recent_violations()
+            if slo is not None else [],
+        },
+    }
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    server_version = "mvtpu-statusz/1"
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        """Silence per-request stderr lines (the serving bench would
+        drown a terminal); scrape failures still surface client-side."""
+
+    def _reply(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_json(self, code: int, doc: dict) -> None:
+        self._reply(code, json.dumps(doc, indent=1, default=str)
+                    .encode(), "application/json")
+
+    def do_GET(self) -> None:       # noqa: N802 (http.server contract)
+        try:
+            path, _, query = self.path.partition("?")
+            if path in ("/", "/statusz"):
+                if path == "/":
+                    body = ("mvtpu statusz — endpoints: /metrics "
+                            "(?fleet=1), /healthz, /statusz, /trace\n")
+                    self._reply(200, body.encode(), "text/plain")
+                    return
+                self._reply_json(200, _statusz_doc())
+            elif path == "/metrics":
+                if "fleet=1" in query.split("&"):
+                    snap, err = self.server.owner.fleet_view()
+                    if snap is None:
+                        self._reply(503, (err + "\n").encode(),
+                                    "text/plain")
+                        return
+                    body = _metrics.snapshot_to_prometheus(snap)
+                else:
+                    body = _metrics.registry().to_prometheus()
+                self._reply(200, body.encode(), "text/plain")
+            elif path == "/healthz":
+                dogs = _watchdog.active_watchdogs()
+                ok = all(d["ok"] for d in dogs)
+                self._reply_json(200 if ok else 503, {
+                    "ok": ok, "ts": time.time(),
+                    "watchdogs": dogs,
+                    "self_terminate_rc": _watchdog.SELF_TERMINATE_RC,
+                })
+            elif path == "/trace":
+                self._reply(200, _trace_tail(), "application/jsonl")
+            else:
+                self._reply(404, b"not found\n", "text/plain")
+        except (BrokenPipeError, ConnectionResetError):
+            pass                    # scraper went away mid-reply
+        except Exception as e:      # introspection must never wedge
+            try:
+                self._reply(500, f"{e!r}\n".encode(), "text/plain")
+            except Exception:
+                pass
+
+
+class _HTTPServer(socketserver.ThreadingMixIn, http.server.HTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    owner: "StatuszServer"
+
+
+class StatuszServer:
+    """One process's introspection server (see module docstring)."""
+
+    def __init__(self, port: int = 0, host: str = "") -> None:
+        self._httpd = _HTTPServer((host, port), _Handler)
+        self._httpd.owner = self
+        self.port: int = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+        self._fleet_lock = threading.Lock()
+        self._fleet: Optional[Tuple[dict, float]] = None
+
+    # -- fleet view --------------------------------------------------------
+
+    def publish_fleet(self, snapshot: Optional[dict] = None) -> dict:
+        """Install the fleet snapshot ``/metrics?fleet=1`` serves.
+
+        COLLECTIVE on multi-process runs (wraps ``gather_metrics`` —
+        every process must call it in lockstep, e.g. once per app
+        superstep or checkpoint cadence); pass ``snapshot`` to install
+        a pre-merged one instead. Single-process runs never need this —
+        the fleet view falls back to a live local gather."""
+        if snapshot is None:
+            from multiverso_tpu.telemetry import aggregate
+            snapshot = aggregate.fleet_snapshot()
+        with self._fleet_lock:
+            self._fleet = (snapshot, time.time())
+        return snapshot
+
+    def fleet_view(self) -> Tuple[Optional[dict], str]:
+        """(snapshot, "") or (None, reason). Live only when the process
+        is alone — the HTTP thread must not join a collective."""
+        with self._fleet_lock:
+            published = self._fleet
+        if published is not None:
+            return published[0], ""
+        if _process_count() == 1:
+            from multiverso_tpu.telemetry import aggregate
+            return aggregate.fleet_snapshot(), ""
+        return None, ("no fleet snapshot published yet (multi-process "
+                      "run: call statusz publish_fleet collectively)")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "StatuszServer":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="mvtpu-statusz",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        global _SERVER
+        with _SERVER_LOCK:
+            if _SERVER is self:
+                _SERVER = None
+
+
+def server() -> Optional[StatuszServer]:
+    """The running env-armed server, if any (tools read ``.port`` here
+    after arming with port 0)."""
+    return _SERVER
+
+
+def publish_fleet(snapshot: Optional[dict] = None) -> Optional[dict]:
+    """Module-level convenience over the env-armed server (no-op when
+    none is running — apps can call it unconditionally)."""
+    srv = server()
+    if srv is None:
+        return None
+    return srv.publish_fleet(snapshot)
+
+
+def maybe_statusz() -> Optional[StatuszServer]:
+    """Env-gated server: bind and serve when ``MVTPU_STATUSZ_PORT`` is
+    set (``0`` = ephemeral port), else None. Idempotent — one server
+    per process (``core.init`` calls this on every re-init)."""
+    raw = os.environ.get(STATUSZ_ENV)
+    if raw is None or raw.strip() == "":
+        return None
+    global _SERVER
+    with _SERVER_LOCK:
+        if _SERVER is not None:
+            return _SERVER
+        try:
+            port = int(raw)
+        except ValueError:
+            _watchdog._warn(f"statusz: malformed {STATUSZ_ENV}={raw!r};"
+                            f" server disabled")
+            return None
+        try:
+            _SERVER = StatuszServer(port).start()
+        except OSError as e:
+            _watchdog._warn(f"statusz: bind failed on port {port}: "
+                            f"{e!r}; server disabled")
+            return None
+        _watchdog._warn(f"statusz: serving on port {_SERVER.port} "
+                        f"(/metrics /healthz /statusz /trace)")
+        return _SERVER

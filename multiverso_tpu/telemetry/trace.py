@@ -13,15 +13,26 @@ compiled ops — one vocabulary across host and device timelines.
 Record shapes (one JSON object per line):
 
 - span:  ``{"kind": "span", "name", "id", "parent", "ts", "dur_s",
-  "attrs"?}`` (``parent`` is null for roots; ``ts`` is the epoch start)
+  "attrs"?, "req"?}`` (``parent`` is null for roots; ``ts`` is the
+  epoch start; ``req`` is the request id when the span ran inside a
+  :func:`request` scope)
 - step:  ``{"kind": "step", "name", "step", "ts", ...metrics}`` — the
   per-superstep heartbeat apps emit via :func:`step_timeline`; a trace
   with step records is a per-step timeline even when nothing else is
   instrumented (the round-5 bench hang left zero such signal).
 
+Request scoping (the serving-observability layer): :func:`request`
+mints a ``request_id`` at a client entry point and stamps it — plus
+parent links — onto every span nested under it, including spans on
+OTHER threads via the :func:`link`/:func:`adopt` hand-off (the client
+pipeline's D2H-wait and host-prep workers). One slow get then
+reconstructs as one parent-linked tree in the JSONL and the
+``--chrome-trace`` export.
+
 Sink configuration: :func:`set_trace_file`, or ``MVTPU_TRACE_JSONL``
 (a file path), or ``MVTPU_TRACE_DIR`` (a directory; the file becomes
 ``trace-<pid>.jsonl`` inside it — per-process files, safe multi-host).
+``MVTPU_TRACE_MAX_MB`` size-caps the sink with a keep-1 rollover.
 With no sink, spans still nest and time but write nothing, so hot-path
 instrumentation costs one perf_counter pair when tracing is off.
 """
@@ -34,13 +45,16 @@ import json
 import os
 import threading
 import time
-from typing import Iterator, List, Optional, TextIO
+from typing import Iterator, List, Optional, TextIO, Tuple
 
 _IDS = itertools.count(1)
+_REQS = itertools.count(1)
 _TLS = threading.local()
 _LOCK = threading.Lock()
 _FILE: Optional[TextIO] = None
 _PATH: Optional[str] = None
+
+LinkToken = Tuple[Optional[str], Optional[int]]
 
 
 def _stack() -> List[int]:
@@ -76,14 +90,20 @@ def _emit(rec: dict) -> None:
     # identity stamps: host/pid pick the Perfetto process track (and
     # correlate with snapshots, log lines, and watchdog dumps); tid
     # separates concurrent host threads so span nesting stays true
-    from multiverso_tpu.telemetry.metrics import host_index
+    from multiverso_tpu.telemetry.metrics import (host_index,
+                                                  rotate_jsonl,
+                                                  sink_max_bytes)
     rec.setdefault("host", host_index())
     rec.setdefault("pid", os.getpid())
     rec.setdefault("tid", threading.get_ident())
+    global _FILE
     with _LOCK:
         if _FILE is not None:
             _FILE.write(json.dumps(rec) + "\n")
             _FILE.flush()
+            limit = sink_max_bytes()
+            if limit and _PATH and _FILE.tell() >= limit:
+                _FILE = rotate_jsonl(_PATH, _FILE)
 
 
 def _named_scope(name: str):
@@ -118,9 +138,84 @@ def span(name: str, **attrs) -> Iterator[int]:
         st.pop()
         rec = {"kind": "span", "name": name, "id": sid,
                "parent": parent, "ts": ts, "dur_s": dur}
+        rid = getattr(_TLS, "request", None)
+        if rid is not None:
+            rec["req"] = rid
         if attrs:
             rec["attrs"] = attrs
         _emit(rec)
+
+
+# -- request scoping -------------------------------------------------------
+
+def new_request_id() -> str:
+    """Mint a request id: ``r<host>-<pid>-<counter>`` — unique across a
+    fleet, no randomness (the trace layer's id discipline)."""
+    from multiverso_tpu.telemetry.metrics import host_index
+    return f"r{host_index()}-{os.getpid()}-{next(_REQS)}"
+
+
+def current_request() -> Optional[str]:
+    """The request id this thread is serving, or None."""
+    return getattr(_TLS, "request", None)
+
+
+@contextlib.contextmanager
+def request(name: str, **attrs) -> Iterator[str]:
+    """Open a request scope at a client entry point: mints a request
+    id, opens a root span named ``name``, and stamps the id (``req``)
+    onto that span and every span nested under it — on this thread, or
+    on a worker thread that :func:`adopt`\\ s this scope's
+    :func:`link` token. Yields the request id. Re-entrant: an entry
+    point invoked while a request is already open joins the OUTER
+    request (one user-visible operation = one tree)."""
+    rid = getattr(_TLS, "request", None)
+    fresh = rid is None
+    if fresh:
+        rid = new_request_id()
+        _TLS.request = rid
+    try:
+        with span(name, **attrs):
+            yield rid
+    finally:
+        if fresh:
+            _TLS.request = None
+
+
+def link() -> Optional[LinkToken]:
+    """Capture ``(request_id, innermost span id)`` for hand-off to
+    another thread (both halves may be None-padded); None when there is
+    nothing to link — the no-tracing fast path."""
+    st = _stack()
+    rid = getattr(_TLS, "request", None)
+    sid = st[-1] if st else None
+    if rid is None and sid is None:
+        return None
+    return (rid, sid)
+
+
+@contextlib.contextmanager
+def adopt(token: Optional[LinkToken]) -> Iterator[None]:
+    """Parent this thread's spans under a :func:`link` token minted on
+    another thread — the cross-thread half of request scoping (D2H-wait
+    workers, staging prep). Spans opened inside the block chain to the
+    token's span and carry its request id."""
+    if token is None:
+        yield
+        return
+    rid, sid = token
+    st = _stack()
+    prev = getattr(_TLS, "request", None)
+    if rid is not None:
+        _TLS.request = rid
+    if sid is not None:
+        st.append(sid)
+    try:
+        yield
+    finally:
+        if sid is not None:
+            st.pop()
+        _TLS.request = prev
 
 
 def step_timeline(name: str, step: int, **fields) -> dict:

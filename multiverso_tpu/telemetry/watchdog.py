@@ -159,6 +159,18 @@ class Watchdog:
             self._last_beat = _now()
             self._tripped = False
 
+    def status(self) -> dict:
+        """Liveness snapshot for the statusz ``/healthz`` endpoint:
+        ``ok`` is "the deadline is currently held" — the same predicate
+        the watcher thread trips on."""
+        with self._lock:
+            silent = _now() - self._last_beat
+            beats = self._beats
+        return {"name": self.name, "deadline_s": self.deadline_s,
+                "silent_s": silent, "beats": beats,
+                "stalls": self.stalls, "action": self.action,
+                "ok": silent <= self.deadline_s}
+
     # -- the watcher thread ------------------------------------------------
 
     def _run(self) -> None:
@@ -262,6 +274,25 @@ class Watchdog:
                 latest_ckpt = ft_ckpt.latest_good_checkpoint()
             except Exception:   # diagnostics must never raise
                 pass
+        # per-queue depth/age gauges + the last SLO violations: the
+        # backpressure and tail-latency evidence a stall post-mortem
+        # starts from (which worker queue was wedged, and was the SLO
+        # monitor already screaming before the heartbeat died)
+        queues = {}
+        if metrics is not None:
+            try:
+                queues = {k: v for k, v in metrics.snapshot()
+                          .get("gauges", {}).items()
+                          if k.startswith("queue.")}
+            except Exception:
+                pass
+        violations = []
+        slo = _sibling("slo")
+        if slo is not None:
+            try:
+                violations = slo.recent_violations()
+            except Exception:
+                pass
         with open(os.path.join(path, "watchdog.json"), "w") as f:
             json.dump({
                 "kind": DUMP_KIND, "name": self.name,
@@ -271,6 +302,8 @@ class Watchdog:
                 "ts": time.time(), "pid": os.getpid(),
                 "host": _host_index(), "argv": sys.argv,
                 "latest_checkpoint": latest_ckpt,
+                "queues": queues,
+                "slo_violations": violations,
             }, f, indent=1)
         return path
 
@@ -282,6 +315,13 @@ def beat() -> None:
         active = list(_ACTIVE)
     for w in active:
         w.beat()
+
+
+def active_watchdogs() -> List[dict]:
+    """Status of every armed watchdog (the ``/healthz`` payload)."""
+    with _ACTIVE_LOCK:
+        active = list(_ACTIVE)
+    return [w.status() for w in active]
 
 
 @contextlib.contextmanager

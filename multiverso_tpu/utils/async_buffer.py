@@ -35,8 +35,16 @@ class ASyncBuffer(Generic[T]):
     exactly like ``get()``.
     """
 
-    def __init__(self, fill_fn: Callable[[int], T]) -> None:
+    def __init__(self, fill_fn: Callable[[int], T],
+                 name: Optional[str] = None) -> None:
         self._fill_fn = fill_fn
+        # named buffers publish queue.depth/queue.age_s gauges (lazy
+        # import: this module stays importable without the telemetry
+        # package initialised)
+        self._qg = None
+        if name is not None:
+            from multiverso_tpu.telemetry.metrics import QueueGauges
+            self._qg = QueueGauges(f"async:{name}")
         self._requests: "queue.Queue[Optional[int]]" = queue.Queue()
         self._results: "queue.Queue[tuple[Optional[T], Optional[BaseException]]]" = (
             queue.Queue(maxsize=1))
@@ -51,6 +59,8 @@ class ASyncBuffer(Generic[T]):
             idx = self._requests.get()
             if idx is None:         # stop() sentinel
                 return
+            if self._qg is not None:
+                self._qg.on_take()
             try:
                 item = (self._fill_fn(idx), None)
             except BaseException as exc:  # propagate to consumer
@@ -67,6 +77,8 @@ class ASyncBuffer(Generic[T]):
     def _kick(self) -> None:
         self._requests.put(self._index)
         self._index += 1
+        if self._qg is not None:
+            self._qg.on_put()
 
     def _consume(self, value: Optional[T],
                  exc: Optional[BaseException]) -> T:

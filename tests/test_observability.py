@@ -1,0 +1,491 @@
+"""Serving-observability tests (ISSUE 7): HDR quantile math, registry
+thread-safety, size-capped JSONL rotation, request-scoped span trees
+(including the cross-thread link/adopt hand-off and the chrome-trace
+export), SLO rule parsing + the forced-violation -> watchdog path,
+queue gauges, and the statusz introspection server over real HTTP.
+
+The full serving bench (8 client threads + statusz scrape end to end)
+runs as ``make serve-smoke`` (tools/serve_smoke.py) — these tests cover
+the same machinery at unit scale so failures localize.
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from multiverso_tpu import telemetry
+from multiverso_tpu.telemetry import metrics, report, slo, trace, watchdog
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    """Each test sees an empty process registry and no trace sink."""
+    metrics.registry().reset()
+    trace.set_trace_file(None)
+    yield
+    metrics.registry().reset()
+    trace.set_trace_file(None)
+
+
+# -- quantiles -------------------------------------------------------------
+
+
+class TestQuantiles:
+    def test_interpolates_within_bucket(self):
+        h = metrics.histogram("q.lat", bounds=(1.0, 2.0, 4.0))
+        for _ in range(4):
+            h.observe(1.5)
+        # all mass in (1, 2]: rank q*4 interpolates linearly inside it
+        assert h.quantile(0.5) == pytest.approx(1.5)
+        assert h.quantile(0.25) == pytest.approx(1.25)
+        assert h.quantile(1.0) == pytest.approx(2.0)
+
+    def test_bucket_zero_interpolates_from_zero(self):
+        h = metrics.histogram("q.z", bounds=(1.0, 2.0))
+        h.observe(0.3)
+        assert h.quantile(0.5) == pytest.approx(0.5)
+
+    def test_overflow_clamps_to_last_bound(self):
+        h = metrics.histogram("q.of", bounds=(1.0, 2.0, 4.0))
+        h.observe(100.0)
+        # exact values are gone; the last bound is the honest answer
+        assert h.quantile(0.99) == pytest.approx(4.0)
+
+    def test_empty_is_none_not_zero(self):
+        h = metrics.histogram("q.empty", bounds=(1.0,))
+        assert h.quantile(0.5) is None
+        assert h.p50 is None and h.p99 is None and h.p999 is None
+
+    def test_q_range_enforced(self):
+        h = metrics.histogram("q.rng", bounds=(1.0,))
+        h.observe(0.5)
+        with pytest.raises(ValueError):
+            h.quantile(-0.1)
+        with pytest.raises(ValueError):
+            h.quantile(1.5)
+
+    def test_properties_and_snapshot_agree(self):
+        h = metrics.histogram("q.props", bounds=metrics.LATENCY_BUCKETS)
+        for ms in (1, 2, 3, 50):
+            h.observe(ms * 1e-3)
+        snap_h = metrics.snapshot()["histograms"]["q.props"]
+        for q, prop in ((0.5, h.p50), (0.99, h.p99), (0.999, h.p999)):
+            assert metrics.snapshot_quantile(snap_h, q) == \
+                pytest.approx(prop)
+        # the p99 of 4 samples sits in the slowest sample's bucket
+        import bisect
+        lo_i = bisect.bisect_left(metrics.LATENCY_BUCKETS, 50e-3)
+        lo = metrics.LATENCY_BUCKETS[lo_i - 1]
+        hi = metrics.LATENCY_BUCKETS[lo_i]
+        assert lo < h.p99 <= hi
+
+    def test_log_spaced_bounds_shape(self):
+        b = metrics.log_spaced_bounds(1e-5, 100.0, 4)
+        assert len(b) == 29                    # 7 decades * 4 + 1
+        assert b[0] == pytest.approx(1e-5)
+        assert b[-1] == pytest.approx(100.0)
+        assert list(b) == sorted(set(b))       # strictly increasing
+        # deterministic arithmetic: every host builds IDENTICAL bounds
+        assert b == metrics.LATENCY_BUCKETS
+        with pytest.raises(ValueError):
+            metrics.log_spaced_bounds(1.0, 1.0)
+        with pytest.raises(ValueError):
+            metrics.log_spaced_bounds(1.0, 10.0, 0)
+
+
+# -- registry thread-safety ------------------------------------------------
+
+
+class TestRegistryThreadSafety:
+    def test_concurrent_emit_and_snapshot(self):
+        n_threads, n_ops = 8, 200
+        errors = []
+
+        def worker(i):
+            try:
+                for j in range(n_ops):
+                    metrics.counter("ts.ops", worker=str(i)).inc()
+                    metrics.histogram(
+                        "ts.lat", bounds=metrics.LATENCY_BUCKETS,
+                        worker=str(i)).observe(1e-3)
+                    metrics.gauge("ts.depth", worker=str(i)).set(j)
+                    if j % 20 == 0:
+                        json.dumps(metrics.snapshot())  # reader races
+            except Exception as e:      # pragma: no cover - on failure
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        snap = metrics.snapshot()
+        for i in range(n_threads):
+            assert snap["counters"][f"ts.ops{{worker={i}}}"] == n_ops
+            h = snap["histograms"][f"ts.lat{{worker={i}}}"]
+            assert h["count"] == n_ops
+            assert sum(h["counts"]) == n_ops
+
+
+# -- size-capped JSONL rotation --------------------------------------------
+
+
+class TestRotation:
+    def test_trace_sink_keep1_rollover(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MVTPU_TRACE_MAX_MB", "0.001")  # 1000 bytes
+        path = str(tmp_path / "trace.jsonl")
+        trace.set_trace_file(path)
+        for i in range(60):
+            with telemetry.span("rot.region", i=i):
+                pass
+        trace.set_trace_file(None)
+        assert os.path.exists(path)
+        assert os.path.exists(path + ".1")     # exactly one rollover kept
+        assert not os.path.exists(path + ".2")
+        # the live file restarted from the cap; both halves stay parseable
+        assert os.path.getsize(path + ".1") <= 1000 + 300
+        # rollover precedes live: oldest-first order, newest span last
+        # (the live file may be freshly empty when the final write
+        # itself tripped the cap)
+        recs = trace.read_trace(path + ".1") + trace.read_trace(path)
+        assert recs and all(r["name"] == "rot.region" for r in recs)
+        assert recs[-1]["attrs"]["i"] == 59
+
+    def test_metric_event_sink_rotates_too(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MVTPU_TRACE_MAX_MB", "0.001")
+        path = str(tmp_path / "events.jsonl")
+        metrics.registry().set_jsonl(path)
+        try:
+            for i in range(40):
+                telemetry.emit("rot.rate", float(i), "x/s")
+        finally:
+            metrics.registry().set_jsonl(None)
+        assert os.path.exists(path + ".1")
+        recs = [json.loads(ln)
+                for p in (path + ".1", path) if os.path.exists(p)
+                for ln in open(p)]
+        assert recs[-1]["value"] == 39.0
+
+    def test_unset_cap_means_unbounded(self, monkeypatch):
+        monkeypatch.delenv("MVTPU_TRACE_MAX_MB", raising=False)
+        assert metrics.sink_max_bytes() == 0
+        monkeypatch.setenv("MVTPU_TRACE_MAX_MB", "junk")
+        assert metrics.sink_max_bytes() == 0
+        monkeypatch.setenv("MVTPU_TRACE_MAX_MB", "2")
+        assert metrics.sink_max_bytes() == 2_000_000
+
+
+# -- request-scoped span trees ---------------------------------------------
+
+
+class TestRequestTrees:
+    def test_cross_thread_tree_and_chrome_export(self, tmp_path):
+        """One request spanning two threads reconstructs as ONE
+        parent-linked tree, and the chrome-trace export stamps the
+        request id on every slice (the acceptance-criterion shape)."""
+        path = str(tmp_path / "trace.jsonl")
+        trace.set_trace_file(path)
+        done = threading.Event()
+
+        def d2h_worker(token):
+            with trace.adopt(token):
+                with telemetry.span("client.d2h_wait"):
+                    pass
+            done.set()
+
+        with trace.request("client.get", table="0:w") as rid:
+            with telemetry.span("client.dispatch"):
+                token = trace.link()
+                threading.Thread(target=d2h_worker,
+                                 args=(token,)).start()
+                assert done.wait(10)
+        trace.set_trace_file(None)
+
+        recs = [r for r in trace.read_trace(path)
+                if r.get("kind") == "span"]
+        mine = [r for r in recs if r.get("req") == rid]
+        assert {r["name"] for r in mine} == \
+            {"client.get", "client.dispatch", "client.d2h_wait"}
+        ids = {r["id"] for r in mine}
+        roots = [r for r in mine
+                 if r["parent"] is None or r["parent"] not in ids]
+        assert len(roots) == 1 and roots[0]["name"] == "client.get"
+        # adopted span chains to the DISPATCH span it was linked from
+        by_name = {r["name"]: r for r in mine}
+        assert by_name["client.d2h_wait"]["parent"] == \
+            by_name["client.dispatch"]["id"]
+        # chrome export: every slice of the request carries req=<rid>
+        doc = report.to_chrome_trace(recs)
+        slices = [e for e in doc["traceEvents"]
+                  if e.get("cat") == "span"
+                  and e.get("args", {}).get("req") == rid]
+        assert len(slices) == 3
+
+    def test_request_reentry_joins_outer(self, tmp_path):
+        path = str(tmp_path / "trace.jsonl")
+        trace.set_trace_file(path)
+        with trace.request("outer.op") as outer_rid:
+            with trace.request("inner.op") as inner_rid:
+                assert inner_rid == outer_rid   # one user op = one tree
+        assert trace.current_request() is None
+        trace.set_trace_file(None)
+        recs = trace.read_trace(path)
+        assert all(r["req"] == outer_rid for r in recs)
+
+    def test_request_ids_unique_and_fleet_scoped(self):
+        a, b = trace.new_request_id(), trace.new_request_id()
+        assert a != b
+        assert a.startswith("r") and str(os.getpid()) in a
+
+    def test_link_is_none_outside_any_scope(self):
+        assert trace.link() is None
+
+    def test_client_get_request_tree_on_mesh(self, mesh8, tmp_path):
+        """The real pipeline: a CachedView.get() leaves a single
+        parent-linked request tree in the trace."""
+        from multiverso_tpu import client
+        from multiverso_tpu.tables import ArrayTable, reset_tables
+        path = str(tmp_path / "trace.jsonl")
+        trace.set_trace_file(path)
+        try:
+            t = ArrayTable(32, "float32", updater="default")
+            view = client.CachedView(t, max_staleness=2)
+            view.get()
+            view.close()
+        finally:
+            reset_tables()
+            trace.set_trace_file(None)
+        recs = [r for r in trace.read_trace(path)
+                if r.get("kind") == "span"]
+        gets = [r for r in recs if r["name"] == "client.get"]
+        assert gets, f"no client.get span in {recs}"
+        rid = gets[0]["req"]
+        mine = [r for r in recs if r.get("req") == rid]
+        ids = {r["id"] for r in mine}
+        roots = [r for r in mine
+                 if r["parent"] is None or r["parent"] not in ids]
+        assert len(roots) == 1 and roots[0]["name"] == "client.get"
+
+
+# -- SLO rules + forced violation ------------------------------------------
+
+
+class TestSloParsing:
+    def test_grammar(self):
+        r = slo.parse_rule("table.add.p99<5ms")
+        assert (r.metric, r.stat, r.q, r.bound_s) == \
+            ("table.add", "p99", 0.99, pytest.approx(5e-3))
+        r = slo.parse_rule("client.get.seconds.p999 < 50us")
+        assert r.metric == "client.get.seconds"
+        assert r.q == pytest.approx(0.999)
+        assert r.bound_s == pytest.approx(50e-6)
+        r = slo.parse_rule("svc.lat.mean<1.5")
+        assert r.stat == "mean" and r.bound_s == pytest.approx(1.5)
+        rules = slo.parse_slo("a.b.p50<1ms, c.d.mean<2s,")
+        assert [r.stat for r in rules] == ["p50", "mean"]
+
+    def test_rejects_malformed(self):
+        for bad in ("no-operator", "x.y.p99", "x.frobnicate<1ms",
+                    "x.p0<1ms", ".p99<1ms"):
+            with pytest.raises(ValueError):
+                slo.parse_rule(bad)
+
+    def test_match_ignores_labels_and_optional_seconds(self):
+        assert slo._match("table.add", "table.add.seconds{table=0:w}")
+        assert slo._match("table.add.seconds", "table.add.seconds")
+        assert not slo._match("table.add", "table.get.seconds")
+
+
+class TestSloViolations:
+    def test_forced_violation_counts_and_records(self):
+        metrics.histogram("svc.latency.seconds",
+                          bounds=metrics.LATENCY_BUCKETS).observe(0.5)
+        mon = slo.SloMonitor(slo.parse_slo("svc.latency.p50<1ms"),
+                             action="warn")
+        found = mon.check_once()
+        assert len(found) == 1
+        v = found[0]
+        assert v["metric"] == "svc.latency.seconds"
+        assert v["value_s"] > v["bound_s"] == pytest.approx(1e-3)
+        assert mon.recent_violations() == [v]
+        snap = metrics.snapshot()
+        key = "slo.violations{rule=svc.latency.p50<1ms}"
+        assert snap["counters"][key] == 1
+        # a second pass violates (and counts) again
+        mon.check_once()
+        assert metrics.snapshot()["counters"][key] == 2
+
+    def test_within_bound_is_quiet(self):
+        metrics.histogram("svc.ok.seconds",
+                          bounds=metrics.LATENCY_BUCKETS).observe(1e-4)
+        mon = slo.SloMonitor(slo.parse_slo("svc.ok.p99<1s"))
+        assert mon.check_once() == []
+        assert mon.recent_violations() == []
+
+    def test_empty_histogram_never_violates(self):
+        metrics.histogram("svc.idle.seconds",
+                          bounds=metrics.LATENCY_BUCKETS)
+        mon = slo.SloMonitor(slo.parse_slo("svc.idle.p99<1us"))
+        assert mon.check_once() == []
+
+    def test_dump_action_writes_watchdog_postmortem(self, tmp_path):
+        """MVTPU_SLO_ACTION=dump escalates through the watchdog dump
+        path: post-mortem manifest carries the violations + queues."""
+        metrics.histogram("svc.slow.seconds",
+                          bounds=metrics.LATENCY_BUCKETS).observe(2.0)
+        metrics.QueueGauges("slo-test").sample(3, 1.5)
+        mon = slo.SloMonitor(slo.parse_slo("svc.slow.p50<1ms"),
+                             every_s=3600.0, action="dump",
+                             dump_dir=str(tmp_path), dump_every_s=0.0)
+        mon.start()        # registered: dumps read recent_violations()
+        try:
+            found = mon.check_once()
+        finally:
+            mon.stop()
+        assert len(found) == 1
+        assert mon.last_dump_path and os.path.isdir(mon.last_dump_path)
+        with open(os.path.join(mon.last_dump_path,
+                               "watchdog.json")) as f:
+            manifest = json.load(f)
+        assert manifest["slo_violations"], "dump missing the violations"
+        assert manifest["slo_violations"][-1]["rule"] == \
+            "svc.slow.p50<1ms"
+        assert manifest["queues"]["queue.depth{queue=slo-test}"] == 3
+
+    def test_env_gated_monitor(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("MVTPU_SLO", "svc.env.p99<10ms")
+        monkeypatch.setenv("MVTPU_SLO_EVERY", "3600")
+        mon = slo.maybe_slo_monitor()
+        assert mon is not None
+        try:
+            assert [r.raw for r in slo.active_rules()] == \
+                ["svc.env.p99<10ms"]
+            assert slo.maybe_slo_monitor() is mon     # idempotent
+        finally:
+            mon.stop()
+
+    def test_env_malformed_disables_loudly_not_fatally(self, monkeypatch):
+        monkeypatch.setenv("MVTPU_SLO", "not a rule")
+        assert slo.maybe_slo_monitor() is None
+        monkeypatch.setenv("MVTPU_SLO", "")
+        assert slo.maybe_slo_monitor() is None
+
+
+# -- queue gauges ----------------------------------------------------------
+
+
+class TestQueueGauges:
+    def test_put_take_depth_and_age(self):
+        qg = metrics.QueueGauges("qg-test")
+        depth = metrics.gauge("queue.depth", queue="qg-test")
+        age = metrics.gauge("queue.age_s", queue="qg-test")
+        assert depth.value == 0.0 and age.value == 0.0
+        qg.on_put()
+        qg.on_put()
+        assert depth.value == 2.0
+        qg.on_take()
+        assert depth.value == 1.0
+        qg.refresh()
+        assert age.value >= 0.0
+        qg.on_take()
+        assert depth.value == 0.0 and age.value == 0.0  # drained = 0
+        qg.on_take()                      # over-take must not go negative
+        assert depth.value == 0.0
+
+    def test_self_accounting_sample(self):
+        qg = metrics.QueueGauges("qg-sample")
+        qg.sample(7, 2.5)
+        snap = metrics.snapshot()["gauges"]
+        assert snap["queue.depth{queue=qg-sample}"] == 7.0
+        assert snap["queue.age_s{queue=qg-sample}"] == 2.5
+
+
+# -- statusz server --------------------------------------------------------
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.status, r.read()
+
+
+class TestStatusz:
+    def test_endpoints_over_http(self, tmp_path):
+        from multiverso_tpu.telemetry import statusz
+        path = str(tmp_path / "trace.jsonl")
+        trace.set_trace_file(path)
+        with telemetry.span("sz.region"):
+            pass
+        metrics.counter("sz.ops").inc(3)
+        srv = statusz.StatuszServer(0).start()
+        try:
+            port = srv.port
+            assert port > 0                       # ephemeral bind
+            code, body = _get(port, "/healthz")
+            doc = json.loads(body)
+            assert code == 200 and doc["ok"]      # no armed watchdogs
+            code, body = _get(port, "/metrics")
+            assert code == 200 and b"sz_ops_total 3" in body
+            code, body = _get(port, "/statusz")
+            doc = json.loads(body)
+            assert doc["kind"] == "mvtpu.statusz.v1"
+            assert doc["slo"] == {"rules": [],
+                                  "recent_violations": []}
+            code, body = _get(port, "/trace")
+            assert code == 200 and b"sz.region" in body
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(port, "/bogus")
+            assert ei.value.code == 404
+            # fleet view: a published (pre-merged) snapshot is served
+            srv.publish_fleet(metrics.snapshot())
+            code, body = _get(port, "/metrics?fleet=1")
+            assert code == 200 and b"sz_ops_total 3" in body
+        finally:
+            srv.stop()
+            trace.set_trace_file(None)
+        from multiverso_tpu.telemetry.statusz import server
+        assert server() is None                   # stop() deregisters
+
+    def test_healthz_degrades_with_stalled_watchdog(self):
+        from multiverso_tpu.telemetry import statusz
+        srv = statusz.StatuszServer(0).start()
+        dog = watchdog.Watchdog(0.05, name="sz-dog", action="warn",
+                                poll_s=10.0)
+        dog.start()
+        try:
+            import time as _time
+            _time.sleep(0.1)                      # deadline blown
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(srv.port, "/healthz")
+            assert ei.value.code == 503
+            doc = json.loads(ei.value.read())
+            assert not doc["ok"]
+            assert any(d["name"] == "sz-dog" and not d["ok"]
+                       for d in doc["watchdogs"])
+            dog.beat()
+            code, body = _get(srv.port, "/healthz")
+            assert code == 200 and json.loads(body)["ok"]
+        finally:
+            dog.stop()
+            srv.stop()
+
+    def test_maybe_statusz_env_gate(self, monkeypatch):
+        from multiverso_tpu.telemetry import statusz
+        monkeypatch.delenv("MVTPU_STATUSZ_PORT", raising=False)
+        assert statusz.maybe_statusz() is None
+        monkeypatch.setenv("MVTPU_STATUSZ_PORT", "not-a-port")
+        assert statusz.maybe_statusz() is None
+        monkeypatch.setenv("MVTPU_STATUSZ_PORT", "0")
+        srv = statusz.maybe_statusz()
+        assert srv is not None
+        try:
+            assert statusz.maybe_statusz() is srv     # idempotent
+            assert statusz.server() is srv
+        finally:
+            srv.stop()

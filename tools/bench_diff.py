@@ -19,12 +19,15 @@ autodetects each side:
 
 Prints every shared numeric key with old/new/delta%, plus keys present
 on only one side. Exit status is the CI contract: 0 when every watched
-key holds, 1 when a watched key REGRESSED (dropped) by more than
-``--threshold`` percent (watched metrics are throughputs — higher is
-better; improvements never fail), 2 on unusable input. Default watch
-list: the two metrics of record, the e2e tier, and the client-pipeline
-micro-bench throughputs (each applied when present; ``--watch``
-replaces the whole list).
+key holds, 1 when a watched key REGRESSED by more than ``--threshold``
+percent, 2 on unusable input. Watched keys carry a DIRECTION:
+``--watch`` keys are higher-is-better (throughputs — a drop regresses)
+and ``--watch-lower`` keys are lower-is-better (tail latencies — a
+RISE regresses); improvements never fail either way. Default watch
+list: the metrics of record, the e2e tier, the client-pipeline /
+kernel micro-bench throughputs, and the serving bench's p99 latency
+(each applied when present; any ``--watch``/``--watch-lower`` replaces
+the whole default list).
 
 Pure stdlib, no jax — it must run on the same wedged-tunnel hosts the
 report CLI serves, and in CI (``make bench-diff`` /
@@ -61,7 +64,13 @@ DEFAULT_WATCH = ("value", "e2e_words_per_sec", "lda_doc_tokens_per_sec",
                  # engines vs flat GSPMD XLA): the per-shard Pallas
                  # dispatch rates the sharded engine ships for
                  "kv_probe_ops_per_sec_pallas_sharded",
-                 "coo_scatter_ops_per_sec_pallas_sharded")
+                 "coo_scatter_ops_per_sec_pallas_sharded",
+                 # serving bench (benchmarks/serving.py) throughput —
+                 # its tail latencies ride DEFAULT_WATCH_LOWER below
+                 "serving_ops_per_sec")
+
+# LOWER-is-better watches: a rise past the threshold regresses
+DEFAULT_WATCH_LOWER = ("serving_p99_ms",)
 
 
 def _flatten(prefix: str, obj, out: Dict[str, float]) -> None:
@@ -108,9 +117,11 @@ def load_metrics(path: str) -> Dict[str, float]:
 
 
 def diff(old: Dict[str, float], new: Dict[str, float],
-         watch: Tuple[str, ...], threshold_pct: float
+         watch: Dict[str, str], threshold_pct: float
          ) -> Tuple[List[List[str]], List[str], List[str]]:
-    """(table rows, regressions, only-one-side notes)."""
+    """(table rows, regressions, only-one-side notes). ``watch`` maps
+    key -> direction ("higher" = a drop regresses, "lower" = a rise
+    regresses)."""
     rows: List[List[str]] = []
     regressions: List[str] = []
     for k in sorted(set(old) | set(new)):
@@ -119,13 +130,20 @@ def diff(old: Dict[str, float], new: Dict[str, float],
         o, n = old[k], new[k]
         pct = (n - o) / abs(o) * 100.0 if o else (0.0 if n == o
                                                   else float("inf"))
+        direction = watch.get(k)
         mark = ""
-        if k in watch and pct < -threshold_pct:
+        if direction == "higher" and pct < -threshold_pct:
             mark = "REGRESSED"
             regressions.append(
                 f"{k}: {o:g} -> {n:g} ({pct:+.1f}% < -{threshold_pct:g}%)")
-        elif k in watch:
-            mark = "watched"
+        elif direction == "lower" and pct > threshold_pct:
+            mark = "REGRESSED"
+            regressions.append(
+                f"{k}: {o:g} -> {n:g} ({pct:+.1f}% > +{threshold_pct:g}%"
+                f", lower is better)")
+        elif direction:
+            mark = "watched" if direction == "higher" \
+                else "watched (lower)"
         rows.append([k, f"{o:g}", f"{n:g}",
                      f"{pct:+.1f}%" if pct == pct else "?", mark])
     notes = [f"only in old: {k} = {old[k]:g}"
@@ -155,8 +173,13 @@ def main(argv=None) -> int:
                    metavar="PCT", help="regression tolerance in percent "
                                        "(default 10)")
     p.add_argument("--watch", action="append", default=[], metavar="KEY",
-                   help="metric key that must not regress (repeatable; "
+                   help="higher-is-better key that must not drop "
+                        "(repeatable; any --watch/--watch-lower "
                         "replaces the default watch list)")
+    p.add_argument("--watch-lower", action="append", default=[],
+                   metavar="KEY",
+                   help="LOWER-is-better key (tail latency) that must "
+                        "not rise (repeatable)")
     p.add_argument("--selftest", action="store_true",
                    help="run the built-in self-check and exit")
     args = p.parse_args(argv)
@@ -170,7 +193,12 @@ def main(argv=None) -> int:
     except SystemExit as e:
         print(e.code if isinstance(e.code, str) else e, file=sys.stderr)
         return 2
-    watch = tuple(args.watch) if args.watch else DEFAULT_WATCH
+    if args.watch or args.watch_lower:
+        watch = {k: "higher" for k in args.watch}
+        watch.update({k: "lower" for k in args.watch_lower})
+    else:
+        watch = {k: "higher" for k in DEFAULT_WATCH}
+        watch.update({k: "lower" for k in DEFAULT_WATCH_LOWER})
     rows, regressions, notes = diff(old, new, watch, args.threshold)
     if rows:
         print(_render(rows))
@@ -274,6 +302,37 @@ def selftest() -> int:
         sh_bad = put("sh_bad.json", sh_doc)
         assert main([tk_old, sh_bad]) == 1, \
             "sharded pallas probe regression must fail"
+        # serving bench lines: serving_p99_ms is LOWER-is-better — a
+        # latency RISE regresses, a drop (faster) always passes, and
+        # the throughput key still regresses on a drop
+        sv_old = put("sv_old.json", {
+            "metric": "serving_ops_per_sec", "value": 800.0,
+            "unit": "ops/s", "serving_ops_per_sec": 800.0,
+            "serving_p50_ms": 1.0, "serving_p99_ms": 5.0,
+            "serving_p999_ms": 9.0})
+        sv_doc = json.loads(json.dumps(json.load(open(sv_old))))
+        sv_doc["serving_p99_ms"] = 20.0                 # 4x slower
+        sv_slow = put("sv_slow.json", sv_doc)
+        sv_doc2 = json.loads(json.dumps(json.load(open(sv_old))))
+        sv_doc2["serving_p99_ms"] = 2.0                 # faster
+        sv_doc2["serving_p999_ms"] = 200.0              # unwatched rise
+        sv_fast = put("sv_fast.json", sv_doc2)
+        assert main([sv_old, sv_old]) == 0, "identical serving line"
+        assert main([sv_old, sv_slow]) == 1, \
+            "p99 latency rise must fail (lower is better)"
+        assert main([sv_old, sv_fast]) == 0, \
+            "a faster p99 must pass; unwatched p999 rides along"
+        sv_doc3 = json.loads(json.dumps(json.load(open(sv_old))))
+        sv_doc3["serving_ops_per_sec"] = 100.0          # -87%
+        sv_doc3["value"] = 100.0
+        assert main([sv_old, put("sv_thr.json", sv_doc3)]) == 1, \
+            "serving throughput drop must fail"
+        assert main([sv_old, sv_slow, "--watch-lower",
+                     "serving_p999_ms"]) == 0, \
+            "--watch-lower replaces the default list"
+        assert main([sv_old, sv_fast, "--watch-lower",
+                     "serving_p999_ms"]) == 1, \
+            "explicit lower-is-better watch catches the p999 rise"
         # unusable inputs exit 2, not a traceback
         hung = put("hung.json", {"rc": 124, "tail": "...", "parsed": None})
         assert main([hung, raw_ok]) == 2, "no parsed line -> exit 2"

@@ -1,0 +1,141 @@
+"""CI smoke for the serving/observability stack (``make serve-smoke``).
+
+One process, end to end: arm the statusz server on an ephemeral port,
+arm a (generous) SLO rule and the span trace sink, run the tiny
+serving bench in-process, then scrape every introspection endpoint
+over real HTTP and assert the whole loop closed:
+
+- the bench completed deadlock-free (>= 8 client threads, one
+  dispatcher) and published non-null ``serving_p50/p99/p999_ms``
+  gauges through the registry,
+- ``/healthz`` answers 200 with every watchdog green,
+- ``/metrics`` exposes the serving histogram + quantile gauges,
+- ``/statusz`` shows the armed SLO rule, the serving tables, and the
+  kernel-engine selections,
+- ``/trace`` serves span JSONL whose request ids stitch client spans
+  to their dispatch/flush children.
+
+Exit code 0 = the serving story works; any assertion prints a reason
+and exits 1. Stdlib only (urllib against our own stdlib server).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_TMP = tempfile.mkdtemp(prefix="mvtpu_serve_smoke_")
+os.environ.setdefault("MVTPU_SERVING_TINY", "1")
+os.environ.setdefault("MVTPU_STATUSZ_PORT", "0")
+# generous threshold: the smoke asserts the PLUMBING, not the latency
+os.environ.setdefault("MVTPU_SLO", "serving.latency.p99<600s")
+os.environ.setdefault("MVTPU_TRACE_JSONL",
+                      os.path.join(_TMP, "trace.jsonl"))
+os.environ.setdefault("MVTPU_SERVING_BENCH_JSON",
+                      os.path.join(_TMP, "serving_bench.json"))
+
+FAILURES: list = []
+
+
+def check(ok: bool, what: str) -> None:
+    tag = "ok" if ok else "FAIL"
+    print(f"serve-smoke: [{tag}] {what}")
+    if not ok:
+        FAILURES.append(what)
+
+
+def fetch(port: int, path: str) -> tuple:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+        return r.status, r.read()
+
+
+def main() -> int:
+    from benchmarks import serving
+    serving.main()          # raises SystemExit on deadlock/timeout
+
+    from multiverso_tpu import telemetry
+    from multiverso_tpu.telemetry import statusz
+
+    with open(os.environ["MVTPU_SERVING_BENCH_JSON"]) as f:
+        bench = json.load(f)
+    for k in ("serving_p50_ms", "serving_p99_ms", "serving_p999_ms"):
+        check(isinstance(bench.get(k), (int, float)),
+              f"bench artifact has numeric {k}={bench.get(k)}")
+    check(bench.get("serving_threads", 0) >= 8,
+          f"bench ran >= 8 client threads "
+          f"({bench.get('serving_threads')})")
+
+    snap = telemetry.snapshot()
+    for k in ("serving_p50_ms", "serving_p99_ms", "serving_p999_ms"):
+        check(isinstance(snap["gauges"].get(k), (int, float)),
+              f"registry gauge {k} published")
+
+    srv = statusz.server()
+    check(srv is not None, "statusz server armed by MVTPU_STATUSZ_PORT")
+    if srv is None:
+        return 1
+    port = srv.port
+
+    code, body = fetch(port, "/healthz")
+    health = json.loads(body)
+    check(code == 200 and health["ok"],
+          f"/healthz 200 ok (watchdogs={len(health['watchdogs'])})")
+
+    code, body = fetch(port, "/metrics")
+    text = body.decode()
+    check(code == 200 and "serving_latency_seconds" in text,
+          "/metrics exposes the serving latency histogram")
+    check("serving_p99_ms" in text, "/metrics exposes serving_p99_ms")
+
+    code, body = fetch(port, "/statusz")
+    doc = json.loads(body)
+    check(code == 200 and doc.get("kind") == "mvtpu.statusz.v1",
+          "/statusz serves the status document")
+    check(any("serving.latency" in r for r in doc["slo"]["rules"]),
+          f"/statusz shows the armed SLO rule ({doc['slo']['rules']})")
+    names = {t["name"] for t in doc["tables"]}
+    check({"serve_dense", "serve_kv"} <= names,
+          f"/statusz lists the serving tables ({sorted(names)})")
+    check(any(k.startswith("kernels.selected")
+              for k in doc["kernels"]["selected"]),
+          "/statusz shows kernel-engine selections")
+
+    code, body = fetch(port, "/trace")
+    spans = [json.loads(ln) for ln in body.decode().splitlines() if ln]
+    check(code == 200 and len(spans) > 0,
+          f"/trace serves span JSONL ({len(spans)} spans in tail)")
+    reqs = {s.get("req") for s in spans if s.get("req")}
+    check(len(reqs) > 0,
+          f"spans carry request ids ({len(reqs)} distinct requests)")
+    by_req: dict = {}
+    for s in spans:
+        if s.get("req"):
+            by_req.setdefault(s["req"], set()).add(s.get("name"))
+    linked = [r for r, names_ in by_req.items() if len(names_) >= 2]
+    check(len(linked) > 0,
+          f"some request links >= 2 span kinds "
+          f"(e.g. {sorted(by_req.get(linked[0], []))[:4] if linked else []})")
+
+    import urllib.error
+    try:
+        fetch(port, "/nope")
+        check(False, "unknown path returns 404")
+    except urllib.error.HTTPError as e:
+        check(e.code == 404, f"unknown path returns 404 ({e.code})")
+
+    if FAILURES:
+        print(f"serve-smoke: FAILED ({len(FAILURES)}): {FAILURES}",
+              file=sys.stderr)
+        return 1
+    print("serve-smoke: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
