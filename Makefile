@@ -25,6 +25,11 @@
 #                   TINY forces 2 virtual CPU devices so it always
 #                   runs; drop MVTPU_KERNEL_BENCH_TINY for real sizes
 #                   on TPU; emits table_kernels_bench.json)
+#   make health-smoke - training-health smoke: tiny sparse-logreg run
+#                   with a chaos-injected NaN, asserting the fused
+#                   stats audit catches it, /healthz flips 503, and
+#                   MVTPU_HEALTH_ACTION=rollback restores the last
+#                   pre-violation checkpoint generation
 #   make serve-smoke - serving/observability smoke: tiny serving bench
 #                   (8 client threads, one dispatcher) in-process with
 #                   an ephemeral statusz server + SLO rule armed, then
@@ -42,8 +47,8 @@ OLD ?= BENCH_r04.json
 NEW ?= BENCH_r05.json
 
 .PHONY: test dryrun bench bench-dryrun bench-diff bench-diff-selftest \
-	client-bench ckpt-bench kernel-bench serve-smoke chaos fuzz lint \
-	native ci
+	client-bench ckpt-bench kernel-bench serve-smoke health-smoke \
+	chaos fuzz lint native ci
 
 fuzz:
 	$(PY) tests/deep_fuzz.py
@@ -74,6 +79,9 @@ kernel-bench:
 
 serve-smoke:
 	$(PY) tools/serve_smoke.py
+
+health-smoke:
+	$(PY) tools/health_smoke.py
 
 # the chaos lane: recovery paths exercised under injected faults —
 # the ft test subset, the overwrite crash-window fuzz, and an app CLI
@@ -109,4 +117,5 @@ native:
 	$(MAKE) -C native
 
 ci: lint bench-diff-selftest native test dryrun bench-dryrun \
-	client-bench ckpt-bench kernel-bench serve-smoke chaos
+	client-bench ckpt-bench kernel-bench serve-smoke health-smoke \
+	chaos

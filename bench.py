@@ -349,6 +349,22 @@ def _beat() -> None:
         _WATCHDOG.beat()
 
 
+def _counter_snapshot(*prefixes: str) -> dict:
+    """Flat ``{counter_key: value}`` for counters under the given name
+    prefixes — the engine/health provenance the BENCH line embeds so a
+    capture self-identifies (which kernels actually ran Pallas vs fell
+    back, whether the numerics audit flagged anything) without needing
+    the sidecar telemetry snapshot."""
+    if _TELEMETRY is None:
+        return {}
+    try:
+        counters = _TELEMETRY.snapshot().get("counters", {})
+    except Exception:            # diagnostics must never kill the bench
+        return {}
+    return {k: v for k, v in sorted(counters.items())
+            if k.startswith(prefixes)}
+
+
 def _write_telemetry_snapshot() -> None:
     if _TELEMETRY is not None:
         try:
@@ -691,6 +707,10 @@ def main() -> None:
         # achieved-vs-chip accounting (benchmarks/roofline.py model)
         "w2v_roofline": roofline.w2v_utilization(
             pairs_per_sec / max(n_chips, 1), DIM, NEGATIVE),
+        # provenance: engine fallbacks + training-health violations at
+        # capture time (numeric leaves ride bench_diff unwatched)
+        "counters": _counter_snapshot("kernels.fallbacks",
+                                      "health.violations"),
     }
     # print the w2v capture BEFORE attempting the LDA tier: the driver
     # records the LAST complete JSON line, so if the tunnel wedges
@@ -723,6 +743,10 @@ def main() -> None:
     record_device_memory()
     _beat()                      # lda tier resolved either way
     if lda:
+        # refresh provenance: the LDA tier's own fallbacks/violations
+        # belong on the final combined line
+        w2v_line["counters"] = _counter_snapshot("kernels.fallbacks",
+                                                 "health.violations")
         print(json.dumps({**w2v_line, **lda}))
 
 

@@ -127,6 +127,35 @@ def bench_kv_staged() -> dict:
     return {"ops_s": len(batches) / dt}
 
 
+def bench_kv_health() -> dict:
+    """The direct lane re-run with the training-health audit ON: a
+    temporary HealthMonitor (no rules — pure observation cost) makes
+    every ``add`` dispatch the fused stats vector too. The ratio vs
+    ``bench_kv_direct`` is the audit's hot-path overhead (the async
+    poller does the D2H off-thread, so this should stay within a few
+    percent)."""
+    from multiverso_tpu.telemetry import health
+    mon = health.install(health.HealthMonitor([]).start())
+    try:
+        kv = KVTable(SIZES["keys"] * 16, value_dim=SIZES["value_dim"],
+                     name="bench_kv_health")
+        batches = _kv_batches(0)
+
+        def run():
+            for keys, deltas in batches:
+                kv.add(keys, deltas)
+            kv.wait()
+
+        run()       # warmup: compile apply + stats signatures once
+        t0 = time.perf_counter()
+        run()
+        dt = time.perf_counter() - t0
+        mon.drain()
+        return {"ops_s": len(batches) / dt}
+    finally:
+        health.uninstall()
+
+
 def bench_get_direct() -> dict:
     t = ArrayTable(SIZES["table_n"], "float32", name="bench_get_direct")
     delta = np.ones(SIZES["table_n"], np.float32)
@@ -168,6 +197,7 @@ def main() -> None:
     direct = bench_kv_direct()
     coal = bench_kv_coalesced()
     staged = bench_kv_staged()
+    health_on = bench_kv_health()
     g_direct = bench_get_direct()
     g_cached = bench_get_cached()
     line = {
@@ -178,6 +208,9 @@ def main() -> None:
         "kv_add_ops_per_sec_direct": round(direct["ops_s"], 2),
         "kv_add_ops_per_sec_coalesced": round(coal["ops_s"], 2),
         "kv_add_ops_per_sec_staged": round(staged["ops_s"], 2),
+        "kv_add_ops_per_sec_health": round(health_on["ops_s"], 2),
+        "kv_add_health_overhead": round(direct["ops_s"]
+                                        / health_on["ops_s"], 3),
         "kv_add_coalesce_speedup": round(coal["ops_s"]
                                          / direct["ops_s"], 3),
         "kv_apply_dispatches_direct": direct["dispatches"],

@@ -1579,7 +1579,16 @@ class LightLDA:
         # calls start from 0 like they always did
         start_sweep = min(self._resume_sweeps, iters)
         self._resume_sweeps = 0
-        for it in range(start_sweep, iters):
+        it = start_sweep
+        while it < iters:
+            # divergence rollback (MVTPU_HEALTH_ACTION=rollback):
+            # restore_run_state moved the sweep cursor back to the last
+            # clean generation — replay from there (sweep keys derive
+            # from _calls_done, which the restore also rewound)
+            if telemetry.health.maybe_rollback(self) is not None:
+                it = min(self._resume_sweeps, iters)
+                self._resume_sweeps = 0
+                continue
             t_sweep = time.perf_counter()
             with telemetry.span("lda.sweep"):
                 self.sweep()
@@ -1601,11 +1610,12 @@ class LightLDA:
                 # legacy periodic full-state dump (sampler state
                 # included, so a crash resumes mid-training); collective
                 self.store(self.config.checkpoint_prefix)
-            if (it + 1) % every and it != iters - 1:
+            it += 1
+            if it % every and it != iters:
                 continue
             ll = self.loglik()
             self.ll_history.append(ll)
-            log.info("lightlda iter %d: loglik/token=%.4f", it, ll)
+            log.info("lightlda iter %d: loglik/token=%.4f", it - 1, ll)
         dt = time.perf_counter() - t0
         tokens = self.num_tokens * max(iters - start_sweep, 0)
         telemetry.counter("lda.tokens").inc(tokens)

@@ -377,13 +377,21 @@ class LogisticRegression:
         # the table state is exact (CRC-verified restore) and each
         # epoch's shuffle seed derives from its index, so the remaining
         # epochs replay identically to the uninterrupted run
-        start = min(self._resume_epochs, self.config.epochs)
+        e = min(self._resume_epochs, self.config.epochs)
         self._resume_epochs = 0
-        for e in range(start, self.config.epochs):
+        while e < self.config.epochs:
+            # divergence rollback (MVTPU_HEALTH_ACTION=rollback): the
+            # restore ran restore_run_state, so re-read the cursor and
+            # replay from the last clean generation
+            if telemetry.health.maybe_rollback(self) is not None:
+                e = min(self._resume_epochs, self.config.epochs)
+                self._resume_epochs = 0
+                continue
             loss = self.train_epoch(X, y, shuffle_seed=self.config.seed + e)
             self._epoch_done = e + 1
             if self.run_ckpt is not None:
                 self.run_ckpt.maybe_save(self._epoch_done, self.run_state)
+            e += 1
         return loss
 
     # -- fault tolerance (ft.checkpoint contract) --------------------------

@@ -222,9 +222,17 @@ class SparseLogisticRegression:
         # resume (applied ONCE): table state restored exactly at an
         # epoch boundary and each epoch's permutation seed derives from
         # its index, so the remaining epochs replay identically
-        start = min(self._resume_epochs, c.epochs)
+        e = min(self._resume_epochs, c.epochs)
         self._resume_epochs = 0
-        for e in range(start, c.epochs):
+        while e < c.epochs:
+            # divergence rollback (MVTPU_HEALTH_ACTION=rollback):
+            # restore_run_state just moved the cursor — replay from the
+            # last clean generation (epoch RNG derives from the index,
+            # so the replay is deterministic)
+            if telemetry.health.maybe_rollback(self) is not None:
+                e = min(self._resume_epochs, c.epochs)
+                self._resume_epochs = 0
+                continue
             order = np.random.default_rng(c.seed + e).permutation(n)
             losses = []
             for s in range(0, n, c.minibatch_size):
@@ -249,6 +257,7 @@ class SparseLogisticRegression:
                 # export_checkpoint_async flushes the coalescer, so the
                 # checkpoint observes every buffered delta
                 self.run_ckpt.maybe_save(self._epoch_done, self.run_state)
+            e += 1
         if self._coalescer is not None:
             # the tail partial group must land before eval/checkpoint
             self._coalescer.flush()

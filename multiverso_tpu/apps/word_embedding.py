@@ -491,6 +491,16 @@ class WordEmbedding:
             losses.append(loss)
             srcs_buf, tgts_buf = [], []
             call_no += 1
+            if telemetry.health.maybe_rollback(self) is not None:
+                # divergence rollback: tables + the step cursor are
+                # back at the last clean generation (LR decay and the
+                # fold_in key sequence re-align through _step_no). The
+                # pair stream itself cannot rewind — training resumes
+                # on fresh batches from the restored parameters, which
+                # for a stochastic stream is equivalent to a replay.
+                # Checked BEFORE maybe_save so a diverged state is
+                # never committed as a generation.
+                continue
             if self.run_ckpt is not None:
                 # run-level manager (preferred over the bespoke prefix
                 # dump): atomically-committed generations, keep-K

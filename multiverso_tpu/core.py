@@ -142,11 +142,14 @@ def init(argv: Optional[Sequence[str]] = None, *,
 
         # observability rides init the same way: MVTPU_STATUSZ_PORT
         # arms the live introspection server, MVTPU_SLO the tail-
-        # latency monitor (both idempotent across re-inits)
+        # latency monitor, MVTPU_HEALTH the training-health monitor
+        # (all idempotent across re-inits)
+        from multiverso_tpu.telemetry.health import maybe_health_monitor
         from multiverso_tpu.telemetry.slo import maybe_slo_monitor
         from multiverso_tpu.telemetry.statusz import maybe_statusz
         maybe_statusz()
         maybe_slo_monitor()
+        maybe_health_monitor()
 
         devs = list(devices) if devices is not None else jax.devices()
         dp = data_parallel if data_parallel is not None \

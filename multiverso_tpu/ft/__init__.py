@@ -26,8 +26,9 @@ steps/sweeps), ``MVTPU_CKPT_KEEP`` (retained generations, default 3),
 
 from multiverso_tpu.ft.chaos import (ChaosCrash, ChaosError,
                                      ChaosInjector, ChaosTornWrite,
-                                     chaos_from_env, chaos_point,
-                                     install_chaos, uninstall_chaos)
+                                     chaos_corrupt, chaos_from_env,
+                                     chaos_point, install_chaos,
+                                     uninstall_chaos)
 
 _RETRY = ("RetryError", "RetryPolicy", "io_retry_policy")
 _CKPT = ("CheckpointGeneration", "RestoredState", "RunCheckpointManager",
@@ -51,6 +52,7 @@ def __getattr__(name):
 
 __all__ = [
     "ChaosCrash", "ChaosError", "ChaosInjector", "ChaosTornWrite",
-    "chaos_from_env", "chaos_point", "install_chaos", "uninstall_chaos",
+    "chaos_corrupt", "chaos_from_env", "chaos_point", "install_chaos",
+    "uninstall_chaos",
     *_RETRY, *_CKPT,
 ]

@@ -24,12 +24,19 @@ Spec grammar (semicolon-separated rules)::
     land, the rename never happens) by raising :class:`ChaosTornWrite`
     *after* the payload is on disk,
   - ``crash``   — raise :class:`ChaosCrash` (NOT an OSError: retry
-    policies never swallow it — it simulates the process dying).
+    policies never swallow it — it simulates the process dying),
+  - ``nan``     — VALUE corruption: poison deterministic elements of
+    the tensor flowing through a :func:`chaos_corrupt` point (the
+    ``table.add`` delta paths) with NaN. Nothing raises — the bad
+    numbers propagate exactly like a real fused-kernel NaN, which is
+    what the training-health layer (`telemetry/health.py`) must catch.
 - params:
   - ``p=<float>``   — firing probability per hit (default 1.0),
   - ``after=<int>`` — skip the first N matching hits (default 0),
   - ``times=<int>`` — fire at most N times (default unlimited),
-  - ``ms=<float>``  — latency milliseconds (``latency`` kind, default 1).
+  - ``ms=<float>``  — latency milliseconds (``latency`` kind, default 1),
+  - ``frac=<float>`` — fraction of elements to poison (``nan`` kind,
+    default 0 = a single element).
 
 Determinism: the injector derives every probabilistic draw from
 ``splitmix64(seed, point-hit-counter)`` — same spec, same call
@@ -52,7 +59,9 @@ Fault points in the codebase (grep ``chaos_point(`` for ground truth):
                       final path is never updated)
 ``io.mv.aside``       fsspec overwrite: the ``final -> final.bak`` move
 ``io.mv.replace``     fsspec overwrite: the ``tmp -> final`` move
-``table.add``         dense/KV table Add dispatch (`tables/base.py`)
+``table.add``         dense/KV table Add dispatch (`tables/base.py`) —
+                      also a :func:`chaos_corrupt` value point: ``nan``
+                      rules poison the delta before it reaches devices
 ``table.get``         whole-table Get dispatch
 ``core.barrier``      the global barrier (`core.py`)
 ``multihost.allgather``  multihost collectives (`parallel/multihost.py`)
@@ -103,11 +112,12 @@ def _splitmix64(x: int) -> int:
 class ChaosRule:
     """One parsed spec rule (see module docstring for the grammar)."""
     pattern: str
-    kind: str                   # error | latency | torn | crash
+    kind: str                   # error | latency | torn | crash | nan
     p: float = 1.0
     after: int = 0
     times: Optional[int] = None
     ms: float = 1.0
+    frac: float = 0.0           # nan kind: fraction poisoned (0 = one)
     # runtime state
     hits: int = 0               # matching hits seen
     fired: int = 0              # faults actually fired
@@ -116,7 +126,7 @@ class ChaosRule:
         return fnmatch.fnmatchcase(point, self.pattern)
 
 
-KINDS = ("error", "latency", "torn", "crash")
+KINDS = ("error", "latency", "torn", "crash", "nan")
 
 
 def parse_chaos_spec(spec: str) -> "ChaosInjector":
@@ -159,10 +169,12 @@ def parse_chaos_spec(spec: str) -> "ChaosInjector":
                     rule.times = int(v)
                 elif k == "ms":
                     rule.ms = float(v)
+                elif k == "frac":
+                    rule.frac = float(v)
                 else:
                     raise ValueError(
                         f"chaos rule {raw!r}: unknown param {k!r} "
-                        "(valid: p, after, times, ms)")
+                        "(valid: p, after, times, ms, frac)")
         rules.append(rule)
     return ChaosInjector(rules=rules, seed=seed)
 
@@ -180,31 +192,65 @@ class ChaosInjector:
         """Evaluate the fault point: no-op, sleep, or raise. Called by
         :func:`chaos_point` when an injector is installed."""
         for rule in self.rules:
-            if not rule.matches(point):
+            # nan is a VALUE fault: it only fires through corrupt()
+            # (falling through to _fire would raise ChaosCrash)
+            if rule.kind == "nan" or not rule.matches(point):
                 continue
-            with self._lock:
-                rule.hits += 1
-                n = rule.hits
-                if n <= rule.after:
-                    continue
-                if rule.times is not None and rule.fired >= rule.times:
-                    continue
-                if rule.p < 1.0:
-                    # deterministic draw: hash(seed, pattern, hit index)
-                    # — crc32, not hash(): str hash is randomized per
-                    # process (PYTHONHASHSEED), which would make the
-                    # same spec fire differently across processes
-                    import zlib
-                    pat = zlib.crc32(rule.pattern.encode())
-                    h = _splitmix64(self.seed ^ _splitmix64(pat) ^ n)
-                    if (h / 2.0 ** 64) >= rule.p:
-                        continue
-                rule.fired += 1
-            self._fire(rule, point)
+            if self._account(rule):
+                self._fire(rule, point)
 
-    def _fire(self, rule: ChaosRule, point: str) -> None:
-        # telemetry through sys.modules only (an installed injector in
-        # a jax-free process must not drag the package in)
+    def _account(self, rule: ChaosRule) -> bool:
+        """Shared hit accounting: after/times gating + the
+        deterministic probability draw. True = the rule fires now."""
+        with self._lock:
+            rule.hits += 1
+            n = rule.hits
+            if n <= rule.after:
+                return False
+            if rule.times is not None and rule.fired >= rule.times:
+                return False
+            if rule.p < 1.0:
+                # deterministic draw: hash(seed, pattern, hit index)
+                # — crc32, not hash(): str hash is randomized per
+                # process (PYTHONHASHSEED), which would make the
+                # same spec fire differently across processes
+                import zlib
+                pat = zlib.crc32(rule.pattern.encode())
+                h = _splitmix64(self.seed ^ _splitmix64(pat) ^ n)
+                if (h / 2.0 ** 64) >= rule.p:
+                    return False
+            rule.fired += 1
+        return True
+
+    def corrupt(self, point: str, arr):
+        """Evaluate the value-fault point: pass ``arr`` through every
+        matching ``nan`` rule. Returns ``arr`` untouched (same object)
+        when nothing fires; a poisoned COPY otherwise — callers hand
+        the result on, they never see an exception."""
+        for rule in self.rules:
+            if rule.kind != "nan" or not rule.matches(point):
+                continue
+            if self._account(rule):
+                arr = self._poison(rule, point, arr)
+        return arr
+
+    def _poison(self, rule: ChaosRule, point: str, arr):
+        import zlib
+
+        import numpy as np
+        out = np.array(arr, copy=True)
+        if out.size == 0 or not np.issubdtype(out.dtype, np.floating):
+            return arr
+        count = max(1, int(rule.frac * out.size))
+        flat = out.reshape(-1)
+        pat = zlib.crc32(rule.pattern.encode())
+        base = self.seed ^ _splitmix64(pat) ^ (rule.fired << 20)
+        for i in range(min(count, out.size)):
+            flat[_splitmix64(base ^ i) % out.size] = np.nan
+        self._note_fired(rule, point)
+        return out
+
+    def _note_fired(self, rule: ChaosRule, point: str) -> None:
         import sys
         m = sys.modules.get("multiverso_tpu.telemetry.metrics")
         if m is not None:
@@ -213,6 +259,11 @@ class ChaosInjector:
                           kind=rule.kind).inc()
             except Exception:
                 pass
+
+    def _fire(self, rule: ChaosRule, point: str) -> None:
+        # telemetry through sys.modules only (an installed injector in
+        # a jax-free process must not drag the package in)
+        self._note_fired(rule, point)
         if rule.kind == "latency":
             time.sleep(rule.ms / 1000.0)
             return
@@ -270,3 +321,13 @@ def chaos_point(point: str) -> None:
     inj = _INSTALLED
     if inj is not None:
         inj.hit(point)
+
+
+def chaos_corrupt(point: str, arr):
+    """The VALUE fault-point hook: code holding a host tensor passes it
+    through; ``nan`` rules matching ``point`` poison a copy. Same
+    one-check cost as :func:`chaos_point` when chaos is off."""
+    inj = _INSTALLED
+    if inj is None:
+        return arr
+    return inj.corrupt(point, arr)

@@ -430,13 +430,27 @@ class RunCheckpointManager:
         out.sort(key=lambda g: g.step)
         return out
 
-    def resume(self, tables: Optional[Sequence[Any]] = None
-               ) -> Optional[RestoredState]:
+    def resume(self, tables: Optional[Sequence[Any]] = None, *,
+               before_unix_time: Optional[float] = None,
+               max_step: Optional[int] = None) -> Optional[RestoredState]:
         """Restore the latest complete generation (fall back to older
         ones when a payload fails verification). Returns the app
         train-state, or None when the run dir holds no usable
-        checkpoint (a fresh run)."""
+        checkpoint (a fresh run).
+
+        ``before_unix_time`` / ``max_step`` restrict the search to
+        generations committed strictly before that wall time / at or
+        below that step — the health monitor's rollback uses the former
+        to land on the newest generation PREDATING a divergence (a
+        generation saved after the bad values entered storage would
+        just restore the divergence)."""
         gens = self.scan()
+        if before_unix_time is not None:
+            gens = [g for g in gens
+                    if float(g.manifest.get("unix_time", 0.0))
+                    < before_unix_time]
+        if max_step is not None:
+            gens = [g for g in gens if g.step <= max_step]
         cover = list(tables) if tables is not None \
             else self._resolve_tables()
         for gen in reversed(gens):

@@ -40,8 +40,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from multiverso_tpu import core
-from multiverso_tpu.ft.chaos import chaos_point
+from multiverso_tpu.ft.chaos import chaos_corrupt, chaos_point
 from multiverso_tpu.io import open_stream
+from multiverso_tpu.telemetry import health as _health
 from multiverso_tpu.telemetry import metrics as telemetry
 from multiverso_tpu.telemetry import trace as tracing
 from multiverso_tpu.telemetry.profiling import profiled_jit
@@ -446,6 +447,7 @@ class Table:
             elems = int(np.prod(self.logical_shape)) \
                 if self.logical_shape else 1
             self._record_op("get", elems, elems * self.dtype.itemsize)
+            _health.observe_param(self)
             out = self._snapshot(self.param)
         self._h_get.observe(time.monotonic() - t0)
         return out
@@ -470,6 +472,7 @@ class Table:
         blocking Add.
         """
         chaos_point("table.add")
+        delta = chaos_corrupt("table.add", delta)
         t0 = time.monotonic()
         with tracing.span("table.add",
                           table=f"{self.table_id}:{self.name}",
@@ -495,9 +498,11 @@ class Table:
             elems = int(np.prod(self.logical_shape)) \
                 if self.logical_shape else 1
             self._record_op("add", elems, elems * self.dtype.itemsize)
+            _health.observe_update(self, delta)
             opt = self._resolve_option(option)
             self.param, self.state = self._apply(self.param, self.state,
                                                  delta, opt)
+            _health.observe_param(self)
             handle = Handle(table=self, generation=self._bump_step())
             if sync:
                 handle.wait()

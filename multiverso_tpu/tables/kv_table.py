@@ -41,6 +41,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from multiverso_tpu import core
+from multiverso_tpu.ft.chaos import chaos_corrupt
 from multiverso_tpu.ops import table_kernels as tk
 from multiverso_tpu.tables.base import (Handle, Table, _register,
                                         loadz_stream, pack_state,
@@ -51,6 +52,7 @@ from multiverso_tpu.tables.base import (Handle, Table, _register,
 from multiverso_tpu.tables.hashing import (EMPTY_KEY, _bucket, _hash_u64,
                                            _join_keys, _split_keys,
                                            shard_lane_slices)
+from multiverso_tpu.telemetry import health as _health
 from multiverso_tpu.telemetry import metrics as telemetry
 from multiverso_tpu.telemetry import trace as tracing
 from multiverso_tpu.telemetry.profiling import profiled_jit
@@ -521,6 +523,7 @@ class KVTable:
         want = (n, self.value_dim) if self.value_dim else (n,)
         if deltas.shape != want:
             raise ValueError(f"deltas shape {deltas.shape} != {want}")
+        deltas = chaos_corrupt("table.add", deltas)
         lane_buckets = self._buckets_of(keys)
         order = np.argsort(lane_buckets, kind="stable")
         keys = keys[order]
@@ -578,12 +581,14 @@ class KVTable:
                           table=f"{self.table_id}:{self.name}",
                           engine=self._probe_update.engine, sync=sync):
             self._record_op("add", prepared.elems, prepared.nbytes)
+            _health.observe_update(self, prepared.deltas)
             self.keys, self.values, self.state, n_over = \
                 self._probe_update(
                     self.keys, self.values, self.state,
                     prepared.buckets, prepared.query, prepared.deltas,
                     prepared.valid, prepared.option)
             self._pending_over.append(n_over)
+            _health.observe_param(self, self.values)
             with self._option_lock:
                 self.default_option.step += 1
                 self.generation += 1

@@ -32,11 +32,13 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from multiverso_tpu import core
+from multiverso_tpu.ft.chaos import chaos_corrupt
 from multiverso_tpu.ops import table_kernels as tk
 from multiverso_tpu.tables.base import Handle, Table
 # _bucket lives in tables/hashing.py now (shared with the kernel
 # engine); re-imported here for historical import sites
 from multiverso_tpu.tables.hashing import _bucket, shard_lane_slices
+from multiverso_tpu.telemetry import health as _health
 from multiverso_tpu.telemetry.profiling import profiled_jit
 from multiverso_tpu.updaters import AddOption
 
@@ -297,8 +299,10 @@ class MatrixTable(Table):
         if deltas.shape != (len(ids), self.num_cols):
             raise ValueError(f"deltas shape {deltas.shape} != "
                              f"({len(ids)}, {self.num_cols})")
+        deltas = chaos_corrupt("table.add", deltas)
         self._record_op("add", deltas.size,
                         deltas.size * self.dtype.itemsize)
+        _health.observe_update(self, deltas)
         if self.updater.name in ("default", "sgd"):
             if self.updater.name == "sgd":
                 # stateless: scatter-add of -lr*delta, duplicate-safe

@@ -41,10 +41,12 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from multiverso_tpu import core
+from multiverso_tpu.ft.chaos import chaos_corrupt
 from multiverso_tpu.ops import table_kernels as tk
 from multiverso_tpu.tables.base import Handle
 from multiverso_tpu.tables.hashing import _bucket, shard_lane_slices
 from multiverso_tpu.tables.matrix_table import MatrixTable
+from multiverso_tpu.telemetry import health as _health
 from multiverso_tpu.telemetry.profiling import profiled_jit
 from multiverso_tpu.updaters import AddOption
 
@@ -303,7 +305,9 @@ class SparseMatrixTable(MatrixTable):
             raise ValueError(f"col ids out of range [0, {self.num_cols})")
 
         n = len(rows)
+        values = chaos_corrupt("table.add", values)
         self._record_op("add", n, n * self.dtype.itemsize)
+        _health.observe_update(self, values)
         # stable row sort: the Pallas COO engine segment-sums each row's
         # run in VMEM (requires sorted rows; same-(row,col) duplicates
         # keep their input order, so float accumulation order matches

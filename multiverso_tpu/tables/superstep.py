@@ -66,6 +66,7 @@ from multiverso_tpu.ops.table_kernels import (coo_scatter_add,
                                               gather_rows,
                                               row_scatter_add)
 from multiverso_tpu.tables.base import Handle, Table
+from multiverso_tpu.telemetry import health as _health
 from multiverso_tpu.telemetry.profiling import profiled_jit
 from multiverso_tpu.updaters import AddOption
 
@@ -149,6 +150,10 @@ class FusedSuperstep:
             nbytes = elems * t.dtype.itemsize
             t._record_op("get", elems, nbytes)
             t._record_op("add", elems, nbytes)
+            # fused updates never pass through add(), so the numerics
+            # audit samples the written-back storage here (stride-gated
+            # inside observe_param; a no-op when health is off)
+            _health.observe_param(t, p)
             gen = t._bump_step()
             if t is self.tables[0]:
                 # mint from the returned generation (racing with

@@ -26,6 +26,11 @@ and a report CLI.
   rules (``MVTPU_SLO=table.add.p99<5ms,...``) evaluated on snapshot
   cadence; violations counted and escalated through the watchdog
   warn → dump path.
+- :mod:`multiverso_tpu.telemetry.health` — training-health monitor:
+  fused device-side numerics stats (``ops/stat_kernels.py``) folded
+  into per-table EWMA drift windows, a ``MVTPU_HEALTH`` rule grammar
+  mirroring the SLO one, and ``MVTPU_HEALTH_ACTION=dump|rollback``
+  escalation closing the loop into the ``ft/`` checkpoint machinery.
 - :mod:`multiverso_tpu.telemetry.profiling` — the compile side:
   :func:`profiled_jit` (lowering/compile wall time + XLA cost/memory
   analysis per jitted function), :func:`record_device_memory`
@@ -65,17 +70,19 @@ from multiverso_tpu.telemetry.trace import (adopt, current_request,
 from multiverso_tpu.telemetry.watchdog import (Watchdog,
                                                active_watchdogs, beat,
                                                maybe_watchdog)
-# statusz/slo import AFTER the siblings above: they resolve metrics/
-# trace/watchdog through the already-bound package attributes
-from multiverso_tpu.telemetry import slo, statusz
+# statusz/slo/health import AFTER the siblings above: they resolve
+# metrics/trace/watchdog through the already-bound package attributes
+from multiverso_tpu.telemetry import health, slo, statusz
+from multiverso_tpu.telemetry.health import (HealthMonitor,
+                                             maybe_health_monitor)
 from multiverso_tpu.telemetry.slo import SloMonitor, maybe_slo_monitor
 from multiverso_tpu.telemetry.statusz import (StatuszServer,
                                               maybe_statusz,
                                               publish_fleet)
 
 __all__ = [
-    "aggregate", "metrics", "profiling", "slo", "statusz", "trace",
-    "watchdog",
+    "aggregate", "health", "metrics", "profiling", "slo", "statusz",
+    "trace", "watchdog",
     "Counter", "Gauge", "Histogram", "MetricRegistry", "QueueGauges",
     "LATENCY_BUCKETS", "log_spaced_bounds", "snapshot_quantile",
     "counter", "gauge", "histogram", "emit", "host_index", "registry",
@@ -85,6 +92,7 @@ __all__ = [
     "gather_metrics", "merge_snapshots", "fleet_snapshot",
     "Watchdog", "beat", "maybe_watchdog", "active_watchdogs",
     "SloMonitor", "maybe_slo_monitor",
+    "HealthMonitor", "maybe_health_monitor",
     "StatuszServer", "maybe_statusz", "publish_fleet",
     "profiled_jit", "profile_window", "record_device_memory",
 ]

@@ -233,6 +233,30 @@ def render_top(kind: str, data, n: int) -> str:
     return "\n\n".join(out)
 
 
+def render_health(snap: dict) -> str:
+    """Training-health view of a snapshot: the ``health.*`` gauges
+    (latest per-table numerics stats), the violation/rollback counters,
+    and the chaos-fired counters a health incident usually pairs with."""
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    out = []
+    stat_rows = [[k, _num(v)] for k, v in sorted(gauges.items())
+                 if k.startswith("health.")]
+    if stat_rows:
+        out.append("health stats (latest per table/kind):\n"
+                   + _table(stat_rows, ["stat", "value"]))
+    count_rows = [[k, _num(v)] for k, v in sorted(counters.items())
+                  if k.startswith("health.")
+                  or k.startswith("chaos.fired")]
+    if count_rows:
+        out.append("health counters:\n"
+                   + _table(count_rows, ["name", "value"]))
+    if not out:
+        return ("(no health.* metrics in this snapshot — was "
+                "MVTPU_HEALTH set on the run?)")
+    return "\n\n".join(out)
+
+
 def render_metric_events(records: List[dict]) -> str:
     last: Dict[str, dict] = {}
     for r in records:
@@ -278,6 +302,10 @@ def main(argv=None) -> int:
     p.add_argument("--top", type=int, default=0, metavar="N",
                    help="print the N slowest spans (trace) or largest "
                         "counters/histograms (snapshot)")
+    p.add_argument("--health", action="store_true",
+                   help="summarize the training-health metrics of a "
+                        "snapshot (health.* stats, violations, "
+                        "rollbacks, chaos firings)")
     args = p.parse_args(argv)
     kind, data = _load(args.path)
     if args.chrome_trace is not None:
@@ -295,6 +323,13 @@ def main(argv=None) -> int:
             print(f"wrote {len(doc['traceEvents'])} events to "
                   f"{args.chrome_trace} (load at ui.perfetto.dev or "
                   "chrome://tracing)", file=sys.stderr)
+        return 0
+    if args.health:
+        if kind != "snapshot":
+            print("--health requires a registry snapshot",
+                  file=sys.stderr)
+            return 2
+        print(render_health(data))
         return 0
     if args.top:
         print(render_top(kind, data, args.top))

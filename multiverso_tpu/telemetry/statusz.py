@@ -148,7 +148,20 @@ def _statusz_doc() -> dict:
             "recent_violations": slo.recent_violations()
             if slo is not None else [],
         },
+        "health": _health_status(),
     }
+
+
+def _health_status() -> Optional[dict]:
+    """The training-health monitor's status(), via sys.modules like the
+    slo/ft lookups above (statusz must not force extra imports)."""
+    health = sys.modules.get("multiverso_tpu.telemetry.health")
+    if health is None:
+        return None
+    try:
+        return health.status()
+    except Exception:
+        return None
 
 
 class _Handler(http.server.BaseHTTPRequestHandler):
@@ -192,10 +205,21 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 self._reply(200, body.encode(), "text/plain")
             elif path == "/healthz":
                 dogs = _watchdog.active_watchdogs()
-                ok = all(d["ok"] for d in dogs)
+                health = sys.modules.get(
+                    "multiverso_tpu.telemetry.health")
+                divergence = None
+                if health is not None:
+                    try:
+                        divergence = health.active_divergence()
+                    except Exception:
+                        pass
+                # liveness AND numerics: a diverging run is not
+                # healthy even when every heartbeat is on time
+                ok = all(d["ok"] for d in dogs) and divergence is None
                 self._reply_json(200 if ok else 503, {
                     "ok": ok, "ts": time.time(),
                     "watchdogs": dogs,
+                    "divergence": divergence,
                     "self_terminate_rc": _watchdog.SELF_TERMINATE_RC,
                 })
             elif path == "/trace":

@@ -43,6 +43,7 @@ from __future__ import annotations
 import contextlib
 import faulthandler
 import os
+import shutil
 import sys
 import threading
 import time
@@ -92,6 +93,43 @@ def _host_index() -> int:
 
 def default_dump_dir() -> str:
     return os.environ.get("MVTPU_DUMP_DIR", "mvtpu_dump")
+
+
+def dump_keep() -> int:
+    """``MVTPU_DUMP_KEEP``: how many post-mortem directories the dump
+    dir retains (default 8, 0 = unbounded). SLO/health ``action=dump``
+    fire on a cadence — without retention a long degraded run fills the
+    disk with near-identical post-mortems."""
+    try:
+        return max(int(os.environ.get("MVTPU_DUMP_KEEP", "8") or 8), 0)
+    except ValueError:
+        return 8
+
+
+def prune_dumps(dump_dir: str, keep: Optional[int] = None) -> List[str]:
+    """Delete the oldest ``dump-*`` directories beyond ``keep`` (by
+    mtime; newest survive). Returns the removed paths. Best-effort —
+    retention must never take the process down with it."""
+    keep = dump_keep() if keep is None else keep
+    if keep <= 0:
+        return []
+    try:
+        entries = [os.path.join(dump_dir, e)
+                   for e in os.listdir(dump_dir)
+                   if e.startswith("dump-")]
+        dumps = [(os.path.getmtime(p), p) for p in entries
+                 if os.path.isdir(p)]
+    except OSError:
+        return []
+    dumps.sort()
+    removed = []
+    for _, p in dumps[:max(len(dumps) - keep, 0)]:
+        try:
+            shutil.rmtree(p)
+            removed.append(p)
+        except OSError as e:
+            _warn(f"watchdog: dump retention failed for {p!r}: {e!r}")
+    return removed
 
 
 def _resolve_action(action: Optional[str]) -> str:
@@ -293,6 +331,13 @@ class Watchdog:
                 violations = slo.recent_violations()
             except Exception:
                 pass
+        health_status = None
+        health = _sibling("health")
+        if health is not None:
+            try:
+                health_status = health.status()
+            except Exception:
+                pass
         with open(os.path.join(path, "watchdog.json"), "w") as f:
             json.dump({
                 "kind": DUMP_KIND, "name": self.name,
@@ -304,7 +349,11 @@ class Watchdog:
                 "latest_checkpoint": latest_ckpt,
                 "queues": queues,
                 "slo_violations": violations,
+                "health": health_status,
             }, f, indent=1)
+        # keep-K retention AFTER the new dump lands: the artifact being
+        # written right now must never be the one pruned away
+        prune_dumps(self.dump_dir)
         return path
 
 

@@ -1,0 +1,547 @@
+"""Training-health layer tests: fused stats kernels (flat + sharded
+engine parity vs a numpy oracle), the EWMA drift windows and rule
+grammar, chaos-injected NaN detection through the real table paths, and
+the headline divergence→rollback guarantee — the rolled-back table is
+BIT-IDENTICAL to a manual resume of the same pre-violation generation."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.ft.chaos import (chaos_corrupt, install_chaos,
+                                     uninstall_chaos)
+from multiverso_tpu.telemetry import health
+from multiverso_tpu.telemetry import metrics as telemetry
+from multiverso_tpu.telemetry.health import (HealthMonitor, parse_health,
+                                             parse_rule)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaks():
+    """Monitor install and chaos install are process-global."""
+    yield
+    health.uninstall()
+    uninstall_chaos()
+
+
+def _vec(sum_sq=0.0, amax=0.0, nan=0.0, inf=0.0, zero=0.0, count=1.0):
+    """Hand-packed stats vector in the PACKED_FIELDS lane order."""
+    return np.array([sum_sq, amax, nan, inf, zero, count], np.float32)
+
+
+def _counter(snap, prefix):
+    return sum(v for k, v in snap["counters"].items()
+               if k.startswith(prefix))
+
+
+# -- fused stats kernels ---------------------------------------------------
+
+class TestStatsParity:
+    # representative operand shapes of the three audited table paths:
+    # dense delta (ArrayTable), KV values (buckets x slots x dim), COO
+    # values (flat 1-D)
+    CASES = {
+        "dense": (64, 16),
+        "kv": (8, 4, 6),
+        "coo": (128,),
+    }
+
+    def _tensor(self, shape, seed=0):
+        rng = np.random.default_rng(seed)
+        x = rng.normal(size=shape).astype(np.float32)
+        flat = x.reshape(-1)
+        flat[1] = np.nan
+        flat[3] = np.inf
+        flat[5] = -np.inf
+        flat[7] = 0.0
+        flat[11] = 0.0
+        return x
+
+    @pytest.mark.parametrize("path", sorted(CASES))
+    def test_flat_engine_matches_numpy(self, mesh8, path):
+        from multiverso_tpu.ops import stat_kernels
+        x = self._tensor(self.CASES[path])
+        got = stat_kernels.unpack(stat_kernels.summarize(x, mesh=mesh8))
+        want = stat_kernels.numpy_reference(x)
+        for k, v in want.items():
+            assert got[k] == pytest.approx(v, rel=1e-5), (path, k)
+
+    @pytest.mark.parametrize("path", sorted(CASES))
+    def test_sharded_engine_matches_numpy(self, mesh8, path):
+        """Operands committed P("model", ...) route through the
+        shard_map+psum engine and must agree with the same oracle."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from multiverso_tpu import core
+        from multiverso_tpu.ops import stat_kernels
+        x = self._tensor(self.CASES[path])
+        spec = P(core.MODEL_AXIS, *([None] * (x.ndim - 1)))
+        xs = jax.device_put(x, NamedSharding(mesh8, spec))
+        assert stat_kernels._is_model_sharded(xs, mesh8, core.MODEL_AXIS)
+        got = stat_kernels.unpack(stat_kernels.summarize(xs, mesh=mesh8))
+        want = stat_kernels.numpy_reference(x)
+        for k, v in want.items():
+            assert got[k] == pytest.approx(v, rel=1e-5), (path, k)
+
+    def test_flat_and_sharded_engines_agree(self, mesh8):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from multiverso_tpu import core
+        from multiverso_tpu.ops import stat_kernels
+        x = self._tensor((32, 8), seed=7)
+        xs = jax.device_put(
+            x, NamedSharding(mesh8, P(core.MODEL_AXIS, None)))
+        flat = stat_kernels.unpack(stat_kernels.summarize(x, mesh=mesh8))
+        shd = stat_kernels.unpack(stat_kernels.summarize(xs, mesh=mesh8))
+        for k in stat_kernels.STAT_NAMES:
+            assert flat[k] == pytest.approx(shd[k], rel=1e-5), k
+
+    def test_all_finite_tensor(self, mesh8):
+        from multiverso_tpu.ops import stat_kernels
+        x = np.full((5, 5), 2.0, np.float32)
+        got = stat_kernels.unpack(stat_kernels.summarize(x, mesh=mesh8))
+        assert got["nan_count"] == 0 and got["inf_count"] == 0
+        assert got["absmax"] == pytest.approx(2.0)
+        assert got["l2"] == pytest.approx(10.0)      # sqrt(25 * 4)
+        assert got["zero_frac"] == 0.0
+
+    def test_unpack_rejects_wrong_shape(self):
+        from multiverso_tpu.ops import stat_kernels
+        with pytest.raises(ValueError, match="packed stats"):
+            stat_kernels.unpack(np.zeros(4, np.float32))
+
+
+# -- rule grammar ----------------------------------------------------------
+
+class TestRuleGrammar:
+    def test_issue_headline_spec_parses(self):
+        rules = parse_health(
+            "table.w.update_norm spike>10x, *.nan_count > 0")
+        assert len(rules) == 2
+        spike, nan = rules
+        assert spike.table_glob == "table.w"
+        assert spike.stat_key == "update_norm"
+        assert spike.kind == "update" and spike.stat == "l2"
+        assert spike.op == "spike" and spike.value == 10.0
+        assert nan.table_glob == "*" and nan.kind is None
+        assert nan.op == ">" and nan.value == 0.0
+
+    @pytest.mark.parametrize("stat,kind,field", [
+        ("update_norm", "update", "l2"),
+        ("update_absmax", "update", "absmax"),
+        ("param_norm", "param", "l2"),
+        ("param_absmax", "param", "absmax"),
+        ("nan_count", None, "nan_count"),
+        ("inf_count", None, "inf_count"),
+        ("zero_frac", None, "zero_frac"),
+        ("l2", None, "l2"),
+        ("norm", None, "l2"),
+        ("absmax", None, "absmax"),
+    ])
+    def test_stat_aliases(self, stat, kind, field):
+        r = parse_rule(f"*.{stat} >= 1.5")
+        assert r.kind == kind and r.stat == field and r.value == 1.5
+
+    @pytest.mark.parametrize("bad", [
+        "w.update_norm",                    # no condition
+        "w.bogus_stat > 1",                 # unknown stat
+        "update_norm > 1",                  # selector without a glob
+        "w.update_norm spike>x",            # non-numeric factor
+        "w.update_norm spike>0.5x",         # factor must exceed 1
+        "w.update_norm ~ 3",                # unknown operator
+    ])
+    def test_malformed_rule_raises(self, bad):
+        with pytest.raises(ValueError):
+            parse_rule(bad)
+
+    def test_empty_spec_raises(self):
+        with pytest.raises(ValueError, match="no rules"):
+            parse_health(" , ")
+
+    def test_applies_glob_and_kind(self):
+        r = parse_rule("table.w*.update_norm > 1")
+        assert r.applies("w_in", "update")       # "table." prefix form
+        assert not r.applies("w_in", "param")    # kind-scoped
+        assert not r.applies("embed", "update")
+        any_kind = parse_rule("*.nan_count > 0")
+        assert any_kind.applies("anything", "update")
+        assert any_kind.applies("anything", "param")
+
+    def test_breached_operators(self):
+        assert parse_rule("*.l2 > 2").breached(2.1)
+        assert not parse_rule("*.l2 > 2").breached(2.0)
+        assert parse_rule("*.l2 >= 2").breached(2.0)
+        assert parse_rule("*.l2 < 2").breached(1.9)
+        assert parse_rule("*.l2 <= 2").breached(2.0)
+
+
+# -- EWMA drift windows ----------------------------------------------------
+
+class TestEwmaSpike:
+    def _mon(self, rule, **kw):
+        kw.setdefault("warmup", 3)
+        kw.setdefault("alpha", 0.5)
+        return HealthMonitor(parse_health(rule), **kw)
+
+    def test_spike_fires_after_warmup_only(self):
+        mon = self._mon("*.update_norm spike>3x")
+        # steady l2=2 (sum_sq=4): below warmup nothing may fire even
+        # though the very first sample has no baseline at all
+        for _ in range(3):
+            mon._ingest("w", "update", _vec(sum_sq=4.0, amax=2.0,
+                                            count=10), time.time())
+        assert mon.recent_violations() == []
+        # 20x the baseline: fires
+        mon._ingest("w", "update", _vec(sum_sq=1600.0, amax=40.0,
+                                        count=10), time.time())
+        v = mon.recent_violations()
+        assert len(v) == 1
+        assert v[0]["rule"].endswith("spike>3x")
+        assert v[0]["baseline"] == pytest.approx(2.0)
+        assert v[0]["value"] == pytest.approx(40.0)
+        assert mon.active_divergence() is not None
+
+    def test_steady_stream_never_fires(self):
+        mon = self._mon("*.update_norm spike>3x")
+        for _ in range(20):
+            mon._ingest("w", "update", _vec(sum_sq=4.0, count=10),
+                        time.time())
+        assert mon.recent_violations() == []
+
+    def test_spike_not_folded_into_baseline_before_eval(self):
+        """The violating sample must be judged against the PRE-spike
+        EWMA: two consecutive identical spikes both fire (the first
+        must not have pulled the baseline up past the trigger)."""
+        mon = self._mon("*.update_norm spike>3x", alpha=0.01)
+        for _ in range(3):
+            mon._ingest("w", "update", _vec(sum_sq=4.0, count=10),
+                        time.time())
+        mon._ingest("w", "update", _vec(sum_sq=1600.0, count=10),
+                    time.time())
+        mon._ingest("w", "update", _vec(sum_sq=1600.0, count=10),
+                    time.time())
+        assert len(mon.recent_violations()) == 2
+
+    def test_nonfinite_never_poisons_window(self):
+        mon = self._mon("*.update_norm spike>3x")
+        for _ in range(3):
+            mon._ingest("w", "update", _vec(sum_sq=4.0, count=10),
+                        time.time())
+        base = dict(mon._ewma)
+        # an Inf l2 must be skipped, not averaged in (a poisoned
+        # baseline would mask every later spike)
+        mon._ingest("w", "update", _vec(sum_sq=np.inf, count=10),
+                    time.time())
+        assert mon._ewma[("w", "update", "l2")] == \
+            base[("w", "update", "l2")]
+
+    def test_threshold_rule_and_clear(self):
+        mon = self._mon("*.nan_count > 0")
+        mon._ingest("w", "update", _vec(nan=2.0, count=10), time.time())
+        assert mon.active_divergence() is not None
+        assert mon.status()["violations"] == 1
+        mon.clear_divergence()
+        assert mon.active_divergence() is None
+        assert mon._ewma == {}          # windows restart post-clear
+
+    def test_worker_thread_drains_submits(self):
+        mon = self._mon("*.nan_count > 0").start()
+        try:
+            assert mon.submit("w", "update", _vec(nan=1.0, count=4))
+            assert mon.drain(timeout=10)
+            assert mon.active_divergence() is not None
+        finally:
+            mon.stop()
+
+
+# -- chaos nan kind --------------------------------------------------------
+
+class TestChaosNan:
+    def test_poison_is_deterministic_and_copies(self):
+        a = np.zeros((4, 4), np.float32)
+        install_chaos("seed=3;table.add:nan:times=1")
+        out1 = chaos_corrupt("table.add", a)
+        uninstall_chaos()
+        install_chaos("seed=3;table.add:nan:times=1")
+        out2 = chaos_corrupt("table.add", a)
+        assert np.isnan(out1).sum() == 1
+        np.testing.assert_array_equal(np.isnan(out1), np.isnan(out2))
+        assert not np.isnan(a).any()         # input untouched
+        assert out1 is not a
+
+    def test_times_and_after_gating(self):
+        install_chaos("table.add:nan:after=2,times=1")
+        a = np.zeros(8, np.float32)
+        hits = [np.isnan(chaos_corrupt("table.add", a)).sum()
+                for _ in range(5)]
+        assert hits == [0, 0, 1, 0, 0]
+
+    def test_frac_poisons_a_fraction(self):
+        install_chaos("table.add:nan:frac=0.5,times=1")
+        a = np.zeros(100, np.float32)
+        n = np.isnan(chaos_corrupt("table.add", a)).sum()
+        # 50 draws with replacement over 100 slots: some collide
+        assert 20 <= n <= 50
+
+    def test_non_float_arrays_pass_through(self):
+        install_chaos("table.add:nan")
+        a = np.arange(6, dtype=np.int64)
+        out = chaos_corrupt("table.add", a)
+        np.testing.assert_array_equal(out, np.arange(6))
+
+    def test_value_fault_never_raises_at_chaos_point(self):
+        from multiverso_tpu.ft.chaos import chaos_point
+        install_chaos("table.add:nan")
+        chaos_point("table.add")             # must not ChaosCrash
+
+    def test_fired_counter(self):
+        before = _counter(telemetry.snapshot(), "chaos.fired")
+        install_chaos("table.add:nan:times=1")
+        chaos_corrupt("table.add", np.zeros(4, np.float32))
+        assert _counter(telemetry.snapshot(), "chaos.fired") \
+            == before + 1
+
+
+# -- table-path integration ------------------------------------------------
+
+class TestTablePathDetection:
+    """A chaos-NaN at table.add becomes a health violation through each
+    real table class's audit hook — detected within one add+drain."""
+
+    def _arm(self):
+        mon = HealthMonitor(parse_health("*.nan_count > 0")).start()
+        health.install(mon)
+        return mon
+
+    def test_dense_table(self, mesh8):
+        from multiverso_tpu.tables import ArrayTable, reset_tables
+        try:
+            mon = self._arm()
+            t = ArrayTable(16, "float32", name="h_dense")
+            install_chaos("table.add:nan:times=1")
+            t.add(np.ones(16, np.float32))
+            t.wait()
+            assert mon.drain(timeout=30)
+            assert mon.active_divergence() is not None
+            assert mon.active_divergence()["table"] == "h_dense"
+        finally:
+            reset_tables()
+
+    def test_kv_table(self, mesh8):
+        from multiverso_tpu.tables import KVTable, reset_tables
+        try:
+            mon = self._arm()
+            t = KVTable(1 << 10, value_dim=4, name="h_kv")
+            install_chaos("table.add:nan:times=1")
+            t.add(np.arange(1, 9, dtype=np.uint64),
+                  np.ones((8, 4), np.float32), sync=True)
+            assert mon.drain(timeout=30)
+            assert mon.active_divergence() is not None
+        finally:
+            reset_tables()
+
+    def test_coo_table(self, mesh8):
+        from multiverso_tpu.tables import SparseMatrixTable, reset_tables
+        try:
+            mon = self._arm()
+            t = SparseMatrixTable(32, 8, name="h_coo")
+            install_chaos("table.add:nan:times=1")
+            t.add_sparse(np.arange(8), np.arange(8),
+                         np.ones(8, np.float32), sync=True)
+            assert mon.drain(timeout=30)
+            assert mon.active_divergence() is not None
+        finally:
+            reset_tables()
+
+
+# -- divergence → rollback -------------------------------------------------
+
+class TestRollback:
+    def test_rollback_bit_identical_to_manual_resume(self, mesh8,
+                                                     tmp_path):
+        from multiverso_tpu.ft.checkpoint import RunCheckpointManager
+        from multiverso_tpu.tables import ArrayTable, reset_tables
+        try:
+            t = ArrayTable(16, "float32", updater="adagrad",
+                           name="hb_arr")
+            t.add(np.arange(16, dtype=np.float32))
+            mgr = RunCheckpointManager(str(tmp_path), tables=[t],
+                                       background=False)
+            mgr.save(1, {"cursor": 3})
+            clean = np.asarray(t.get()).copy()
+
+            mon = HealthMonitor(parse_health("*.nan_count > 0"),
+                                action="rollback").start()
+            health.install(mon)
+            install_chaos("table.add:nan:times=1")
+            t.add(np.ones(16, np.float32))       # poisoned
+            t.wait()
+            assert mon.drain(timeout=30)
+            assert mon.active_divergence() is not None
+            assert np.isnan(np.asarray(t.get())).any()
+
+            restored = health.maybe_rollback(manager=mgr, tables=[t])
+            assert restored is not None and restored.step == 1
+            assert restored.get("cursor") == 3
+            assert mon.active_divergence() is None   # healthz back to 200
+            rolled = np.asarray(t.get())
+            assert not np.isnan(rolled).any()
+
+            # the guarantee: bit-identical to a MANUAL resume of the
+            # same generation into a fresh table
+            uninstall_chaos()
+            health.uninstall()
+            t2 = ArrayTable(16, "float32", updater="adagrad",
+                            name="hb_arr")
+            mgr2 = RunCheckpointManager(str(tmp_path), tables=[t2],
+                                        background=False)
+            st = mgr2.resume()
+            assert st is not None and st.step == 1
+            manual = np.asarray(t2.get())
+            np.testing.assert_array_equal(rolled, manual)
+            np.testing.assert_array_equal(rolled, clean)
+
+            snap = telemetry.snapshot()
+            assert _counter(snap, "health.violations") >= 1
+            assert _counter(snap, "health.rollbacks") >= 1
+        finally:
+            reset_tables()
+
+    def test_rollback_skips_generations_after_violation(self, mesh8,
+                                                        tmp_path):
+        """A generation committed AFTER the bad values entered storage
+        must not be the restore target."""
+        from multiverso_tpu.ft.checkpoint import RunCheckpointManager
+        from multiverso_tpu.tables import ArrayTable, reset_tables
+        try:
+            t = ArrayTable(8, "float32", name="hb_skip")
+            t.add(np.ones(8, np.float32))
+            mgr = RunCheckpointManager(str(tmp_path), tables=[t],
+                                       background=False)
+            mgr.save(1)
+            time.sleep(0.01)
+            viol_ts = time.time()                # "the violation"
+            time.sleep(0.01)
+            t.add(np.full(8, np.nan, np.float32))    # diverged state...
+            t.wait()
+            mgr.save(2)                              # ...committed late
+            st = mgr.resume(tables=[t], before_unix_time=viol_ts)
+            assert st is not None and st.step == 1
+            assert not np.isnan(np.asarray(t.get())).any()
+            # and the plain max_step filter composes the same way
+            st2 = mgr.resume(tables=[t], max_step=1)
+            assert st2 is not None and st2.step == 1
+        finally:
+            reset_tables()
+
+    def test_rollback_without_manager_fails_soft(self):
+        mon = HealthMonitor(parse_health("*.nan_count > 0"),
+                            action="rollback")
+        health.install(mon)
+        mon._ingest("w", "update", _vec(nan=1.0, count=4), time.time())
+        assert mon.status()["rollback_pending"]
+        assert health.maybe_rollback() is None       # nothing wired
+        assert mon._rollback_failures == 1
+        assert mon.active_divergence() is not None   # stays 503
+
+    def test_rollback_with_no_prior_generation_fails_soft(self, mesh8,
+                                                          tmp_path):
+        from multiverso_tpu.ft.checkpoint import RunCheckpointManager
+        from multiverso_tpu.tables import ArrayTable, reset_tables
+        try:
+            t = ArrayTable(8, "float32", name="hb_none")
+            mgr = RunCheckpointManager(str(tmp_path), tables=[t],
+                                       background=False)
+            mon = HealthMonitor(parse_health("*.nan_count > 0"),
+                                action="rollback")
+            health.install(mon)
+            mon._ingest("hb_none", "update", _vec(nan=1.0, count=4),
+                        time.time())
+            assert health.maybe_rollback(manager=mgr, tables=[t]) is None
+            assert mon.active_divergence() is not None
+        finally:
+            reset_tables()
+
+
+# -- monitor arming / env gate ---------------------------------------------
+
+class TestMaybeHealthMonitor:
+    def test_arms_from_env(self, monkeypatch):
+        monkeypatch.setenv("MVTPU_HEALTH", "*.nan_count > 0")
+        monkeypatch.setenv("MVTPU_HEALTH_ACTION", "dump")
+        monkeypatch.setenv("MVTPU_HEALTH_WARMUP", "7")
+        mon = health.maybe_health_monitor()
+        assert mon is not None
+        assert mon.action == "dump" and mon.warmup == 7
+        assert [r.raw for r in mon.rules] == ["*.nan_count > 0"]
+        assert health.maybe_health_monitor() is mon      # idempotent
+
+    def test_unset_env_stays_disabled(self, monkeypatch):
+        monkeypatch.delenv("MVTPU_HEALTH", raising=False)
+        assert health.maybe_health_monitor() is None
+        assert not health.enabled()
+
+    def test_malformed_spec_disables_with_warning(self, monkeypatch):
+        monkeypatch.setenv("MVTPU_HEALTH", "w.bogus_stat > 1")
+        assert health.maybe_health_monitor() is None
+
+    def test_invalid_action_rejected(self):
+        with pytest.raises(ValueError, match="action"):
+            HealthMonitor([], action="explode")
+
+    def test_status_shape(self):
+        mon = HealthMonitor(parse_health("*.nan_count > 0"))
+        s = mon.status()
+        for key in ("rules", "action", "violations", "recent",
+                    "divergence", "rollback_pending", "rollbacks",
+                    "rollback_failures", "dropped", "tables"):
+            assert key in s, key
+
+
+# -- watchdog dump retention -----------------------------------------------
+
+class TestDumpRetention:
+    def _mk_dumps(self, root, n):
+        paths = []
+        for i in range(n):
+            p = root / f"dump-2026010{i}-00000{i}"
+            p.mkdir()
+            stamp = time.time() - (n - i) * 60     # oldest first
+            os.utime(p, (stamp, stamp))
+            paths.append(str(p))
+        return paths
+
+    def test_prune_keeps_newest_k(self, tmp_path):
+        from multiverso_tpu.telemetry.watchdog import prune_dumps
+        paths = self._mk_dumps(tmp_path, 5)
+        (tmp_path / "not-a-dump").mkdir()        # never touched
+        removed = prune_dumps(str(tmp_path), keep=2)
+        assert sorted(removed) == sorted(paths[:3])
+        left = sorted(os.listdir(tmp_path))
+        assert left == sorted(
+            [os.path.basename(p) for p in paths[3:]] + ["not-a-dump"])
+
+    def test_keep_zero_is_unbounded(self, tmp_path):
+        from multiverso_tpu.telemetry.watchdog import prune_dumps
+        self._mk_dumps(tmp_path, 4)
+        assert prune_dumps(str(tmp_path), keep=0) == []
+        assert len(os.listdir(tmp_path)) == 4
+
+    def test_dump_keep_env_parsing(self, monkeypatch):
+        from multiverso_tpu.telemetry.watchdog import dump_keep
+        monkeypatch.delenv("MVTPU_DUMP_KEEP", raising=False)
+        assert dump_keep() == 8
+        monkeypatch.setenv("MVTPU_DUMP_KEEP", "3")
+        assert dump_keep() == 3
+        monkeypatch.setenv("MVTPU_DUMP_KEEP", "bogus")
+        assert dump_keep() == 8
+        monkeypatch.setenv("MVTPU_DUMP_KEEP", "-2")
+        assert dump_keep() == 0
+
+    def test_missing_dir_is_noop(self, tmp_path):
+        from multiverso_tpu.telemetry.watchdog import prune_dumps
+        assert prune_dumps(str(tmp_path / "nope"), keep=2) == []

@@ -48,6 +48,10 @@ DEFAULT_WATCH = ("value", "e2e_words_per_sec", "lda_doc_tokens_per_sec",
                  # throughputs are the PR's metrics of record
                  "kv_add_ops_per_sec_coalesced",
                  "kv_add_ops_per_sec_staged",
+                 # ...and the training-health lane: the same direct adds
+                 # with the fused numerics audit ON — a regression here
+                 # means the health layer crept back onto the hot path
+                 "kv_add_ops_per_sec_health",
                  "get_ops_per_sec_cached",
                  # checkpoint micro-bench (benchmarks/
                  # checkpoint_bench.py): run-level store throughput —
@@ -273,6 +277,7 @@ def selftest() -> int:
             "metric": "client_kv_add_ops_per_sec", "value": 1000.0,
             "unit": "adds/s", "kv_add_ops_per_sec_coalesced": 1000.0,
             "kv_add_ops_per_sec_staged": 400.0,
+            "kv_add_ops_per_sec_health": 380.0,
             "get_ops_per_sec_cached": 5000.0,
             "kv_apply_dispatches_coalesced": 8.0})
         cl_doc = json.loads(json.dumps(json.load(open(cl_old))))
@@ -281,6 +286,13 @@ def selftest() -> int:
         assert main([cl_old, cl_old]) == 0, "identical client line passes"
         assert main([cl_old, cl_bad]) == 1, \
             "cached-get throughput regression must fail"
+        # the health lane is watched: the audit creeping back onto the
+        # hot path (throughput collapse) must fail the diff
+        hl_doc = json.loads(json.dumps(json.load(open(cl_old))))
+        hl_doc["kv_add_ops_per_sec_health"] = 80.0          # -79%
+        hl_bad = put("hl_bad.json", hl_doc)
+        assert main([cl_old, hl_bad]) == 1, \
+            "health-lane throughput regression must fail"
         # table-kernel micro-bench lines: the Pallas probe/COO dispatch
         # rates are watched by default
         tk_old = put("tk_old.json", {
