@@ -25,6 +25,13 @@
 #                   TINY forces 2 virtual CPU devices so it always
 #                   runs; drop MVTPU_KERNEL_BENCH_TINY for real sizes
 #                   on TPU; emits table_kernels_bench.json)
+#   make tier-bench - tiered KV storage micro-bench: trains a
+#                   TieredKVTable with the device budget a fraction of
+#                   the table, asserts zero overflow raises + non-zero
+#                   demotions/disk fills + a bit-identical tiered
+#                   checkpoint resume (tiny sizes on CPU; drop
+#                   MVTPU_TIER_BENCH_TINY for real sizes; emits
+#                   tiered_kv_bench.json)
 #   make health-smoke - training-health smoke: tiny sparse-logreg run
 #                   with a chaos-injected NaN, asserting the fused
 #                   stats audit catches it, /healthz flips 503, and
@@ -47,8 +54,8 @@ OLD ?= BENCH_r04.json
 NEW ?= BENCH_r05.json
 
 .PHONY: test dryrun bench bench-dryrun bench-diff bench-diff-selftest \
-	client-bench ckpt-bench kernel-bench serve-smoke health-smoke \
-	chaos fuzz lint native ci
+	client-bench ckpt-bench kernel-bench tier-bench serve-smoke \
+	health-smoke chaos fuzz lint native ci
 
 fuzz:
 	$(PY) tests/deep_fuzz.py
@@ -76,6 +83,9 @@ ckpt-bench:
 
 kernel-bench:
 	MVTPU_KERNEL_BENCH_TINY=1 $(PY) benchmarks/table_kernels.py
+
+tier-bench:
+	MVTPU_TIER_BENCH_TINY=1 $(PY) benchmarks/tiered_kv.py
 
 serve-smoke:
 	$(PY) tools/serve_smoke.py
@@ -117,5 +127,5 @@ native:
 	$(MAKE) -C native
 
 ci: lint bench-diff-selftest native test dryrun bench-dryrun \
-	client-bench ckpt-bench kernel-bench serve-smoke health-smoke \
-	chaos
+	client-bench ckpt-bench kernel-bench tier-bench serve-smoke \
+	health-smoke chaos

@@ -20,6 +20,14 @@ drives that shape while honoring the repo's threading contract:
   bench and a production ``MVTPU_SLO=serving.latency.p99<...`` rule can
   never disagree.
 
+A second, TIERED lane drives a cold-start miss storm against a
+``TieredKVTable`` whose device budget is a fraction of the table:
+every get faults buckets in from host RAM / the disk spill file, and
+the per-get latencies land in ``serving.tiered.latency.seconds`` +
+the ``serving_tiered_p99_ms`` gauge — the tail a recommender replica
+pays right after (re)start, in the same SLO/telemetry pipeline
+(``MVTPU_SLO=serving.tiered.latency.p99<...`` works out of the box).
+
 Emits ONE final JSON line in the bench metric-line shape (flat numeric
 keys — ``tools/bench_diff.py`` compares runs; ``serving_p99_ms`` is a
 LOWER-is-better watch) and writes the same document to
@@ -35,7 +43,9 @@ from __future__ import annotations
 import json
 import os
 import queue
+import shutil
 import sys
+import tempfile
 import threading
 import time
 
@@ -55,14 +65,22 @@ if CPU:
 import numpy as np  # noqa: E402
 
 from multiverso_tpu import client, core, telemetry  # noqa: E402
+from multiverso_tpu.storage import TieredKVTable  # noqa: E402
 from multiverso_tpu.tables import ArrayTable, KVTable  # noqa: E402
 
 # sizes: client threads, ops per thread, kv batch, table n
 SIZES = dict(threads=8, ops=40, keys=128, value_dim=8, table_n=1 << 14,
              coalesce_k=8, staleness=4)
+# tiered lane: population keys, get batch, get ops, device/host budget
+# in buckets (slots=8) — budget ~1/16 of the geometry so the storm
+# really faults
+TIERED = dict(population=1 << 13, batch=256, ops=16,
+              device_buckets=64, host_buckets=32, slots=8)
 if TINY:
     SIZES = dict(threads=8, ops=8, keys=32, value_dim=4,
                  table_n=1 << 10, coalesce_k=4, staleness=4)
+    TIERED = dict(population=1 << 10, batch=64, ops=8,
+                  device_buckets=16, host_buckets=8, slots=8)
 
 OP_TIMEOUT_S = 120.0        # a blown timeout IS the deadlock detector
 
@@ -113,6 +131,43 @@ def _client(tid: int, reqq: "queue.Queue", hist, errors: list) -> None:
         telemetry.counter("serving.ops", op=op.kind).inc()
 
 
+def _tiered_storm() -> dict:
+    """Cold-start miss storm: populate a tiered table wider than its
+    device budget, demote everything hot off-device by streaming the
+    population through, then time cold gets. Single-threaded on the
+    caller (fault-in owns the table's dispatch-thread contract)."""
+    rng = np.random.default_rng(7)
+    c = TIERED
+    spill_dir = tempfile.mkdtemp(prefix="mvtpu_serve_tier_")
+    try:
+        t = TieredKVTable(c["population"] * 8, value_dim=4,
+                          slots_per_bucket=c["slots"],
+                          device_buckets=c["device_buckets"],
+                          host_buckets=c["host_buckets"],
+                          spill_dir=spill_dir, name="serve_tiered")
+        pop = np.arange(1, c["population"] + 1, dtype=np.uint64)
+        for lo in range(0, len(pop), c["batch"]):
+            chunk = pop[lo:lo + c["batch"]]
+            t.add(chunk, np.ones((len(chunk), 4), np.float32),
+                  sync=True)
+        hist = telemetry.histogram("serving.tiered.latency.seconds",
+                                   telemetry.LATENCY_BUCKETS)
+        for _ in range(c["ops"]):
+            keys = rng.choice(pop, size=c["batch"], replace=False)
+            t0 = time.perf_counter()
+            np.asarray(t.get(keys)[0])
+            hist.observe(time.perf_counter() - t0)
+            telemetry.counter("serving.ops", op="tiered_get").inc()
+        p50, p99 = hist.p50, hist.p99
+        assert p50 is not None, "tiered lane recorded no latencies"
+        telemetry.gauge("serving_tiered_p50_ms").set(round(p50 * 1e3, 6))
+        telemetry.gauge("serving_tiered_p99_ms").set(round(p99 * 1e3, 6))
+        return {"serving_tiered_p50_ms": round(p50 * 1e3, 3),
+                "serving_tiered_p99_ms": round(p99 * 1e3, 3)}
+    finally:
+        shutil.rmtree(spill_dir, ignore_errors=True)
+
+
 def main() -> None:
     core.init()
     telemetry.beat()
@@ -158,6 +213,8 @@ def main() -> None:
         raise SystemExit("serving bench: deadlock or timeout (see "
                          "above)")
 
+    tiered = _tiered_storm()
+
     n_ops = SIZES["threads"] * SIZES["ops"]
     p50, p99, p999 = hist.p50, hist.p99, hist.p999
     assert p50 is not None, "no latencies recorded"
@@ -178,6 +235,7 @@ def main() -> None:
         "serving_threads": SIZES["threads"],
         "serving_ops": n_ops,
     }
+    line.update(tiered)
     out = os.environ.get("MVTPU_SERVING_BENCH_JSON",
                          "serving_bench.json")
     with open(out, "w") as f:

@@ -16,6 +16,13 @@ pipelining role (SURVEY.md §4.5), applied to the Add path.
 Update order is submission order: one worker + FIFO queues means
 prepared batches come back in the order they went in, and dispatches
 happen on the caller's thread in that order.
+
+The writer is duck-typed over ``prepare_add``/``add_prepared``, so a
+:class:`~multiverso_tpu.storage.tiered_kv.TieredKVTable` slots in
+unchanged — there the prepare half is host-only (validate/hash/sort;
+packing and the H2D wait for the dispatch-thread fault-in that decides
+slot placement), and the dispatch half may chunk a batch wider than
+the device tier.
 """
 
 from __future__ import annotations

@@ -3,7 +3,7 @@
 
 from multiverso_tpu.io.stream import (Stream, StreamFactory,
                                       mem_store_clear, open_stream,
-                                      register_scheme)
+                                      pread, register_scheme)
 
 __all__ = ["Stream", "StreamFactory", "mem_store_clear", "open_stream",
-           "register_scheme"]
+           "pread", "register_scheme"]

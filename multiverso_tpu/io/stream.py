@@ -383,6 +383,33 @@ def open_stream(uri: str, mode: str = "rb") -> Stream:
         f"registered: {sorted(_SCHEMES)} (+ fsspec protocols)")
 
 
+def pread(uri: str, offset: int, size: int) -> bytes:
+    """Ranged read: exactly ``size`` bytes starting at ``offset``.
+
+    The cold-tier fill path (``storage/tiers.py``) reads ONE spilled
+    bucket record out of a large spill file; loading the whole file per
+    fill would turn a miss into an O(file) stall.  Seeks through the
+    same :func:`open_stream` stack, so scheme dispatch, chaos fault
+    points (``io.open.read``/``io.read``) and the per-scheme
+    ``io.read.bytes`` counters all see ranged reads — the counter
+    accounts only the ``size`` bytes actually read, not the file size.
+
+    Raises ``EOFError`` on a short read (the range runs past EOF):
+    callers treat that like a failed CRC — the record is unusable.
+    """
+    if offset < 0 or size < 0:
+        raise ValueError(f"pread needs offset/size >= 0, got "
+                         f"offset={offset} size={size}")
+    with open_stream(uri, "rb") as f:
+        f.seek(offset)
+        b = f.read(size)
+    if len(b) != size:
+        raise EOFError(
+            f"pread({uri!r}, offset={offset}, size={size}) short read: "
+            f"got {len(b)} bytes")
+    return b
+
+
 class StreamFactory:
     """Class-style facade matching the reference's StreamFactory."""
 

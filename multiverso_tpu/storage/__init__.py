@@ -1,0 +1,23 @@
+"""Tiered KV storage: billion-key tables across device HBM, pinned
+host RAM, and disk (ROADMAP Open item 3).
+
+The capacity analogue of arXiv:2004.13336's optimizer-state sharding:
+put each bucket where it fits, move only what the step touches. See
+``tiered_kv.py`` for the table, ``manager.py`` for placement policy,
+``tiers.py`` for the host arena + CRC-stamped disk spill file, and
+the README "Tiered storage" section for the knobs.
+"""
+
+from multiverso_tpu.storage.manager import (TIER_DEVICE, TIER_DISK,
+                                            TIER_HOST, TIER_VIRGIN,
+                                            TierConfig, TierManager,
+                                            status_all)
+from multiverso_tpu.storage.tiered_kv import TieredKVTable
+from multiverso_tpu.storage.tiers import (BucketRecord, DiskTier,
+                                          HostTier, RecordSpec)
+
+__all__ = [
+    "BucketRecord", "DiskTier", "HostTier", "RecordSpec",
+    "TIER_DEVICE", "TIER_DISK", "TIER_HOST", "TIER_VIRGIN",
+    "TierConfig", "TierManager", "TieredKVTable", "status_all",
+]

@@ -1,0 +1,512 @@
+"""TieredKVTable: a KVTable whose capacity ceiling is disk, not HBM.
+
+The table keeps the KVTable contract (get/add/store/load, deferred
+overflow, the prepare/dispatch staging split) over a LOGICAL geometry
+of ``total_buckets × slots`` while the device arrays hold only
+``device_buckets`` bucket rows — the hot set. A host-side injective
+map (``TierManager.slot_of``) translates logical bucket ids to device
+slots; a miss on a get/add transparently faults the bucket in ON THE
+DISPATCH THREAD (the single thread that owns the table's buffers —
+the same contract every other dispatch rides):
+
+1. ``plan``: the tier manager picks the coldest resident buckets
+   outside the batch (per-bucket access EWMAs, lazily decayed) as
+   victims,
+2. demote: one jitted gather pulls the victims' rows D2H into the
+   host arena (the warm tier; its own coldest bucket cascades to the
+   disk spill file when the arena is full),
+3. fill: missing buckets come back from the host arena or a ranged
+   ``pread`` of the spill file (never-touched buckets are "virgin" —
+   all-empty by construction, no IO), and one jitted scatter lands
+   them in the freed slots.
+
+Batches touching more distinct buckets than the device tier holds are
+CHUNKED: each chunk faults its working set in and dispatches
+separately — bucket-capacity pressure becomes demotion + retry
+instead of a dropped batch. (Per-bucket slot overflow — more than
+``slots`` live keys hashing to one logical bucket — still raises with
+the named buckets; size ``capacity`` for the key population as usual,
+just without a device-HBM ceiling.)
+
+The kernel path: lanes must be re-sorted by device slot AFTER the
+fault-in (placement is decided at dispatch, not prepare), so the
+table keeps the plain XLA probe/lookup closures (``ALLOW_PALLAS =
+False``) — the non-tiered hot path and its Pallas engines are
+untouched. The prepare half (:meth:`prepare_add`) stays thread-safe
+for the ``KVStagingWriter`` split: it validates/hashes/sorts on the
+worker thread and defers packing + H2D to :meth:`add_prepared`.
+
+Checkpoints: the export gathers EVERY tier into logical bucket order
+— content is a pure function of op history, independent of placement
+— and records each bucket's tier in the payload (``tier_of``), so a
+resume restores bit-identical content AND re-establishes the
+placement. ``RunCheckpointManager`` covers the table automatically
+(duck-typed on ``export_checkpoint_async``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from multiverso_tpu import core
+from multiverso_tpu.storage.manager import (TIER_DEVICE, TIER_DISK,
+                                            TIER_HOST, TIER_NAMES,
+                                            TierConfig, TierManager)
+from multiverso_tpu.storage.tiers import BucketRecord, RecordSpec
+from multiverso_tpu.tables.base import (loadz_stream, pack_state,
+                                        unpack_state)
+from multiverso_tpu.tables.hashing import _bucket, _hash_u64
+from multiverso_tpu.tables.kv_table import KVTable
+from multiverso_tpu.updaters import AddOption
+from multiverso_tpu.utils import log
+
+
+class _TieredPreparedAdd:
+    """Prepare-half product of a tiered Add: host arrays sorted by
+    LOGICAL bucket. Packing (and the H2D) waits for the dispatch
+    thread — lane→slot translation needs the fault-in that only the
+    buffer-owning thread may run."""
+
+    __slots__ = ("keys", "deltas", "logical", "option", "elems",
+                 "nbytes")
+
+    def __init__(self, keys, deltas, logical, option, elems, nbytes):
+        self.keys = keys
+        self.deltas = deltas
+        self.logical = logical
+        self.option = option
+        self.elems = elems
+        self.nbytes = nbytes
+
+
+class TieredKVTable(KVTable):
+    """KVTable over HBM + host RAM + disk. See the module docstring.
+
+    Extra constructor knobs (budgets; ``MVTPU_TIER_*`` env supplies
+    defaults — see ``storage/manager.py``):
+
+    - ``device_buckets`` — hot-set size in buckets (the HBM budget);
+      rounded up to the mesh model-axis multiple like every KVTable
+      geometry.
+    - ``host_buckets`` — warm-arena size in buckets.
+    - ``spill_dir`` — directory for the cold tier's spill file.
+    - ``tier_alpha`` — access-EWMA smoothing for victim selection.
+    """
+
+    ALLOW_PALLAS = False
+
+    def __init__(self, capacity: int, value_dim: int = 0,
+                 dtype: Any = "float32", *, slots_per_bucket: int = 8,
+                 updater: Optional[str] = None, mesh=None,
+                 name: str = "tiered_kv_table",
+                 default_value: float = 0.0,
+                 default_option: Optional[AddOption] = None,
+                 shard_update: bool = False,
+                 device_buckets: Optional[int] = None,
+                 host_buckets: Optional[int] = None,
+                 spill_dir: Optional[str] = None,
+                 tier_alpha: Optional[float] = None) -> None:
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        total = -(-capacity // slots_per_bucket)
+        cfg = TierConfig.from_env(total, device_buckets=device_buckets,
+                                  host_buckets=host_buckets,
+                                  spill_dir=spill_dir,
+                                  alpha=tier_alpha)
+        dev_buckets = min(max(int(cfg.device_buckets), 1), total)
+        # the parent builds the DEVICE tier: arrays sized to the hot
+        # set, geometry rounded to the mesh like any KVTable
+        super().__init__(dev_buckets * slots_per_bucket, value_dim,
+                         dtype, slots_per_bucket=slots_per_bucket,
+                         updater=updater, mesh=mesh, name=name,
+                         default_value=default_value,
+                         default_option=default_option,
+                         shard_update=shard_update)
+        # ... and this subclass re-points the LOGICAL geometry at the
+        # full capacity: hashing is mod total_buckets, device bucket
+        # ids exist only between fault-in and dispatch
+        self.total_buckets = max(int(total), self.num_buckets)
+        self.capacity = self.total_buckets * self.slots
+        state_leaves = jax.tree.leaves(self.state)
+        self.spec = RecordSpec(
+            self.slots, self.value_dim, self.dtype,
+            [np.dtype(leaf.dtype) for leaf in state_leaves],
+            default_value)
+        self.tiers = TierManager(self.name, self.total_buckets, cfg,
+                                 self.spec)
+        self._n_state = len(state_leaves)
+        self._build_tier_jits()
+        log.debug(
+            "tiered kv table %r: %d logical buckets over %d device + "
+            "%d host (+disk at %s)", name, self.total_buckets,
+            self.tiers.device_buckets, self.tiers.host.capacity,
+            self.tiers.disk.path)
+
+    def _build_tier_jits(self) -> None:
+        repl = NamedSharding(self.mesh, P())
+        state_sh = jax.tree.map(lambda _: self._state_sharding,
+                                self.state)
+        repl_state = jax.tree.map(lambda _: repl, self.state)
+
+        def gather_rows(k, v, s, idx):
+            return (jnp.take(k, idx, axis=0), jnp.take(v, idx, axis=0),
+                    jax.tree.map(lambda a: jnp.take(a, idx, axis=0), s))
+
+        # victims come back replicated so every process reads the same
+        # bytes (multihost demotion decisions stay in SPMD lockstep)
+        self._gather_rows = jax.jit(
+            gather_rows, out_shardings=(repl, repl, repl_state))
+
+        def scatter_rows(k, v, s, idx, nk, nv, ns):
+            return (k.at[idx].set(nk), v.at[idx].set(nv),
+                    jax.tree.map(
+                        lambda a, na: a.at[idx].set(na.astype(a.dtype)),
+                        s, ns))
+
+        self._scatter_rows = jax.jit(
+            scatter_rows, donate_argnums=(0, 1, 2),
+            out_shardings=(self._key_sharding, self._val_sharding,
+                           state_sh))
+
+    # logical hashing: mod the FULL geometry
+    def _buckets_of(self, keys: np.ndarray) -> np.ndarray:
+        return (_hash_u64(keys)
+                % np.uint64(self.total_buckets)).astype(np.int64)
+
+    # -- fault-in (dispatch thread only) -----------------------------------
+
+    def _ensure_resident(self, needed: np.ndarray) -> None:
+        """Make every (unique) logical bucket in ``needed`` device
+        resident: demote the plan's victims, then fill the misses.
+        Runs on the dispatch thread — it swaps the live buffers."""
+        mgr = self.tiers
+        mgr.touch(needed)
+        plan = mgr.plan(needed)
+        if plan.victims.size:
+            m = len(plan.victims)
+            idx = np.full(_bucket(m), 0, np.int32)
+            idx[:m] = mgr.slot_of[plan.victims]
+            k_f, v_f, s_f = self._gather_rows(
+                self.keys, self.values, self.state,
+                core.place(idx, mesh=self.mesh))
+            hk = np.asarray(k_f)
+            hv = np.asarray(v_f)
+            hs = [np.asarray(leaf) for leaf in jax.tree.leaves(s_f)]
+            for i, b in enumerate(plan.victims):
+                mgr.demote(int(b), BucketRecord(
+                    keys=hk[i], values=hv[i],
+                    state=[leaf[i] for leaf in hs]))
+        if not plan.fills.size:
+            return
+        slots: List[int] = []
+        recs: List[BucketRecord] = []
+        for b in plan.fills:
+            rec, _src = mgr.fetch(int(b))
+            slot, was_used = mgr.assign_slot(int(b))
+            if rec is None and not was_used:
+                continue    # virgin bucket on a never-written slot:
+            slots.append(slot)  # the EMPTY rows already represent it
+            recs.append(rec if rec is not None else self.spec.empty())
+        if not slots:
+            return
+        m = len(slots)
+        p = _bucket(m)
+        idx = np.empty(p, np.int32)
+        idx[:m] = slots
+        idx[m:] = slots[-1]    # pad lanes rewrite the last row in place
+        nk = np.stack([r.keys for r in recs]
+                      + [recs[-1].keys] * (p - m))
+        nv = np.stack([r.values for r in recs]
+                      + [recs[-1].values] * (p - m))
+        ns = [np.stack([r.state[j] for r in recs]
+                       + [recs[-1].state[j]] * (p - m))
+              for j in range(self._n_state)]
+        ns_tree = jax.tree.unflatten(
+            jax.tree.structure(self.state), ns)
+        put = lambda a: core.place(a, mesh=self.mesh)
+        self.keys, self.values, self.state = self._scatter_rows(
+            self.keys, self.values, self.state, put(idx), put(nk),
+            put(nv), jax.tree.map(put, ns_tree))
+
+    def _chunk_spans(self, sorted_logical: np.ndarray) -> List[Tuple[int, int]]:
+        """Split a bucket-sorted lane array into [lo, hi) spans, each
+        touching at most ``device_buckets`` distinct buckets."""
+        n = len(sorted_logical)
+        budget = self.tiers.device_buckets
+        starts = np.flatnonzero(np.concatenate(
+            [[True], sorted_logical[1:] != sorted_logical[:-1]]))
+        if len(starts) <= budget:
+            return [(0, n)]
+        spans = []
+        for i in range(0, len(starts), budget):
+            lo = int(starts[i])
+            hi = int(starts[i + budget]) if i + budget < len(starts) \
+                else n
+            spans.append((lo, hi))
+        return spans
+
+    # -- get ---------------------------------------------------------------
+
+    def get_jax(self, keys) -> Tuple[jax.Array, jax.Array]:
+        self._check_overflow()
+        keys = self._check_keys(keys)
+        logical = self._buckets_of(keys)
+        uniq = np.unique(logical)
+        if len(uniq) <= self.tiers.device_buckets:
+            self._ensure_resident(uniq)
+            slots = self.tiers.slot_of[logical].astype(np.int32)
+            return self._get_with_buckets(keys, slots)
+        # miss storm wider than the device tier: sort lanes by logical
+        # bucket, fault in + look up chunk by chunk, unpermute at the
+        # end so callers still see their own key order
+        order = np.argsort(logical, kind="stable")
+        sk, sl = keys[order], logical[order]
+        vals_parts, found_parts = [], []
+        for lo, hi in self._chunk_spans(sl):
+            self._ensure_resident(np.unique(sl[lo:hi]))
+            slots = self.tiers.slot_of[sl[lo:hi]].astype(np.int32)
+            v, f = self._get_with_buckets(sk[lo:hi], slots)
+            vals_parts.append(v)
+            found_parts.append(f)
+        inv = np.empty(len(keys), np.int64)
+        inv[order] = np.arange(len(keys))
+        inv_dev = core.place(inv, mesh=self.mesh)
+        return (jnp.take(jnp.concatenate(vals_parts), inv_dev, axis=0),
+                jnp.take(jnp.concatenate(found_parts), inv_dev,
+                         axis=0))
+
+    # -- add ---------------------------------------------------------------
+
+    def prepare_add(self, keys, deltas,
+                    option: Optional[AddOption] = None):
+        """Thread-safe host half (the ``KVStagingWriter`` seam):
+        validate/hash/sort by LOGICAL bucket. No H2D here — operand
+        order depends on slot placement, which is decided at dispatch
+        (after the fault-in)."""
+        keys, deltas, logical, opt = self._prep_host_add(keys, deltas,
+                                                         option)
+        return _TieredPreparedAdd(
+            keys=keys, deltas=deltas, logical=logical, option=opt,
+            elems=int(deltas.size),
+            nbytes=int(deltas.size) * self.dtype.itemsize)
+
+    def add_prepared(self, prepared, sync: bool = False):
+        if not isinstance(prepared, _TieredPreparedAdd):
+            # a parent-layout batch (e.g. hand-built in tests) rides
+            # the parent path untouched — its bucket ids are already
+            # device-geometry
+            return super().add_prepared(prepared, sync=sync)
+        self._poll_overflow()
+        handle = None
+        for lo, hi in self._chunk_spans(prepared.logical):
+            lk = prepared.logical[lo:hi]
+            self._ensure_resident(np.unique(lk))
+            slots = self.tiers.slot_of[lk].astype(np.int32)
+            # stable re-sort by slot: per-bucket batch order survives
+            # (slot↔bucket is injective), and the packed lanes meet the
+            # engine's sorted-by-bucket operand contract
+            order = np.argsort(slots, kind="stable")
+            packed = self._pack_prepared(
+                prepared.keys[lo:hi][order],
+                prepared.deltas[lo:hi][order], slots[order],
+                prepared.option)
+            handle = super().add_prepared(packed, sync=False)
+        if sync:
+            handle.wait()
+            self._check_overflow()
+        return handle
+
+    def _overflowing_buckets(self, host_buckets) -> list:
+        """The parent stashes DEVICE slot ids with the overflow flag;
+        translate back to logical bucket ids (best effort — a slot
+        may have been re-assigned since) so the raise names buckets
+        the caller can recognize."""
+        slots = super()._overflowing_buckets(host_buckets)
+        out = []
+        for s in slots:
+            if 0 <= s < len(self.tiers.bucket_at) \
+                    and self.tiers.bucket_at[s] >= 0:
+                out.append(int(self.tiers.bucket_at[s]))
+            else:
+                out.append(int(s))
+        return out
+
+    def __len__(self) -> int:
+        """Live keys across ALL tiers."""
+        self._check_overflow()
+        on_device = int(np.asarray(self._count_live(self.keys)))
+        return on_device + self.tiers.offdevice_live_keys()
+
+    # -- checkpoint --------------------------------------------------------
+
+    def export_checkpoint_async(self):
+        """Export the FULL logical table, placement-independent.
+
+        Dispatch half: jitted copy of the device triple (survives the
+        next add's donation) + host-arena copies + disk reads of the
+        cold records — synchronous IO, acceptable at checkpoint
+        cadence — plus a snapshot of the placement (``tier_of``).
+        Blocking half (``finish``): D2H the device copy and merge every
+        tier into ``total_buckets``-major arrays. Content is a pure
+        function of the op history, so two runs with different
+        placements (different budgets, different access order inside a
+        step) export byte-identical payloads."""
+        self.flush_coalesced()
+        self._check_overflow()
+        mgr = self.tiers
+        if self._export_copy is None:
+            state_sh = jax.tree.map(lambda _: self._state_sharding,
+                                    self.state)
+            self._export_copy = jax.jit(
+                lambda k, v, s: (jnp.copy(k), jnp.copy(v),
+                                 jax.tree.map(jnp.copy, s)),
+                out_shardings=(self._key_sharding, self._val_sharding,
+                               state_sh))
+        keys_fut, vals_fut, state_fut = self._export_copy(
+            self.keys, self.values, self.state)
+        bucket_at = mgr.bucket_at.copy()
+        tier_of = mgr.tier.copy()
+        offdev = {int(b): mgr.host.peek(int(b))
+                  for b in mgr.host.buckets()}
+        offdev.update({int(b): mgr.disk.peek(int(b))
+                       for b in mgr.disk.buckets()})
+        manifest = {"magic": self.KV_MAGIC, "name": self.name,
+                    "capacity": self.capacity,
+                    "value_dim": self.value_dim, "slots": self.slots,
+                    "num_buckets": self.total_buckets,
+                    "dtype": self.dtype.name,
+                    "updater": self.updater.name,
+                    "step": self.default_option.step,
+                    "tiered": True,
+                    "device_buckets": mgr.device_buckets}
+
+        def finish():
+            dk = np.asarray(keys_fut)
+            dv = np.asarray(vals_fut)
+            ds = [np.asarray(leaf)
+                  for leaf in jax.tree.leaves(state_fut)]
+            T = self.total_buckets
+            full_k = np.full((T,) + self.spec.key_shape, 0xFFFFFFFF,
+                             np.uint32)
+            full_v = np.full((T,) + self.spec.val_shape,
+                             self.default_value, self.dtype)
+            full_s = [np.zeros((T,) + self.spec.val_shape, d)
+                      for d in self.spec.state_dtypes]
+            live_slots = np.flatnonzero(bucket_at >= 0)
+            dst = bucket_at[live_slots]
+            full_k[dst] = dk[live_slots]
+            full_v[dst] = dv[live_slots]
+            for fs, leaf in zip(full_s, ds):
+                fs[dst] = leaf[live_slots]
+            for b, rec in offdev.items():
+                full_k[b] = rec.keys
+                full_v[b] = rec.values
+                for fs, leaf in zip(full_s, rec.state):
+                    fs[b] = leaf
+            fill = (~(full_k == 0xFFFFFFFF).all(-1)).sum(-1)
+            payload = {"keys": full_k, "values": full_v,
+                       "bucket_fill": fill.astype(np.int32),
+                       "tier_of": tier_of}
+            manifest["n_state_leaves"] = pack_state(
+                jax.tree.unflatten(jax.tree.structure(self.state),
+                                   full_s), payload)
+            self._record_op("store", full_v.size,
+                            sum(a.nbytes for a in payload.values()))
+            return manifest, payload
+        return finish
+
+    def load(self, uri: str) -> None:
+        """Restore a tiered checkpoint: bit-identical logical content,
+        placement re-established from the recorded ``tier_of`` (capped
+        by the CURRENT budgets — a bucket that no longer fits its
+        recorded tier cascades down; never-touched buckets stay
+        virgin)."""
+        self.flush_coalesced()
+        self._check_overflow()
+        manifest, data = loadz_stream(uri, self.KV_MAGIC)
+        for field, mine in (("value_dim", self.value_dim),
+                            ("dtype", self.dtype.name),
+                            ("slots", self.slots),
+                            ("num_buckets", self.total_buckets)):
+            if manifest[field] != mine:
+                raise ValueError(
+                    f"tiered kv table {field} mismatch: checkpoint "
+                    f"{manifest[field]!r} != table {mine!r} (tiered "
+                    "restores require identical logical geometry)")
+        if manifest["updater"] != self.updater.name:
+            raise ValueError(
+                f"checkpoint updater {manifest['updater']!r} != "
+                f"{self.updater.name!r}")
+        full_k = data["keys"]
+        full_v = data["values"]
+        full_s = unpack_state(data, manifest["n_state_leaves"],
+                              self.state, lambda leaf, tmpl:
+                              np.asarray(leaf, tmpl.dtype))
+        full_s_leaves = jax.tree.leaves(full_s)
+        tier_of = np.asarray(
+            data.get("tier_of",
+                     np.full(self.total_buckets, TIER_DEVICE,
+                             np.int8)), np.int8)
+        # fresh placement state (the old spill file is abandoned; the
+        # first new spill atomically replaces it)
+        self.tiers.retire()
+        mgr = TierManager(self.name, self.total_buckets, self.tiers.config,
+                          self.spec)
+        dev_shape = (self.num_buckets,) + self.spec.key_shape
+        new_k = np.full(dev_shape, 0xFFFFFFFF, np.uint32)
+        new_v = np.full((self.num_buckets,) + self.spec.val_shape,
+                        self.default_value, self.dtype)
+        new_s = [np.zeros((self.num_buckets,) + self.spec.val_shape, d)
+                 for d in self.spec.state_dtypes]
+
+        def rec_of(b: int) -> BucketRecord:
+            return BucketRecord(
+                keys=full_k[b], values=full_v[b],
+                state=[leaf[b] for leaf in full_s_leaves])
+
+        for code in (TIER_DEVICE, TIER_HOST, TIER_DISK):
+            for b in np.flatnonzero(tier_of == code):
+                b = int(b)
+                rec = rec_of(b)
+                want = code
+                if want == TIER_DEVICE and not mgr._free_slots:
+                    want = TIER_HOST
+                if want == TIER_HOST and mgr.host.full:
+                    want = TIER_DISK
+                if want == TIER_DEVICE:
+                    slot, _ = mgr.assign_slot(b)
+                    new_k[slot] = rec.keys
+                    new_v[slot] = rec.values
+                    for arr, leaf in zip(new_s, rec.state):
+                        arr[slot] = leaf
+                elif want == TIER_HOST:
+                    mgr.host.put(b, rec)
+                    mgr.tier[b] = TIER_HOST
+                    mgr._live[b] = rec.live()
+                else:
+                    mgr.disk.spill(b, rec)
+                    mgr.tier[b] = TIER_DISK
+                    mgr._live[b] = rec.live()
+        keys_dev = jax.device_put(new_k, self._key_sharding)
+        vals_dev = jax.device_put(new_v, self._val_sharding)
+        state_dev = jax.tree.unflatten(
+            jax.tree.structure(self.state),
+            [jax.device_put(arr, self._state_sharding)
+             for arr in new_s])
+        self._record_op("load", full_v.size,
+                        full_k.nbytes + full_v.nbytes)
+        self.keys, self.values, self.state = keys_dev, vals_dev, state_dev
+        self.tiers = mgr
+        self.default_option.step = int(manifest.get("step", 0))
+        with self._option_lock:
+            self.generation += 1
+        self._notify_views()
+
+
+# referenced for the /statusz storage section + README
+_ = (TIER_DEVICE, TIER_HOST, TIER_DISK, TIER_NAMES)

@@ -42,7 +42,8 @@ def create_table(option: TableOption):
     if isinstance(option, KVTableOption):
         return KVTable(option.capacity, option.value_dim, option.dtype,
                        slots_per_bucket=option.slots_per_bucket,
-                       updater=option.updater, name=option.name)
+                       updater=option.updater, name=option.name,
+                       shard_update=option.shard_update)
     raise TypeError(f"unknown table option type {type(option).__name__}")
 
 

@@ -69,6 +69,7 @@ class KVTableOption:
     slots_per_bucket: int = 8
     updater: Optional[str] = None
     name: str = "kv_table"
+    shard_update: bool = False   # data-axis updater-state sharding
 
 
 @dataclasses.dataclass
@@ -87,6 +88,10 @@ class PreparedKVAdd:
     #: operand layout this batch was prepped for — must match the
     #: engine's ``KernelEngine.layout`` ("flat" | "sharded")
     layout: str = "flat"
+    #: host copy of the batch's GLOBAL bucket ids (sorted, no padding)
+    #: — kept alongside the deferred overflow flag so a later raise can
+    #: name the overflowing buckets, not just count keys
+    host_buckets: Any = None
 
 
 class KVTable:
@@ -94,12 +99,18 @@ class KVTable:
     storage is (keys, values, state) triple — but implements the same
     get/add/store/load contract and registers a table id."""
 
+    #: subclasses that break the kernel engine's operand contract (the
+    #: tiered store re-sorts lanes at dispatch) keep the plain XLA
+    #: closures and skip the Pallas factories entirely
+    ALLOW_PALLAS = True
+
     def __init__(self, capacity: int, value_dim: int = 0,
                  dtype: Any = "float32", *, slots_per_bucket: int = 8,
                  updater: Optional[str] = None,
                  mesh: Optional[Mesh] = None, name: str = "kv_table",
                  default_value: float = 0.0,
-                 default_option: Optional[AddOption] = None) -> None:
+                 default_option: Optional[AddOption] = None,
+                 shard_update: bool = False) -> None:
         if capacity <= 0:
             raise ValueError("capacity must be positive")
         self.name = name
@@ -121,8 +132,15 @@ class KVTable:
         self._coalescer_refs: list = []
 
         shards = self.mesh.shape[core.MODEL_AXIS]
+        dp = dict(self.mesh.shape).get(core.DATA_AXIS, 1)
+        # arXiv:2004.13336 for the KV updater state: the state leaves
+        # (adagrad/adam accumulators) refine over the data axis too, so
+        # optimizer memory per device shrinks by dp — same contract as
+        # Table.shard_update for the dense tables (base.py)
+        self.shard_update = bool(shard_update) and dp > 1
+        bucket_mult = shards * dp if self.shard_update else shards
         buckets = -(-capacity // self.slots)
-        self.num_buckets = -(-buckets // shards) * shards
+        self.num_buckets = -(-buckets // bucket_mult) * bucket_mult
         self.capacity = self.num_buckets * self.slots
         self._shards = shards
         # bucket→shard ownership is contiguous equal blocks (shard s
@@ -137,6 +155,10 @@ class KVTable:
             self.mesh, P(core.MODEL_AXIS, None, None))
         self._val_sharding = NamedSharding(
             self.mesh, P(core.MODEL_AXIS, *([None] * (len(val_shape) - 1))))
+        self._state_sharding = NamedSharding(
+            self.mesh, P((core.MODEL_AXIS, core.DATA_AXIS),
+                         *([None] * (len(val_shape) - 1)))) \
+            if self.shard_update else self._val_sharding
         # 64-bit keys are stored as two uint32 planes (hi, lo): with
         # jax_enable_x64 off, uint64 device arrays silently canonicalize to
         # uint32, aliasing keys that share low 32 bits.
@@ -147,7 +169,7 @@ class KVTable:
             np.full(val_shape, default_value, dtype=self.dtype),
             self._val_sharding)
         self.state = jax.tree.map(
-            lambda s: jax.device_put(s, self._val_sharding),
+            lambda s: jax.device_put(s, self._state_sharding),
             self.updater.init_state(self.values))
         self._pending_over: list = []  # deferred overflow flags (device
         # scalars, one per in-flight add; drained non-blocking in add,
@@ -182,7 +204,11 @@ class KVTable:
 
         n_slots = self.slots
         scalar_sh = NamedSharding(self.mesh, P())
-        state_sh = jax.tree.map(lambda _: self._val_sharding, self.state)
+        state_sh = jax.tree.map(lambda _: self._state_sharding, self.state)
+        # the Pallas engines slice state like values (model axis only);
+        # data-axis-refined state (shard_update) and subclasses that
+        # re-sort lanes at dispatch (tiered) keep the XLA closures
+        allow_pallas = self.ALLOW_PALLAS and not self.shard_update
 
         def probe_update(keys_arr, values_arr, state, buckets, query,
                          deltas, valid, option):
@@ -294,14 +320,14 @@ class KVTable:
             xla=profiled_jit(
                 lookup, name=f"kv.lookup.{self.name}",
                 out_shardings=(replicated, replicated)),
-            pallas=lambda: profiled_jit(
+            pallas=None if not allow_pallas else lambda: profiled_jit(
                 tk.build_kv_lookup(
                     slots=self.slots, value_dim=self.value_dim,
                     default_value=self.default_value,
                     interpret=tk.interpret_mode()),
                 name=f"kv.lookup.{self.name}.pallas",
                 out_shardings=(replicated, replicated)),
-            pallas_sharded=lambda: profiled_jit(
+            pallas_sharded=None if not allow_pallas else lambda: profiled_jit(
                 tk.build_kv_lookup_sharded(
                     slots=self.slots, value_dim=self.value_dim,
                     default_value=self.default_value,
@@ -321,7 +347,7 @@ class KVTable:
                 donate_argnums=(0, 1, 2),
                 out_shardings=(self._key_sharding, self._val_sharding,
                                state_sh, scalar_sh)),
-            pallas=lambda: profiled_jit(
+            pallas=None if not allow_pallas else lambda: profiled_jit(
                 tk.build_kv_probe_update(
                     slots=self.slots, value_dim=self.value_dim,
                     updater=self.updater, state_template=self.state,
@@ -330,7 +356,7 @@ class KVTable:
                 donate_argnums=(0, 1, 2),
                 out_shardings=(self._key_sharding, self._val_sharding,
                                state_sh, scalar_sh)),
-            pallas_sharded=lambda: profiled_jit(
+            pallas_sharded=None if not allow_pallas else lambda: profiled_jit(
                 tk.build_kv_probe_update_sharded(
                     slots=self.slots, value_dim=self.value_dim,
                     updater=self.updater, state_template=self.state,
@@ -362,15 +388,60 @@ class KVTable:
                              "sentinel")
         return keys
 
-    def _raise_overflow(self, n_over: int) -> None:
+    def _raise_overflow(self, n_over: int, bucket_ids=None) -> None:
+        where = ""
+        if bucket_ids:
+            shown = ", ".join(str(b) for b in bucket_ids[:16])
+            more = "" if len(bucket_ids) <= 16 \
+                else f" (+{len(bucket_ids) - 16} more)"
+            where = f"; bucket id(s) at capacity for the batch: " \
+                    f"[{shown}]{more}"
         raise RuntimeError(
             f"kv table {self.name!r}: {n_over} keys overflowed their "
-            f"buckets ({self.slots} slots) in a previous add (the "
-            "batch was dropped atomically); raise capacity or "
-            "slots_per_bucket. NOTE: the dropped add still advanced "
-            "the table generation and option step (its buffers were "
-            "swapped; overflow is only known after device execution) — "
-            "re-issue the dropped batch after resizing")
+            f"buckets in a previous add (configured capacity "
+            f"{self.capacity} keys = {self.capacity // self.slots} "
+            f"buckets x {self.slots} slots{where}; the batch was "
+            "dropped "
+            "atomically); raise capacity or slots_per_bucket. NOTE: "
+            "the dropped add still advanced the table generation and "
+            "option step (its buffers were swapped; overflow is only "
+            "known after device execution) — re-issue the dropped "
+            "batch after resizing")
+
+    def _overflowing_buckets(self, host_buckets) -> list:
+        """Cold path behind an overflow raise: name the buckets that
+        could not take the dropped batch. A bucket is flagged when the
+        batch's key demand plus its CURRENT fill exceeds ``slots`` —
+        an upper bound (keys already present match in place and need
+        no new slot), but the dropped batch left fill untouched, so
+        the true overflowing bucket is always in the list."""
+        if host_buckets is None or len(host_buckets) == 0:
+            return []
+        ub, cnt = np.unique(np.asarray(host_buckets, np.int64),
+                            return_counts=True)
+        rows = np.asarray(jnp.take(
+            self.keys, jnp.asarray(ub, jnp.int32), axis=0))
+        fill = (~(rows == np.uint32(0xFFFFFFFF)).all(-1)).sum(-1)
+        return [int(b) for b in ub[(fill + cnt) > self.slots]]
+
+    @staticmethod
+    def _over_entry(entry):
+        """``_pending_over`` entries are ``(flag, host_buckets)`` pairs;
+        a bare flag (the pre-tiering contract, still poked in by tests
+        and tools) reads as a pair with no bucket context."""
+        return entry if isinstance(entry, tuple) else (entry, None)
+
+    def _drain_overflow(self, entries) -> None:
+        n_over = 0
+        bucket_ids: set = set()
+        for entry in entries:
+            flag, host_buckets = self._over_entry(entry)
+            n = int(np.asarray(flag))
+            if n:
+                n_over += n
+                bucket_ids.update(self._overflowing_buckets(host_buckets))
+        if n_over:
+            self._raise_overflow(n_over, sorted(bucket_ids))
 
     def _check_overflow(self) -> None:
         """Raise any pending overflow from previous async adds —
@@ -380,9 +451,7 @@ class KVTable:
         nothing; the overflowed batches were dropped atomically on
         device, so the table is consistent."""
         pending, self._pending_over = self._pending_over, []
-        n_over = sum(int(np.asarray(p)) for p in pending)
-        if n_over:
-            self._raise_overflow(n_over)
+        self._drain_overflow(pending)
 
     def _poll_overflow(self) -> None:
         """Non-blocking drain for the ``add`` hot path: only flags whose
@@ -395,14 +464,13 @@ class KVTable:
         ``np.asarray`` readback, and every non-add table op drains it
         through :meth:`_check_overflow` anyway."""
         still, ready = [], []
-        for p in self._pending_over:
-            is_ready = getattr(p, "is_ready", None)
+        for entry in self._pending_over:
+            is_ready = getattr(self._over_entry(entry)[0], "is_ready",
+                               None)
             (ready if is_ready is not None and is_ready()
-             else still).append(p)
+             else still).append(entry)
         self._pending_over = still
-        n_over = sum(int(np.asarray(p)) for p in ready)
-        if n_over:
-            self._raise_overflow(n_over)
+        self._drain_overflow(ready)
 
     # -- API ---------------------------------------------------------------
 
@@ -425,6 +493,15 @@ class KVTable:
         query sizes share compiled signatures."""
         self._check_overflow()
         keys = self._check_keys(keys)
+        return self._get_with_buckets(keys, self._buckets_of(keys))
+
+    def _get_with_buckets(self, keys: np.ndarray,
+                          lane_buckets: np.ndarray):
+        """Dispatch half of a Get for pre-hashed per-lane bucket ids in
+        DEVICE geometry — the seam the tiered store drives after
+        translating logical buckets to resident device slots
+        (``storage/tiered_kv.py``); :meth:`get_jax` is the identity
+        translation."""
         n = len(keys)
         t0 = time.monotonic()
         with tracing.span("table.get",
@@ -433,14 +510,14 @@ class KVTable:
             elems = n * max(self.value_dim, 1)
             self._record_op("get", elems, elems * self.dtype.itemsize)
             if self._lookup.layout == "sharded":
-                out = self._get_jax_sharded(keys, n)
+                out = self._get_jax_sharded(keys, lane_buckets, n)
                 self._h_get.observe(time.monotonic() - t0)
                 return out
             b = _bucket(n)
             query = np.full((b, 2), 0xFFFFFFFF, np.uint32)
             query[:n] = _split_keys(keys)
             buckets = np.zeros(b, np.int32)
-            buckets[:n] = self._buckets_of(keys)
+            buckets[:n] = lane_buckets
             vals, found = self._lookup(
                 self.keys, self.values,
                 core.place(query, mesh=self.mesh),
@@ -450,14 +527,14 @@ class KVTable:
         self._h_get.observe(time.monotonic() - t0)
         return vals, found
 
-    def _get_jax_sharded(self, keys: np.ndarray, n: int):
+    def _get_jax_sharded(self, keys: np.ndarray,
+                         lane_buckets: np.ndarray, n: int):
         """Lane-sliced Get prep for the sharded engine: sort lanes by
         owning shard, hand each shard its dense row of local bucket ids
         + queries, and an ``inv`` map (flat ``shard*L + pos`` indices,
         pow2-padded) that unpermutes the per-shard results back to
         caller order."""
         bps = self._buckets_per_shard
-        lane_buckets = self._buckets_of(keys)
         shard_ids = lane_buckets // bps
         order = np.argsort(shard_ids, kind="stable")
         sshard = shard_ids[order]
@@ -514,6 +591,18 @@ class KVTable:
         lane-order-insensitive (its rank tie-break is batch order, which
         a stable sort preserves within each bucket) — so the final table
         state is identical either way."""
+        keys, deltas, lane_buckets, opt = self._prep_host_add(
+            keys, deltas, option)
+        return self._pack_prepared(keys, deltas, lane_buckets, opt)
+
+    def _prep_host_add(self, keys, deltas,
+                       option: Optional[AddOption] = None):
+        """Placement-independent host half of :meth:`prepare_add`:
+        validate, hash, stable-sort by bucket, resolve the AddOption.
+        Returns host arrays sorted by THIS table's bucket ids — device
+        geometry here; LOGICAL geometry in the tiered subclass, which
+        defers packing until its dispatch half has faulted the buckets
+        in and can translate them to device slots."""
         keys = self._check_keys(keys)
         uniq = np.unique(keys)
         if len(uniq) != len(keys):
@@ -526,9 +615,18 @@ class KVTable:
         deltas = chaos_corrupt("table.add", deltas)
         lane_buckets = self._buckets_of(keys)
         order = np.argsort(lane_buckets, kind="stable")
-        keys = keys[order]
-        deltas = deltas[order]
-        lane_buckets = lane_buckets[order]
+        opt = (option or self.default_option).as_jax(self.mesh)
+        return keys[order], deltas[order], lane_buckets[order], opt
+
+    def _pack_prepared(self, keys: np.ndarray, deltas: np.ndarray,
+                       lane_buckets: np.ndarray,
+                       opt: AddOption) -> "PreparedKVAdd":
+        """Pack bucket-sorted host lanes into the selected engine's
+        operand layout and STAGE them on device (H2D). ``lane_buckets``
+        must be DEVICE-geometry bucket ids, sorted ascending with
+        per-bucket batch order preserved (what :meth:`_prep_host_add`
+        returns for a non-tiered table)."""
+        n = len(keys)
         if self._probe_update.layout == "sharded":
             # bucket ownership is contiguous equal blocks, so the sort
             # above already grouped lanes by owning shard (in shard
@@ -542,7 +640,6 @@ class KVTable:
                     shard_ids, self._shards,
                     [local, _split_keys(keys), deltas],
                     [np.int32(bps - 1), np.uint32(0xFFFFFFFF), 0])
-            opt = (option or self.default_option).as_jax(self.mesh)
             mput = lambda a: core.place(
                 a, P(core.MODEL_AXIS, *([None] * (a.ndim - 1))),
                 mesh=self.mesh)
@@ -551,7 +648,7 @@ class KVTable:
                 deltas=mput(sl_deltas), valid=mput(valid), option=opt,
                 elems=int(deltas.size),
                 nbytes=int(deltas.size) * self.dtype.itemsize,
-                layout="sharded")
+                layout="sharded", host_buckets=lane_buckets)
         b = _bucket(n)
         query = np.full((b, 2), 0xFFFFFFFF, np.uint32)
         query[:n] = _split_keys(keys)
@@ -563,12 +660,12 @@ class KVTable:
         pdeltas[:n] = deltas
         valid = np.zeros(b, bool)
         valid[:n] = True
-        opt = (option or self.default_option).as_jax(self.mesh)
         put = lambda a: core.place(a, mesh=self.mesh)
         return PreparedKVAdd(buckets=put(buckets), query=put(query),
                              deltas=put(pdeltas), valid=put(valid),
                              option=opt, elems=int(deltas.size),
-                             nbytes=int(deltas.size) * self.dtype.itemsize)
+                             nbytes=int(deltas.size) * self.dtype.itemsize,
+                             host_buckets=lane_buckets)
 
     def add_prepared(self, prepared: "PreparedKVAdd",
                      sync: bool = False) -> Handle:
@@ -587,7 +684,7 @@ class KVTable:
                     self.keys, self.values, self.state,
                     prepared.buckets, prepared.query, prepared.deltas,
                     prepared.valid, prepared.option)
-            self._pending_over.append(n_over)
+            self._pending_over.append((n_over, prepared.host_buckets))
             _health.observe_param(self, self.values)
             with self._option_lock:
                 self.default_option.step += 1
@@ -648,7 +745,7 @@ class KVTable:
         self.flush_coalesced()
         self._check_overflow()
         if self._export_copy is None:
-            state_sh = jax.tree.map(lambda _: self._val_sharding,
+            state_sh = jax.tree.map(lambda _: self._state_sharding,
                                     self.state)
             self._export_copy = jax.jit(
                 lambda k, v, s: (jnp.copy(k), jnp.copy(v),
@@ -726,7 +823,7 @@ class KVTable:
         state_dev = unpack_state(
             state_src, manifest["n_state_leaves"], self.state,
             lambda leaf, tmpl: jax.device_put(leaf.astype(tmpl.dtype),
-                                              self._val_sharding))
+                                              self._state_sharding))
         # commit only after every new array placed: an exception above
         # (missing state leaf, placement failure) must leave the live
         # table consistent — geometry fields changing ahead of the

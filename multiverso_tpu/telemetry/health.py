@@ -85,6 +85,19 @@ STAT_ALIASES = {
 # against ~0 would fire on the first real update of a cold table
 SPIKE_BASELINE_FLOOR = 1e-9
 
+
+def ewma_step(prev, value, alpha: float):
+    """One exponential-window update: ``prev + alpha * (value - prev)``.
+
+    The single smoothing rule every exponential window in the package
+    shares — the HealthMonitor's per-(table, kind, stat) baselines here
+    and the storage tier manager's per-bucket access scores
+    (``storage/manager.py``), which apply it elementwise over numpy
+    arrays (the formula broadcasts) and decay idle buckets lazily as
+    ``prev * (1 - alpha) ** dt`` — exactly ``dt`` stacked updates with
+    ``value=0``."""
+    return prev + alpha * (value - prev)
+
 # minimum seconds between gauge exports per (table, kind) stream — the
 # stats STILL feed rules/EWMA on every sample; only the registry writes
 # (scrape surface) are throttled to keep the ingest worker cheap
@@ -329,7 +342,7 @@ class HealthMonitor:
             if st is None:
                 self._ewma[key] = [v, 1]
             else:
-                st[0] += self.alpha * (v - st[0])
+                st[0] = ewma_step(st[0], v, self.alpha)
                 st[1] += 1
 
     # -- escalation --------------------------------------------------------

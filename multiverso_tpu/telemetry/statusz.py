@@ -149,6 +149,7 @@ def _statusz_doc() -> dict:
             if slo is not None else [],
         },
         "health": _health_status(),
+        "storage": _storage_status(),
     }
 
 
@@ -160,6 +161,19 @@ def _health_status() -> Optional[dict]:
         return None
     try:
         return health.status()
+    except Exception:
+        return None
+
+
+def _storage_status() -> Optional[list]:
+    """Per-table tier residency from the tiered-storage managers, via
+    sys.modules like the lookups above (statusz must not pull in the
+    storage subsystem for processes that never made a tiered table)."""
+    mgr = sys.modules.get("multiverso_tpu.storage.manager")
+    if mgr is None:
+        return None
+    try:
+        return mgr.status_all()
     except Exception:
         return None
 

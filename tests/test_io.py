@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 
 from multiverso_tpu.io import (StreamFactory, mem_store_clear, open_stream,
-                               register_scheme)
+                               pread, register_scheme)
 
 
 @pytest.fixture(autouse=True)
@@ -58,6 +58,53 @@ class TestMemScheme:
         s.close()
         with open_stream("mem://partial", "rb") as r:
             assert r.read() == b"half"
+
+
+class TestPread:
+    """Ranged reads (the cold-tier bucket fill path): exactly ``size``
+    bytes from ``offset``, never the whole file."""
+
+    def test_ranged_read(self, tmp_path):
+        p = tmp_path / "blob.bin"
+        p.write_bytes(bytes(range(64)))
+        assert pread(str(p), 0, 4) == bytes(range(4))
+        assert pread(f"file://{p}", 10, 5) == bytes(range(10, 15))
+        assert pread(str(p), 60, 4) == bytes(range(60, 64))
+
+    def test_mem_scheme(self):
+        with open_stream("mem://pr", "wb") as s:
+            s.write(b"abcdefgh")
+        assert pread("mem://pr", 2, 3) == b"cde"
+
+    def test_short_read_raises(self, tmp_path):
+        p = tmp_path / "short.bin"
+        p.write_bytes(b"12345678")
+        with pytest.raises(EOFError, match="short read"):
+            pread(str(p), 4, 8)
+
+    def test_bad_range_rejected(self, tmp_path):
+        p = tmp_path / "x.bin"
+        p.write_bytes(b"abc")
+        with pytest.raises(ValueError):
+            pread(str(p), -1, 2)
+        with pytest.raises(ValueError):
+            pread(str(p), 0, -2)
+
+    def test_per_scheme_byte_counter(self, tmp_path):
+        from multiverso_tpu.telemetry import metrics as telemetry
+        p = tmp_path / "ctr.bin"
+        p.write_bytes(bytes(100))
+
+        def read_bytes():
+            snap = telemetry.snapshot()
+            return sum(v for k, v in snap["counters"].items()
+                       if k.startswith("io.read.bytes")
+                       and "scheme=file" in k)
+
+        before = read_bytes()
+        pread(str(p), 30, 7)
+        # only the ranged bytes count, not the file size
+        assert read_bytes() - before == 7
 
 
 class TestRegistry:

@@ -1,0 +1,488 @@
+"""Tiered KV storage tests (multiverso_tpu/storage): host arena +
+CRC-stamped disk spill file, the EWMA placement policy, the
+TieredKVTable fault-in path (parity with a plain KVTable), and the
+headline resume guarantee — a tiered checkpoint with buckets in all
+three tiers restores bit-identically, including under a chaos kill
+storm."""
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.ft.chaos import (ChaosCrash, install_chaos,
+                                     uninstall_chaos)
+from multiverso_tpu.storage import (TIER_DEVICE, TIER_DISK, TIER_HOST,
+                                    TIER_VIRGIN, DiskTier, HostTier,
+                                    RecordSpec, TierConfig, TierManager,
+                                    TieredKVTable)
+from multiverso_tpu.tables import KVTable, reset_tables
+from multiverso_tpu.telemetry import metrics as telemetry
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    uninstall_chaos()
+    reset_tables()
+
+
+def _spec(slots=4, value_dim=2, n_state=1):
+    return RecordSpec(slots, value_dim, np.float32,
+                      [np.float32] * n_state, 0.0)
+
+
+def _rec(spec, seed=0):
+    rng = np.random.default_rng(seed)
+    rec = spec.empty()
+    rec.keys[0] = [seed + 1, seed + 2]
+    rec.values[:] = rng.normal(size=spec.val_shape).astype(np.float32)
+    for leaf in rec.state:
+        leaf[:] = rng.normal(size=spec.val_shape).astype(np.float32)
+    return rec
+
+
+def _assert_rec_equal(a, b):
+    np.testing.assert_array_equal(a.keys, b.keys)
+    np.testing.assert_array_equal(a.values, b.values)
+    assert len(a.state) == len(b.state)
+    for x, y in zip(a.state, b.state):
+        np.testing.assert_array_equal(x, y)
+
+
+class TestRecordSpec:
+    def test_pack_unpack_roundtrip(self):
+        spec = _spec(n_state=2)
+        rec = _rec(spec, seed=3)
+        got = spec.unpack(spec.pack(rec))
+        _assert_rec_equal(rec, got)
+
+    def test_scalar_values_shape(self):
+        spec = _spec(value_dim=0)
+        assert spec.val_shape == (4,)
+        _assert_rec_equal(spec.empty(),
+                          spec.unpack(spec.pack(spec.empty())))
+
+    def test_bad_payload_length_rejected(self):
+        spec = _spec()
+        with pytest.raises(ValueError, match="bytes"):
+            spec.unpack(b"\x00" * (spec.payload_nbytes - 1))
+
+    def test_empty_is_all_empty(self):
+        assert _spec().empty().live() == 0
+        assert _rec(_spec()).live() == 1
+
+
+class TestHostTier:
+    def test_put_take_roundtrip(self):
+        spec = _spec()
+        h = HostTier(2, spec)
+        r0, r1 = _rec(spec, 0), _rec(spec, 1)
+        h.put(10, r0)
+        h.put(20, r1)
+        assert h.full and len(h) == 2
+        assert 10 in h and 30 not in h
+        _assert_rec_equal(h.peek(10), r0)      # peek keeps the row
+        _assert_rec_equal(h.take(10), r0)      # take frees it
+        assert 10 not in h and not h.full
+        _assert_rec_equal(h.take(20), r1)
+
+    def test_duplicate_put_rejected(self):
+        h = HostTier(2, _spec())
+        h.put(1, _rec(_spec()))
+        with pytest.raises(ValueError, match="already"):
+            h.put(1, _rec(_spec()))
+
+    def test_put_beyond_capacity_rejected(self):
+        h = HostTier(1, _spec())
+        h.put(1, _rec(_spec()))
+        with pytest.raises(RuntimeError, match="full"):
+            h.put(2, _rec(_spec()))
+
+    def test_live_keys(self):
+        spec = _spec()
+        h = HostTier(3, spec)
+        h.put(1, _rec(spec, 0))   # 1 live lane each
+        h.put(2, _rec(spec, 1))
+        h.put(3, spec.empty())
+        assert h.live_keys() == 2
+
+
+class TestDiskTier:
+    def test_spill_fill_roundtrip(self, tmp_path):
+        spec = _spec(n_state=2)
+        d = DiskTier(str(tmp_path / "t.spill"), spec)
+        r0, r1 = _rec(spec, 0), _rec(spec, 1)
+        d.spill(5, r0)
+        d.spill(9, r1)
+        assert len(d) == 2 and 5 in d
+        _assert_rec_equal(d.peek(5), r0)       # peek keeps the slot
+        _assert_rec_equal(d.fill(5), r0)       # fill frees it
+        assert 5 not in d
+        d.spill(7, _rec(spec, 2))              # reuses slot 0
+        assert d.nbytes() == 2 * d.record_nbytes
+        _assert_rec_equal(d.fill(9), r1)
+
+    def test_respill_overwrites_in_place(self, tmp_path):
+        spec = _spec()
+        d = DiskTier(str(tmp_path / "t.spill"), spec)
+        d.spill(3, _rec(spec, 0))
+        d.spill(3, _rec(spec, 1))
+        assert len(d) == 1
+        assert d.nbytes() == d.record_nbytes
+        _assert_rec_equal(d.fill(3), _rec(spec, 1))
+
+    def test_torn_record_fails_crc(self, tmp_path):
+        spec = _spec()
+        path = tmp_path / "t.spill"
+        d = DiskTier(str(path), spec)
+        d.spill(3, _rec(spec, 0))
+        raw = bytearray(path.read_bytes())
+        raw[-1] ^= 0xFF                        # flip a payload byte
+        path.write_bytes(bytes(raw))
+        with pytest.raises(IOError, match="CRC mismatch"):
+            d.fill(3)
+
+    def test_stale_slot_fails_bucket_stamp(self, tmp_path):
+        spec = _spec()
+        path = tmp_path / "t.spill"
+        d = DiskTier(str(path), spec)
+        d.spill(3, _rec(spec, 0))
+        raw = bytearray(path.read_bytes())
+        raw[0] ^= 0xFF                         # corrupt the bucket id
+        path.write_bytes(bytes(raw))
+        with pytest.raises(IOError, match="expected bucket 3"):
+            d.fill(3)
+
+    def test_byte_counters(self, tmp_path):
+        spec = _spec()
+        d = DiskTier(str(tmp_path / "t.spill"), spec)
+
+        def bytes_ctr(direction):
+            snap = telemetry.snapshot()
+            return sum(v for k, v in snap["counters"].items()
+                       if k.startswith("storage.bytes")
+                       and f"dir={direction}" in k)
+
+        s0, f0 = bytes_ctr("spill"), bytes_ctr("fill")
+        d.spill(1, _rec(spec, 0))
+        d.fill(1)
+        assert bytes_ctr("spill") - s0 == d.record_nbytes
+        assert bytes_ctr("fill") - f0 == d.record_nbytes
+
+    def test_chaos_transient_fault_retried(self, tmp_path):
+        """storage.spill/storage.fill sit INSIDE the retry closure:
+        one injected transient error per op is invisible."""
+        spec = _spec()
+        d = DiskTier(str(tmp_path / "t.spill"), spec)
+        install_chaos("storage.spill:error:times=1;"
+                      "storage.fill:error:times=1")
+        d.spill(1, _rec(spec, 0))
+        _assert_rec_equal(d.fill(1), _rec(spec, 0))
+
+    def test_chaos_crash_never_swallowed(self, tmp_path):
+        spec = _spec()
+        d = DiskTier(str(tmp_path / "t.spill"), spec)
+        install_chaos("storage.spill:crash:times=1")
+        with pytest.raises(ChaosCrash):
+            d.spill(1, _rec(spec, 0))
+        uninstall_chaos()
+        assert 1 not in d                      # nothing committed
+        d.spill(1, _rec(spec, 0))              # clean state: works
+
+
+class TestTierManager:
+    def _mgr(self, tmp_path, total=8, device=2, host=1, alpha=0.5):
+        cfg = TierConfig(device_buckets=device, host_buckets=host,
+                         spill_dir=str(tmp_path), alpha=alpha)
+        return TierManager("tm", total, cfg, _spec())
+
+    def test_virgin_fills_are_free(self, tmp_path):
+        m = self._mgr(tmp_path)
+        plan = m.plan(np.array([0, 1]))
+        assert plan.victims.size == 0
+        assert sorted(plan.fills) == [0, 1]
+        for b in plan.fills:
+            rec, src = m.fetch(int(b))
+            assert rec is None and src == "virgin"
+            slot, was_used = m.assign_slot(int(b))
+            assert not was_used                # no device write needed
+        assert m.counts()["device"] == 2
+
+    def test_coldest_bucket_is_victim(self, tmp_path):
+        m = self._mgr(tmp_path)
+        for b in (0, 1):
+            m.fetch(b)
+            m.assign_slot(b)
+        m.touch(np.array([0]))
+        m.touch(np.array([0]))                 # 0 is hot, 1 cold
+        plan = m.plan(np.array([0, 5]))
+        assert list(plan.victims) == [1]
+        assert list(plan.fills) == [5]
+
+    def test_demote_cascades_host_to_disk(self, tmp_path):
+        m = self._mgr(tmp_path, host=1)
+        spec = m.spec
+        for b in (0, 1):
+            m.fetch(b)
+            m.assign_slot(b)
+        m.demote(0, _rec(spec, 0))             # host has room
+        assert m.tier[0] == TIER_HOST and 0 in m.host
+        m.demote(1, _rec(spec, 1))             # host full: 0 spills
+        assert m.tier[1] == TIER_HOST
+        assert m.tier[0] == TIER_DISK and 0 in m.disk
+        # round trips preserve content through the cascade
+        rec, src = m.fetch(0)
+        assert src == "disk"
+        _assert_rec_equal(rec, _rec(spec, 0))
+        rec, src = m.fetch(1)
+        assert src == "host"
+        _assert_rec_equal(rec, _rec(spec, 1))
+
+    def test_zero_host_budget_spills_direct(self, tmp_path):
+        m = self._mgr(tmp_path, host=0)
+        m.fetch(0)
+        m.assign_slot(0)
+        m.demote(0, _rec(m.spec, 0))
+        assert m.tier[0] == TIER_DISK
+
+    def test_plan_wider_than_device_rejected(self, tmp_path):
+        m = self._mgr(tmp_path, device=2)
+        with pytest.raises(ValueError, match="chunk"):
+            m.plan(np.array([0, 1, 2]))
+
+    def test_status_counts(self, tmp_path):
+        m = self._mgr(tmp_path)
+        m.fetch(0)
+        m.assign_slot(0)
+        st = m.status()
+        assert st["table"] == "tm" and st["resident"] == 1
+        assert st["virgin"] == 7
+        c = m.counts()
+        assert c["device"] == 1 and c["virgin"] == 7
+        assert m.tier[0] == TIER_DEVICE
+        assert (m.tier == TIER_VIRGIN).sum() == 7
+
+
+def _tiered(name, tmp_path, capacity=2048, **kw):
+    kw.setdefault("value_dim", 3)
+    kw.setdefault("updater", "adagrad")
+    kw.setdefault("slots_per_bucket", 8)
+    kw.setdefault("device_buckets", 16)
+    kw.setdefault("host_buckets", 8)
+    return TieredKVTable(capacity, name=name,
+                         spill_dir=str(tmp_path / name), **kw)
+
+
+class TestTieredKVTable:
+    def test_parity_with_plain_kv(self, mesh8, tmp_path):
+        """Same op history through the tiers and through a plain
+        device-resident KVTable -> same values, exactly (state rides
+        the demote/spill/fill round trips)."""
+        rng = np.random.default_rng(0)
+        plain = KVTable(2048, value_dim=3, updater="adagrad",
+                        name="par_plain")
+        tiered = _tiered("par_tiered", tmp_path)
+        assert tiered.tiers.device_buckets < tiered.total_buckets
+        keys = rng.choice(2 ** 50, size=300, replace=False) \
+            .astype(np.uint64)
+        for _ in range(2):
+            d = rng.normal(size=(300, 3)).astype(np.float32)
+            plain.add(keys, d, sync=True)
+            tiered.add(keys, d, sync=True)
+        vp, fp = plain.get(keys)
+        vt, ft = tiered.get(keys)
+        assert fp.all() and ft.all()
+        np.testing.assert_array_equal(vp, vt)
+        assert len(tiered) == len(plain) == 300
+        # missing keys behave identically too
+        miss = np.array([999999999999], np.uint64)
+        assert not tiered.get(miss)[1].any()
+
+    def test_batch_wider_than_device_tier_chunks(self, mesh8, tmp_path):
+        """A single get/add touching more distinct buckets than the
+        device budget holds must chunk, not raise."""
+        rng = np.random.default_rng(1)
+        t = _tiered("wide", tmp_path, device_buckets=4, host_buckets=2)
+        keys = rng.choice(2 ** 40, size=200, replace=False) \
+            .astype(np.uint64)
+        buckets = np.unique(t._buckets_of(keys))
+        assert len(buckets) > t.tiers.device_buckets
+        d = rng.normal(size=(200, 3)).astype(np.float32)
+        t.add(keys, d, sync=True)
+        vals, found = t.get(keys)
+        assert found.all()
+        # get order is caller order even through the chunk unpermute
+        v2, f2 = t.get(keys[::-1])
+        np.testing.assert_array_equal(np.asarray(v2),
+                                      np.asarray(vals)[::-1])
+
+    def test_overflow_names_logical_buckets_and_capacity(self, mesh8,
+                                                         tmp_path):
+        t = _tiered("ovf", tmp_path, capacity=64, value_dim=0,
+                    updater="default", slots_per_bucket=2,
+                    device_buckets=4, host_buckets=2)
+        # find 3 keys hashing to one LOGICAL bucket (slots=2)
+        probe = np.arange(1, 4096, dtype=np.uint64)
+        buckets = t._buckets_of(probe)
+        ids, counts = np.unique(buckets, return_counts=True)
+        target = int(ids[np.argmax(counts)])
+        assert counts.max() >= 3
+        bad = probe[buckets == target][:3]
+        with pytest.raises(RuntimeError) as ei:
+            t.add(bad, np.ones(3, np.float32), sync=True)
+        msg = str(ei.value)
+        assert f"configured capacity {t.capacity} keys" in msg
+        assert f"{t.capacity // t.slots} buckets" in msg
+        assert str(target) in msg              # the logical bucket id
+
+    def test_len_counts_all_tiers(self, mesh8, tmp_path):
+        rng = np.random.default_rng(2)
+        t = _tiered("len3", tmp_path, device_buckets=8, host_buckets=4)
+        keys = rng.choice(2 ** 40, size=150, replace=False) \
+            .astype(np.uint64)
+        t.add(keys, rng.normal(size=(150, 3)).astype(np.float32),
+              sync=True)
+        c = t.tiers.counts()
+        assert c["host"] > 0 and c["disk"] > 0
+        assert len(t) == 150
+
+    def test_store_load_bitident_across_tiers(self, mesh8, tmp_path):
+        """The satellite guarantee: a checkpoint taken with buckets in
+        ALL THREE tiers restores bit-identically — values, found
+        flags, adagrad state (continuation adds agree) — and the
+        placement is re-established."""
+        rng = np.random.default_rng(3)
+        t = _tiered("ckpt_src", tmp_path)
+        keys = rng.choice(2 ** 45, size=400, replace=False) \
+            .astype(np.uint64)
+        for _ in range(2):
+            t.add(keys, rng.normal(size=(400, 3)).astype(np.float32),
+                  sync=True)
+        c = t.tiers.counts()
+        assert c["device"] > 0 and c["host"] > 0 and c["disk"] > 0
+        uri = str(tmp_path / "tiered.ckpt")
+        t.store(uri)
+        r = _tiered("ckpt_dst", tmp_path)
+        r.load(uri)
+        vt, ft = t.get(keys)
+        vr, fr = r.get(keys)
+        np.testing.assert_array_equal(np.asarray(ft), np.asarray(fr))
+        np.testing.assert_array_equal(np.asarray(vt), np.asarray(vr))
+        assert len(r) == 400
+        rc = r.tiers.counts()
+        assert rc["disk"] > 0                  # placement restored too
+        # adagrad accumulators came along: continuation adds agree
+        d = rng.normal(size=(400, 3)).astype(np.float32)
+        t.add(keys, d, sync=True)
+        r.add(keys, d, sync=True)
+        np.testing.assert_array_equal(np.asarray(t.get(keys)[0]),
+                                      np.asarray(r.get(keys)[0]))
+
+    def test_staging_writer_split(self, mesh8, tmp_path):
+        """The KVStagingWriter seam: prepare off-thread, dispatch (and
+        fault-in) on the caller's thread — same result as sync adds."""
+        from multiverso_tpu.client import stage_kv_adds
+        rng = np.random.default_rng(5)
+        t = _tiered("stage_t", tmp_path)
+        ref = _tiered("stage_ref", tmp_path)
+        batches = []
+        for i in range(4):
+            ks = rng.choice(2 ** 40, size=100, replace=False) \
+                .astype(np.uint64)
+            batches.append((ks, rng.normal(size=(100, 3))
+                            .astype(np.float32)))
+        h = stage_kv_adds(t, batches, depth=2)
+        h.wait()
+        for ks, d in batches:
+            ref.add(ks, d, sync=True)
+        all_keys = np.unique(np.concatenate([b[0] for b in batches]))
+        np.testing.assert_array_equal(np.asarray(t.get(all_keys)[0]),
+                                      np.asarray(ref.get(all_keys)[0]))
+
+    def test_geometry_mismatch_rejected(self, mesh8, tmp_path):
+        t = _tiered("geo_a", tmp_path, capacity=2048)
+        t.add(np.array([5], np.uint64), np.ones((1, 3), np.float32),
+              sync=True)
+        uri = str(tmp_path / "geo.ckpt")
+        t.store(uri)
+        r = _tiered("geo_b", tmp_path, capacity=4096)
+        with pytest.raises(ValueError, match="num_buckets"):
+            r.load(uri)
+
+    def test_statusz_storage_section(self, mesh8, tmp_path):
+        from multiverso_tpu.telemetry import statusz
+        _tiered("statz", tmp_path)
+        doc = statusz._statusz_doc()
+        rows = doc["storage"]
+        assert rows is not None
+        assert any(r["table"] == "statz" for r in rows)
+
+
+class _Kill(BaseException):
+    """Simulated eviction: BaseException so nothing 'recovers' it."""
+
+
+class TestTieredKillStormResume:
+    def test_killed_under_chaos_resumes_bitident(self, mesh8, tmp_path):
+        """Kill a checkpointed tiered run mid-stream WITH chaos
+        injecting transient faults into both the checkpoint writes and
+        the spill/fill paths; resume a fresh table from the latest
+        complete generation (buckets in all three tiers) and finish —
+        final state matches the uninterrupted run bit-for-bit."""
+        from multiverso_tpu.ft.checkpoint import RunCheckpointManager
+        rng = np.random.default_rng(4)
+        pop = rng.choice(2 ** 44, size=500, replace=False) \
+            .astype(np.uint64)
+        batches = []
+        for _ in range(6):
+            ks = rng.choice(pop, size=120, replace=False)
+            batches.append((ks, rng.normal(size=(120, 3))
+                            .astype(np.float32)))
+
+        def run(t, mgr, start, kill_at=None):
+            for i in range(start, len(batches)):
+                if kill_at is not None and i == kill_at:
+                    raise _Kill()
+                ks, d = batches[i]
+                t.add(ks, d, sync=True)
+                if mgr is not None:
+                    mgr.save(i + 1, {"round": i + 1})
+
+        # reference: uninterrupted, no checkpoints
+        ref = _tiered("storm_ref", tmp_path)
+        run(ref, None, 0)
+        want_v, want_f = ref.get(pop)
+
+        # interrupted run: transient chaos on checkpoint writes AND
+        # the tier movement paths (spaced so the 3-attempt retry
+        # always recovers), killed before round 5
+        ckpt_dir = str(tmp_path / "run")
+        t = _tiered("storm_kv", tmp_path / "a")
+        mgr = RunCheckpointManager(ckpt_dir, keep=2, tables=[t],
+                                   background=False)
+        install_chaos("io.write:error:times=1;"
+                      "io.write:error:after=40,times=1;"
+                      "storage.spill:error:times=1;"
+                      "storage.spill:error:after=30,times=1;"
+                      "storage.fill:error:times=1")
+        with pytest.raises(_Kill):
+            run(t, mgr, 0, kill_at=4)
+        mgr.close()
+        uninstall_chaos()
+        reset_tables()
+
+        # fresh process-equivalent: resume from the latest complete
+        # generation, verify all three tiers repopulate, finish
+        res = _tiered("storm_kv", tmp_path / "b")
+        mgr2 = RunCheckpointManager(ckpt_dir, keep=2, tables=[res],
+                                    background=False)
+        st = mgr2.resume()
+        assert st is not None and st.state["round"] == 4
+        c = res.tiers.counts()
+        assert c["device"] > 0 and c["host"] > 0 and c["disk"] > 0
+        run(res, mgr2, st.state["round"])
+        mgr2.close()
+        got_v, got_f = res.get(pop)
+        np.testing.assert_array_equal(np.asarray(want_f),
+                                      np.asarray(got_f))
+        np.testing.assert_array_equal(np.asarray(want_v),
+                                      np.asarray(got_v))

@@ -71,10 +71,20 @@ DEFAULT_WATCH = ("value", "e2e_words_per_sec", "lda_doc_tokens_per_sec",
                  "coo_scatter_ops_per_sec_pallas_sharded",
                  # serving bench (benchmarks/serving.py) throughput —
                  # its tail latencies ride DEFAULT_WATCH_LOWER below
-                 "serving_ops_per_sec")
+                 "serving_ops_per_sec",
+                 # tiered KV storage bench (benchmarks/tiered_kv.py):
+                 # get throughput under fault-in churn with the device
+                 # budget a fraction of the table
+                 "tiered_kv_get_ops_per_sec")
 
 # LOWER-is-better watches: a rise past the threshold regresses
-DEFAULT_WATCH_LOWER = ("serving_p99_ms",)
+DEFAULT_WATCH_LOWER = ("serving_p99_ms",
+                       # a rising miss ratio means the EWMA placement
+                       # stopped keeping the hot set device-resident
+                       "tiered_kv_miss_ratio",
+                       # cold-start miss-storm tail (serving bench's
+                       # tiered lane)
+                       "serving_tiered_p99_ms")
 
 
 def _flatten(prefix: str, obj, out: Dict[str, float]) -> None:
