@@ -42,6 +42,11 @@
 #                   an ephemeral statusz server + SLO rule armed, then
 #                   scrape /metrics /healthz /statusz /trace over HTTP
 #                   and assert non-null serving p50/p99/p999
+#   make mp-smoke - multi-process wire smoke: a TableServer process +
+#                   2 jax-free worker processes over a unix socket,
+#                   dense-fp32 and 1bit-quantized lanes (~15s budget;
+#                   asserts the quant lane ships >= 4x fewer bytes at
+#                   matched loss; emits serving_mp_bench.json)
 #   make chaos    - the chaos lane: fault-injection test subset
 #                   (ft subsystem + overwrite crash-window fuzz) plus a
 #                   CLI checkpoint/resume smoke under an active
@@ -55,7 +60,7 @@ NEW ?= BENCH_r05.json
 
 .PHONY: test dryrun bench bench-dryrun bench-diff bench-diff-selftest \
 	client-bench ckpt-bench kernel-bench tier-bench serve-smoke \
-	health-smoke chaos fuzz lint native ci
+	mp-smoke health-smoke chaos fuzz lint native ci
 
 fuzz:
 	$(PY) tests/deep_fuzz.py
@@ -89,6 +94,9 @@ tier-bench:
 
 serve-smoke:
 	$(PY) tools/serve_smoke.py
+
+mp-smoke:
+	MVTPU_SERVING_MP_TINY=1 $(PY) benchmarks/serving_mp.py
 
 health-smoke:
 	$(PY) tools/health_smoke.py
@@ -128,4 +136,4 @@ native:
 
 ci: lint bench-diff-selftest native test dryrun bench-dryrun \
 	client-bench ckpt-bench kernel-bench tier-bench serve-smoke \
-	health-smoke chaos
+	mp-smoke health-smoke chaos

@@ -131,6 +131,23 @@ def _client(tid: int, reqq: "queue.Queue", hist, errors: list) -> None:
         telemetry.counter("serving.ops", op=op.kind).inc()
 
 
+def publish_quantiles(hist, prefix: str,
+                      quantiles=("p50", "p99")) -> dict:
+    """Histogram tail → bench-line dict + registry gauges, one rule
+    for every serving lane (this bench's dense and tiered lanes, and
+    ``benchmarks/serving_mp.py``'s wire lane): each quantile becomes a
+    ``{prefix}_{q}_ms`` key AND a same-named gauge, so bench JSON and a
+    production ``MVTPU_SLO`` rule read identical numbers."""
+    out = {}
+    for q in quantiles:
+        v = getattr(hist, q)
+        assert v is not None, f"{prefix}: no latencies recorded"
+        name = f"{prefix}_{q}_ms"
+        telemetry.gauge(name).set(round(v * 1e3, 6))
+        out[name] = round(v * 1e3, 3)
+    return out
+
+
 def _tiered_storm() -> dict:
     """Cold-start miss storm: populate a tiered table wider than its
     device budget, demote everything hot off-device by streaming the
@@ -158,12 +175,7 @@ def _tiered_storm() -> dict:
             np.asarray(t.get(keys)[0])
             hist.observe(time.perf_counter() - t0)
             telemetry.counter("serving.ops", op="tiered_get").inc()
-        p50, p99 = hist.p50, hist.p99
-        assert p50 is not None, "tiered lane recorded no latencies"
-        telemetry.gauge("serving_tiered_p50_ms").set(round(p50 * 1e3, 6))
-        telemetry.gauge("serving_tiered_p99_ms").set(round(p99 * 1e3, 6))
-        return {"serving_tiered_p50_ms": round(p50 * 1e3, 3),
-                "serving_tiered_p99_ms": round(p99 * 1e3, 3)}
+        return publish_quantiles(hist, "serving_tiered")
     finally:
         shutil.rmtree(spill_dir, ignore_errors=True)
 
@@ -216,11 +228,6 @@ def main() -> None:
     tiered = _tiered_storm()
 
     n_ops = SIZES["threads"] * SIZES["ops"]
-    p50, p99, p999 = hist.p50, hist.p99, hist.p999
-    assert p50 is not None, "no latencies recorded"
-    for name, v in (("serving_p50_ms", p50), ("serving_p99_ms", p99),
-                    ("serving_p999_ms", p999)):
-        telemetry.gauge(name).set(round(v * 1e3, 6))
     # headline "value" stays higher-is-better (the generic watch);
     # the serving_pXX_ms keys are the LOWER-is-better watches
     line = {
@@ -228,13 +235,12 @@ def main() -> None:
         "value": round(n_ops / dt, 2),
         "unit": "ops/s",
         "tiny": TINY,
-        "serving_p50_ms": round(p50 * 1e3, 3),
-        "serving_p99_ms": round(p99 * 1e3, 3),
-        "serving_p999_ms": round(p999 * 1e3, 3),
         "serving_ops_per_sec": round(n_ops / dt, 2),
         "serving_threads": SIZES["threads"],
         "serving_ops": n_ops,
     }
+    line.update(publish_quantiles(hist, "serving",
+                                  ("p50", "p99", "p999")))
     line.update(tiered)
     out = os.environ.get("MVTPU_SERVING_BENCH_JSON",
                          "serving_bench.json")

@@ -1,0 +1,309 @@
+"""Multi-process serving bench: worker PROCESSES over the wire.
+
+The process topology the reference framework actually ran — N worker
+processes driving a parameter-server process over a transport — where
+``benchmarks/serving.py`` measures the in-process thread version. One
+server subprocess (``python -m multiverso_tpu.server``) owns the
+tables; worker subprocesses are **jax-free** (they file-path-load
+``client/transport.py`` and assert jax never imported) and train a
+softmax logistic regression against the server in two lanes:
+
+- **dense** — fp32 deltas on the wire,
+- **quant** — ``1bit`` quantized deltas with client-side error
+  feedback (``MVTPU_WIRE_QUANT``'s headline mode).
+
+What the bench asserts (the perf claim, measured not vibed):
+
+- both lanes CONVERGE: final loss well below the initial loss, and the
+  quant lane's final loss within ``LOSS_TOL`` of the dense lane's;
+- error feedback works: quant-lane final params within ``PARAM_TOL``
+  relative L2 of the dense-lane params;
+- quantization moves ≥ :data:`MIN_BYTES_RATIO`× fewer add-path bytes
+  than fp32 (client→server tx compared between lanes).
+
+Emits (stdout JSON + ``serving_mp_bench.json``):
+
+- ``serving_mp_p99_ms`` — p99 worker step latency (get + pipelined
+  add submit), the lower-is-better watch in ``tools/bench_diff.py``;
+- ``wire_mb_per_sec`` — total bytes-on-wire / lane wall time, the
+  higher-is-better watch.
+
+``MVTPU_SERVING_MP_TINY=1`` shrinks everything to the ``make
+mp-smoke`` budget. ``MVTPU_SERVING_MP_WORKERS`` overrides the worker
+count (default 2).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "multiverso_tpu")
+
+TINY = os.environ.get("MVTPU_SERVING_MP_TINY", "") not in ("", "0")
+N_WORKERS = int(os.environ.get("MVTPU_SERVING_MP_WORKERS", "") or 2)
+
+# model geometry: W is (features x classes), flattened onto one dense
+# ArrayTable — big enough that delta bytes dominate frame headers
+SIZES = ({"features": 128, "classes": 8, "rows": 256, "steps": 24}
+         if TINY else
+         {"features": 256, "classes": 8, "rows": 512, "steps": 48})
+LR = 0.2
+DATA_SEED = 42
+
+LOSS_TOL = 1.10          # quant final loss ≤ dense final loss * this
+PARAM_TOL = 0.20         # rel-L2(quant W, dense W) ≤ this
+MIN_BYTES_RATIO = 4.0    # dense add-path tx ≥ this × quant tx
+STARTUP_S = 60.0
+LANE_TIMEOUT_S = 120.0
+
+
+def _load_transport():
+    import importlib.util
+    modname = "multiverso_tpu.client.transport"
+    mod = sys.modules.get(modname)
+    if mod is not None:
+        return mod
+    spec = importlib.util.spec_from_file_location(
+        modname, os.path.join(PKG, "client", "transport.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[modname] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def make_dataset():
+    """Deterministic synthetic softmax-logreg problem (same arrays in
+    every process: parent scoring and worker shards must agree)."""
+    s = SIZES
+    rng = np.random.default_rng(DATA_SEED)
+    x = rng.normal(size=(s["rows"], s["features"])).astype(np.float32)
+    w_true = rng.normal(size=(s["features"], s["classes"]))
+    logits = x @ w_true + 0.5 * rng.normal(size=(s["rows"],
+                                                 s["classes"]))
+    y = np.argmax(logits, axis=1)
+    return x, y
+
+
+def softmax_loss_grad(w_flat: np.ndarray, x: np.ndarray,
+                      y: np.ndarray):
+    """Mean cross-entropy + gradient for W = w_flat.reshape(D, C)."""
+    s = SIZES
+    w = w_flat.reshape(s["features"], s["classes"]).astype(np.float64)
+    z = x @ w
+    z -= z.max(axis=1, keepdims=True)
+    p = np.exp(z)
+    p /= p.sum(axis=1, keepdims=True)
+    n = len(y)
+    loss = float(-np.log(np.maximum(p[np.arange(n), y], 1e-12)).mean())
+    p[np.arange(n), y] -= 1.0
+    grad = (x.T @ p) / n
+    return loss, grad.astype(np.float32).reshape(-1)
+
+
+# -- worker process --------------------------------------------------------
+
+def run_worker(address: str, lane: str, rank: int, workers: int,
+               quant: Optional[str]) -> None:
+    """One jax-free worker: fetch W, grad on this rank's data shard,
+    push the scaled delta pipelined. Prints a JSON result line."""
+    transport = _load_transport()
+    assert "jax" not in sys.modules, \
+        "worker process imported jax — the jax-free contract is broken"
+    # workers honor MVTPU_CHAOS like any process (wire storm tests)
+    transport._chaos.chaos_from_env()
+
+    x, y = make_dataset()
+    shard = slice(rank, None, workers)
+    xs, ys = x[shard], y[shard]
+    s = SIZES
+
+    client = transport.connect(address, client=f"{lane}-w{rank}",
+                               quant=quant, seed=1234 + rank)
+    table = client.create_array(f"w_{lane}",
+                                s["features"] * s["classes"],
+                                updater="default")
+    lat_ms: List[float] = []
+    for _ in range(s["steps"]):
+        t0 = time.perf_counter()
+        w_flat = table.get()
+        _, grad = softmax_loss_grad(w_flat, xs, ys)
+        table.add(-LR * grad)
+        lat_ms.append((time.perf_counter() - t0) * 1e3)
+    client.drain()
+    loss, _ = softmax_loss_grad(table.get(), xs, ys)
+    out = {"rank": rank, "lane": lane, "steps": s["steps"],
+           "tx_bytes": client.tx_bytes, "rx_bytes": client.rx_bytes,
+           "reconnects": client.reconnects, "shard_loss": loss,
+           "lat_ms": [round(v, 4) for v in lat_ms]}
+    client.close()
+    print(json.dumps(out), flush=True)
+
+
+# -- parent orchestration --------------------------------------------------
+
+def _start_server(tmpdir: str) -> tuple:
+    ready = os.path.join(tmpdir, "ready")
+    addr = "unix:" + os.path.join(tmpdir, "mvtpu.sock")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "multiverso_tpu.server",
+         "--address", addr, "--ready-file", ready, "--name", "mp"],
+        env=env, cwd=REPO)
+    deadline = time.monotonic() + STARTUP_S
+    while not os.path.exists(ready):
+        if proc.poll() is not None:
+            raise SystemExit("serving_mp: server process died during "
+                             f"startup (rc={proc.returncode})")
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise SystemExit("serving_mp: server not ready within "
+                             f"{STARTUP_S}s")
+        time.sleep(0.05)
+    with open(ready) as f:
+        return proc, f.read().strip()
+
+
+def _run_lane(address: str, lane: str,
+              quant: Optional[str]) -> Dict[str, object]:
+    t0 = time.perf_counter()
+    procs = []
+    for rank in range(N_WORKERS):
+        cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+               "--address", address, "--lane", lane,
+               "--rank", str(rank), "--workers", str(N_WORKERS)]
+        if quant:
+            cmd += ["--quant", quant]
+        procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                      text=True))
+    results = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=LANE_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise SystemExit(f"serving_mp: lane {lane!r} worker hung")
+        if p.returncode != 0:
+            raise SystemExit(f"serving_mp: lane {lane!r} worker failed "
+                             f"(rc={p.returncode})")
+        results.append(json.loads(out.strip().splitlines()[-1]))
+    wall_s = time.perf_counter() - t0
+    return {"lane": lane, "wall_s": wall_s, "workers": results,
+            "tx_bytes": sum(r["tx_bytes"] for r in results),
+            "rx_bytes": sum(r["rx_bytes"] for r in results),
+            "reconnects": sum(r["reconnects"] for r in results),
+            "lat_ms": [v for r in results for v in r["lat_ms"]]}
+
+
+def main() -> None:
+    x, y = make_dataset()
+    transport = _load_transport()
+    with tempfile.TemporaryDirectory(prefix="mvtpu_mp_") as tmpdir:
+        server, address = _start_server(tmpdir)
+        try:
+            lanes = [_run_lane(address, "dense", None),
+                     _run_lane(address, "quant", "1bit")]
+            # final params come off the SERVER (whatever the workers'
+            # views were, this is what training produced)
+            scorer = transport.connect(address, client="scorer",
+                                       quant=None)
+            finals = {}
+            for lane in lanes:
+                t = scorer.create_array(
+                    f"w_{lane['lane']}",
+                    SIZES["features"] * SIZES["classes"],
+                    updater="default")
+                finals[lane["lane"]] = t.get()
+            scorer.shutdown_server()
+            scorer.close()
+        finally:
+            if server.poll() is None:
+                server.terminate()
+                try:
+                    server.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    server.kill()
+
+    dense, quant = lanes
+    loss0, _ = softmax_loss_grad(
+        np.zeros(SIZES["features"] * SIZES["classes"], np.float32),
+        x, y)
+    dense_loss, _ = softmax_loss_grad(finals["dense"], x, y)
+    quant_loss, _ = softmax_loss_grad(finals["quant"], x, y)
+
+    # -- the acceptance gates ---------------------------------------------
+    assert dense_loss < 0.8 * loss0, \
+        f"dense lane did not converge: {dense_loss:.4f} vs init " \
+        f"{loss0:.4f}"
+    assert quant_loss <= dense_loss * LOSS_TOL + 1e-9, \
+        f"quant lane lost accuracy: {quant_loss:.4f} vs dense " \
+        f"{dense_loss:.4f} (tol x{LOSS_TOL})"
+    rel = float(np.linalg.norm(finals["quant"] - finals["dense"])
+                / max(np.linalg.norm(finals["dense"]), 1e-12))
+    assert rel <= PARAM_TOL, \
+        f"error feedback drifted: rel-L2(quant, dense) = {rel:.3f} " \
+        f"> {PARAM_TOL}"
+    ratio = dense["tx_bytes"] / max(quant["tx_bytes"], 1)
+    assert ratio >= MIN_BYTES_RATIO, \
+        f"quantized lane only saved {ratio:.2f}x bytes-on-wire " \
+        f"(need >= {MIN_BYTES_RATIO}x)"
+
+    all_lat = np.asarray(dense["lat_ms"] + quant["lat_ms"])
+    total_bytes = sum(l["tx_bytes"] + l["rx_bytes"] for l in lanes)
+    total_wall = sum(l["wall_s"] for l in lanes)
+    mb_per_s = total_bytes / (1024 * 1024) / max(total_wall, 1e-9)
+
+    line = {
+        "metric": "wire_mb_per_sec",
+        "value": round(mb_per_s, 3),
+        "unit": "MiB/s",
+        "tiny": TINY,
+        "wire_mb_per_sec": round(mb_per_s, 3),
+        "serving_mp_p99_ms": round(
+            float(np.percentile(all_lat, 99)), 3),
+        "serving_mp_p50_ms": round(
+            float(np.percentile(all_lat, 50)), 3),
+        "serving_mp_workers": N_WORKERS,
+        "serving_mp_steps": SIZES["steps"],
+        "wire_bytes_ratio": round(ratio, 2),
+        "wire_dense_tx_mb": round(dense["tx_bytes"] / 2**20, 4),
+        "wire_quant_tx_mb": round(quant["tx_bytes"] / 2**20, 4),
+        "wire_reconnects": dense["reconnects"] + quant["reconnects"],
+        "loss_init": round(loss0, 4),
+        "loss_dense": round(dense_loss, 4),
+        "loss_quant": round(quant_loss, 4),
+        "param_rel_l2": round(rel, 4),
+    }
+    out = os.environ.get("MVTPU_SERVING_MP_BENCH_JSON",
+                         "serving_mp_bench.json")
+    with open(out, "w") as f:
+        json.dump(line, f, indent=1)
+    print(json.dumps(line), flush=True)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--worker", action="store_true")
+    parser.add_argument("--address")
+    parser.add_argument("--lane", default="dense")
+    parser.add_argument("--rank", type=int, default=0)
+    parser.add_argument("--workers", type=int, default=N_WORKERS)
+    parser.add_argument("--quant", default=None)
+    args = parser.parse_args()
+    if args.worker:
+        run_worker(args.address, args.lane, args.rank, args.workers,
+                   args.quant)
+    else:
+        main()

@@ -20,6 +20,16 @@ Telemetry: ``client.coalesce.{flushes,deltas,bytes}``,
 inflight}`` — and the per-dispatch proof lives in
 ``profile.calls{fn=table.apply.*/kv.apply.*}`` (every table kernel is a
 ``profiled_jit``).
+
+The multi-PROCESS worker path lives in :mod:`.transport`
+(``WireClient``, ``RemoteArrayTable``, ``RemoteKVTable``): the same
+table surface over a socket to a
+:class:`~multiverso_tpu.server.table_server.TableServer` process, with
+the CoalescingBuffer working over remote tables unchanged. It is
+re-exported lazily (PEP 562): transport is file-path loadable by
+jax-free workers, and importing it here eagerly would be harmless —
+but keeping it lazy preserves the invariant that only code that talks
+to a wire loads the wire.
 """
 
 from __future__ import annotations
@@ -30,6 +40,24 @@ from typing import Any, Optional
 from multiverso_tpu.client.cache import CachedView
 from multiverso_tpu.client.coalesce import CoalescingBuffer, PendingHandle
 from multiverso_tpu.client.staging import KVStagingWriter, stage_kv_adds
+
+_TRANSPORT_NAMES = ("WireClient", "RemoteArrayTable", "RemoteKVTable",
+                    "RemoteHandle", "DeltaBatcher", "RemoteError",
+                    "connect", "wire_retry_policy")
+
+
+def __getattr__(name: str):
+    if name in _TRANSPORT_NAMES or name == "transport":
+        # import_module, NOT `from ... import transport`: the from-
+        # import resolves the submodule via getattr on this package,
+        # which lands back here before sys.modules is populated
+        import importlib
+        transport = importlib.import_module(
+            "multiverso_tpu.client.transport")
+        return transport if name == "transport" \
+            else getattr(transport, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
 
 COALESCE_ENV = "MVTPU_COALESCE"
 STALENESS_ENV = "MVTPU_STALENESS"
@@ -77,5 +105,5 @@ __all__ = [
     "CachedView", "CoalescingBuffer", "KVStagingWriter", "PendingHandle",
     "COALESCE_ENV", "STALENESS_ENV", "coalesce_from_env",
     "maybe_cached_view", "maybe_coalescing", "staleness_from_env",
-    "stage_kv_adds",
+    "stage_kv_adds", *_TRANSPORT_NAMES,
 ]

@@ -1,0 +1,626 @@
+"""Client transport: worker-process side of the parameter-server wire.
+
+The reference's ``WorkerTable`` proxies (`src/worker.cpp`: Get/Add
+become ZeroMQ messages to the server processes) for this port:
+:class:`WireClient` dials a :class:`~multiverso_tpu.server
+.table_server.TableServer`, and :class:`RemoteArrayTable` /
+:class:`RemoteKVTable` present the local ``Table`` surface
+(``get``/``add``/handles, CoalescingBuffer-compatible) over it.
+
+Perf shape of the hot path:
+
+- **Pipelined adds**: ``add(...)`` returns a :class:`RemoteHandle`
+  immediately; up to :data:`MAX_PIPELINE` adds ride the wire unacked.
+  ``Handle.wait()`` / any sync op drains the ack backlog first (server
+  replies are in request order per connection).
+- **Client-side coalescing**: :class:`DeltaBatcher` sums K local
+  deltas into one wire frame (the jax-free twin of
+  ``client/coalesce.py``'s CoalescingBuffer — which also works over
+  these remote tables unchanged, via the same duck-typed surface).
+- **Quantized delta frames** (``MVTPU_WIRE_QUANT=1bit|int8``): deltas
+  are quantized ONCE at submit time — the pending entry keeps the
+  quantized arrays, so a post-reconnect resend ships the identical
+  bytes (re-quantizing would double-count the error-feedback
+  residual). Residuals live in a per-client
+  :class:`~multiverso_tpu.server.wire.ResidualStore`, keyed per
+  (table, kind, geometry).
+
+Delivery semantics: **at-least-once resend, exactly-once effect**. On
+any connection failure (server restart, chaos ``drop``/``torn`` storm)
+the client redials under a jittered
+:class:`~multiverso_tpu.ft.retry.RetryPolicy` and resends every
+unacked mutation; the server dedups by (client id, request id).
+:class:`~multiverso_tpu.ft.chaos.ChaosCrash` is a BaseException and is
+NEVER retried — a simulated process kill stays a kill.
+
+Like :mod:`multiverso_tpu.server.wire`, this module is file-path
+loadable with no package import: worker processes stay jax-free.
+Use :func:`load_transport` from a bare script::
+
+    transport = load_transport("/path/to/multiverso_tpu")
+    client = transport.connect("unix:/tmp/mvtpu.sock", client="w0")
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import sys
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _dep(modname: str, *relpath: str):
+    mod = sys.modules.get(modname)
+    if mod is not None:
+        return mod
+    if "multiverso_tpu" in sys.modules:
+        import importlib
+        return importlib.import_module(modname)
+    import importlib.util
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, *relpath)
+    spec = importlib.util.spec_from_file_location(modname, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[modname] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        sys.modules.pop(modname, None)
+        raise
+    return mod
+
+
+wire = _dep("multiverso_tpu.server.wire", "server", "wire.py")
+wiresock = _dep("multiverso_tpu.io.wiresock", "io", "wiresock.py")
+_chaos = _dep("multiverso_tpu.ft.chaos", "ft", "chaos.py")
+_retry = _dep("multiverso_tpu.ft.retry", "ft", "retry.py")
+
+
+def load_transport(package_dir: str):
+    """File-path load this module (canonical name, no package import)
+    from a bare worker script. ``package_dir`` is the
+    ``multiverso_tpu`` directory."""
+    modname = "multiverso_tpu.client.transport"
+    mod = sys.modules.get(modname)
+    if mod is not None:
+        return mod
+    import importlib.util
+    path = os.path.join(package_dir, "client", "transport.py")
+    spec = importlib.util.spec_from_file_location(modname, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[modname] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+#: max adds on the wire unacked; MUST stay below the server's dedup
+#: cache depth (256) or a resend could outrun the replay window
+MAX_PIPELINE = 64
+
+_OPTION_FIELDS = ("learning_rate", "momentum", "rho", "lam")
+
+
+class RemoteError(RuntimeError):
+    """The server replied ``{ok: false}`` — a real application error
+    (bad table, shape mismatch), not a transport fault; never retried."""
+
+
+def _option_dict(option: Any) -> Optional[Dict[str, float]]:
+    """AddOption instance or plain dict → wire dict (jax-free: the
+    transport never imports the updater layer)."""
+    if option is None:
+        return None
+    if isinstance(option, dict):
+        return {k: float(option[k]) for k in _OPTION_FIELDS
+                if k in option}
+    out = {}
+    for k in _OPTION_FIELDS:
+        v = getattr(option, k, None)
+        if v is not None:
+            out[k] = float(v)
+    return out
+
+
+def wire_retry_policy(name: str = "wire"):
+    """Reconnect policy: more attempts / tighter backoff than disk IO
+    (a dropped conn under a chaos storm is cheap to redial; defaults
+    overridable by the same ``MVTPU_RETRY_*`` envs)."""
+    env = os.environ.get
+    return _retry.RetryPolicy(
+        max_attempts=max(int(env("MVTPU_RETRY_ATTEMPTS", "") or 10), 1),
+        base_delay_s=float(env("MVTPU_RETRY_BASE_S", "") or 0.01),
+        max_delay_s=float(env("MVTPU_RETRY_MAX_S", "") or 0.25),
+        deadline_s=float(env("MVTPU_RETRY_DEADLINE_S", "") or 60.0),
+        name=name)
+
+
+class _Pending:
+    """One unacked mutation: header + the EXACT wire arrays (already
+    quantized), kept for post-reconnect resend."""
+
+    __slots__ = ("rid", "header", "arrays", "sent")
+
+    def __init__(self, rid: int, header: Dict[str, Any],
+                 arrays: List[np.ndarray]) -> None:
+        self.rid = rid
+        self.header = header
+        self.arrays = arrays
+        self.sent = False
+
+
+class WireClient:
+    """One connection to a table server; thread-safe via one lock
+    (workers are processes — a client is normally single-threaded).
+
+    Local ``tx_bytes`` / ``rx_bytes`` counters measure bytes-on-wire
+    without needing the telemetry registry (jax-free workers report
+    them straight from here)."""
+
+    def __init__(self, address: str, *, client: Optional[str] = None,
+                 quant: Optional[str] = "env",
+                 seed: Optional[int] = None,
+                 retry_policy=None) -> None:
+        self.address = address
+        self.client_id = client or f"pid{os.getpid()}"
+        self.quant = wire.quant_mode_from_env() if quant == "env" \
+            else quant
+        self.block = wire.wire_block()
+        self.residuals = wire.ResidualStore()
+        self._rng = np.random.default_rng(seed)
+        self._policy = retry_policy if retry_policy is not None \
+            else wire_retry_policy()
+        self._lock = threading.RLock()
+        self._sock = None
+        self._rid = 0
+        self._pending: "collections.deque[_Pending]" = collections.deque()
+        self._acked_rid = 0
+        self.tx_bytes = 0
+        self.rx_bytes = 0
+        self.reconnects = 0
+        self._closed = False
+        self._retry_loop(self._ensure_connected)
+
+    def _retry_loop(self, fn):
+        """Progress-aware reconnect retry. Like ``RetryPolicy.call``
+        but the attempt budget RESETS whenever the acked rid advances:
+        under a wire storm each reconnect drains part of the pending
+        window before dying, and steady progress must not exhaust a
+        fixed attempt count — while a genuinely dead server (no
+        progress) still fails loudly after ``max_attempts``."""
+        import time as _time
+        policy = self._policy
+        t0 = _time.monotonic()
+        attempt = 0
+        last_acked = self._acked_rid
+        while True:
+            try:
+                return fn()
+            except policy.non_retryable:
+                raise
+            except (ConnectionError, OSError) as exc:
+                self._mark_dead()
+                self._count("retry.attempts", policy=policy.name)
+                if self._acked_rid > last_acked:
+                    last_acked = self._acked_rid
+                    attempt = 0
+                attempt += 1
+                elapsed = _time.monotonic() - t0
+                if attempt >= policy.max_attempts:
+                    raise _retry.RetryError(
+                        f"wire retry: {attempt} attempts without "
+                        f"progress ({elapsed:.2f}s): {exc!r}") from exc
+                delay = policy.backoff_s(attempt)
+                if policy.deadline_s > 0 \
+                        and elapsed + delay > policy.deadline_s:
+                    raise _retry.RetryError(
+                        f"wire retry: deadline {policy.deadline_s}s "
+                        f"exceeded after {attempt} attempts: "
+                        f"{exc!r}") from exc
+                if delay > 0:
+                    _time.sleep(delay)
+
+    # -- connection management ---------------------------------------------
+
+    def _mark_dead(self) -> None:
+        if self._sock is not None:
+            wire._close_socket(self._sock)
+            self._sock = None
+            for p in self._pending:
+                p.sent = False
+
+    def _ensure_connected(self) -> None:
+        """Dial + hello + resend every unacked mutation. Runs under the
+        retry policy: any OSError here is retried with backoff."""
+        if self._sock is not None:
+            return
+        if self._closed:
+            raise RemoteError("wire client is closed")
+        sock = wiresock.connect_socket(self.address)
+        try:
+            self._rid += 1
+            hello_rid = self._rid
+            self._tx(sock, {"op": "hello", "rid": hello_rid,
+                            "client": self.client_id}, [])
+            header, _, nbytes = wire.recv_frame(sock, role="client")
+            self.rx_bytes += nbytes
+            if not header.get("ok") or header.get("rid") != hello_rid:
+                raise wire.WireProtocolError(
+                    f"bad hello reply: {header}")
+        except BaseException:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+        self._sock = sock
+        if self.reconnects or self._pending:
+            self.reconnects += 1
+            self._count("wire.reconnects")
+        # at-least-once replay of the unacked window (server dedups).
+        # SYNCHRONOUS on purpose — one frame, one ack: a storm that
+        # drops the connection mid-replay costs at most one frame of
+        # progress, where a pipelined replay of W frames would restart
+        # all W on every drop and never converge (acks shrink
+        # ``_pending``, and :meth:`_retry_loop` resets its attempt
+        # budget whenever the acked rid advances)
+        while self._pending:
+            p = self._pending[0]
+            self._tx(sock, p.header, p.arrays)
+            p.sent = True
+            header, _, nbytes = wire.recv_frame(sock, role="client")
+            self.rx_bytes += nbytes
+            self._consume_ack(header)
+
+    def _tx(self, sock, header, arrays) -> None:
+        self.tx_bytes += wire.send_frame(sock, header, arrays,
+                                         role="client")
+
+    @staticmethod
+    def _count(name: str, n: float = 1, **labels) -> None:
+        m = sys.modules.get("multiverso_tpu.telemetry.metrics")
+        if m is not None:
+            try:
+                m.counter(name, **labels).inc(n)
+            except Exception:
+                pass
+
+    # -- request plumbing --------------------------------------------------
+
+    def _next_rid(self) -> int:
+        self._rid += 1
+        return self._rid
+
+    def _recv_reply(self) -> Tuple[Dict[str, Any], List[np.ndarray]]:
+        header, arrays, nbytes = wire.recv_frame(self._sock,
+                                                 role="client")
+        self.rx_bytes += nbytes
+        return header, arrays
+
+    def _consume_ack(self, header: Dict[str, Any]) -> None:
+        """Match an in-order reply against the pending window."""
+        rid = header.get("rid")
+        if self._pending and self._pending[0].rid == rid:
+            self._pending.popleft()
+            self._acked_rid = rid
+            if not header.get("ok"):
+                raise RemoteError(
+                    f"remote add rid={rid} failed: "
+                    f"{header.get('error')}")
+
+    def _recv_until(self, rid: int
+                    ) -> Tuple[Dict[str, Any], List[np.ndarray]]:
+        while True:
+            header, arrays = self._recv_reply()
+            got = header.get("rid")
+            if got == rid:
+                # the target itself may also be a pending mutation
+                self._consume_ack(header)
+                if not header.get("ok"):
+                    raise RemoteError(f"remote op rid={rid} failed: "
+                                      f"{header.get('error')}")
+                return header, arrays
+            self._consume_ack(header)
+
+    def call(self, op: str, header: Optional[Dict[str, Any]] = None,
+             arrays: Sequence[np.ndarray] = ()
+             ) -> Tuple[Dict[str, Any], List[np.ndarray]]:
+        """Synchronous request/reply (drains pending acks on the way).
+        Reconnects + retries on transport faults; application errors
+        (:class:`RemoteError`) and protocol desync are never retried."""
+        with self._lock:
+            req = dict(header or {})
+            req["op"] = op
+            req["rid"] = self._next_rid()
+            arrays = [np.ascontiguousarray(a) for a in arrays]
+
+            def attempt():
+                try:
+                    self._ensure_connected()
+                    self._tx(self._sock, req, arrays)
+                    return self._recv_until(req["rid"])
+                except (ConnectionError, OSError):
+                    self._mark_dead()
+                    raise
+            return self._retry_loop(attempt)
+
+    def submit(self, header: Dict[str, Any],
+               arrays: Sequence[np.ndarray]) -> int:
+        """Pipelined mutation: send now, ack later. Returns the rid
+        (wait for it with :meth:`drain_to`)."""
+        with self._lock:
+            rid = self._next_rid()
+            req = dict(header)
+            req["rid"] = rid
+            p = _Pending(rid, req,
+                         [np.ascontiguousarray(a) for a in arrays])
+            self._pending.append(p)
+
+            def attempt():
+                try:
+                    self._ensure_connected()
+                    for q in self._pending:
+                        if not q.sent:
+                            self._tx(self._sock, q.header, q.arrays)
+                            q.sent = True
+                    while len(self._pending) > MAX_PIPELINE:
+                        self._consume_ack(self._recv_reply()[0])
+                    return rid
+                except (ConnectionError, OSError):
+                    self._mark_dead()
+                    raise
+            return self._retry_loop(attempt)
+
+    def drain_to(self, rid: int) -> None:
+        """Block until the ack for ``rid`` (and everything before it)
+        has arrived."""
+        with self._lock:
+            if self._acked_rid >= rid:
+                return
+
+            def attempt():
+                try:
+                    self._ensure_connected()
+                    while self._pending \
+                            and self._pending[0].rid <= rid:
+                        self._consume_ack(self._recv_reply()[0])
+                except (ConnectionError, OSError):
+                    self._mark_dead()
+                    raise
+            self._retry_loop(attempt)
+
+    def drain(self) -> None:
+        """Block until every pipelined mutation is acked."""
+        with self._lock:
+            if self._pending:
+                self.drain_to(self._pending[-1].rid)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            try:
+                self.drain()
+            finally:
+                self._closed = True
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+
+    def __enter__(self) -> "WireClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- table surface -----------------------------------------------------
+
+    def create_array(self, name: str, size: int, *,
+                     dtype: str = "float32",
+                     updater: Optional[str] = None,
+                     init_value: float = 0) -> "RemoteArrayTable":
+        spec: Dict[str, Any] = {"size": int(size), "dtype": dtype,
+                                "init_value": init_value}
+        if updater:
+            spec["updater"] = updater
+        header, _ = self.call("create", {"name": name, "kind": "array",
+                                         "spec": spec})
+        return RemoteArrayTable(self, header)
+
+    def create_kv(self, name: str, capacity: int, *, value_dim: int = 0,
+                  dtype: str = "float32", updater: Optional[str] = None,
+                  tiered: bool = False) -> "RemoteKVTable":
+        spec: Dict[str, Any] = {"capacity": int(capacity),
+                                "value_dim": int(value_dim),
+                                "dtype": dtype}
+        if updater:
+            spec["updater"] = updater
+        kind = "tiered_kv" if tiered else "kv"
+        header, _ = self.call("create", {"name": name, "kind": kind,
+                                         "spec": spec})
+        return RemoteKVTable(self, header)
+
+    def ping(self) -> bool:
+        return bool(self.call("ping")[0].get("ok"))
+
+    def server_status(self) -> Dict[str, Any]:
+        return self.call("stats")[0].get("status", {})
+
+    def shutdown_server(self) -> None:
+        """Ask the server process to drain and exit (best-effort: the
+        reply may be cut off by the exit itself)."""
+        with self._lock:
+            try:
+                self.call("shutdown")
+            except (ConnectionError, OSError, _retry.RetryError):
+                pass
+
+
+class RemoteHandle:
+    """Handle-compatible ack future for a pipelined remote add."""
+
+    def __init__(self, client: WireClient, rid: int) -> None:
+        self._client = client
+        self._rid = rid
+
+    def done(self) -> bool:
+        return self._client._acked_rid >= self._rid
+
+    def wait(self) -> None:
+        self._client.drain_to(self._rid)
+
+    def result(self) -> None:
+        return self.wait()
+
+
+class _RemoteTable:
+    """Shared surface: the duck type ``client/coalesce.py``'s
+    CoalescingBuffer needs (``table_id``/``name``/``dtype``/
+    ``num_cols``/``_attach_coalescer``/``add``)."""
+
+    def __init__(self, client: WireClient,
+                 meta: Dict[str, Any]) -> None:
+        self.client = client
+        self.table_id = int(meta["table"])
+        self.name = str(meta["name"])
+        self.kind = str(meta["kind"])
+        self.dtype = np.dtype(str(meta["dtype"]))
+        self._coalescers: List[Any] = []
+
+    def _attach_coalescer(self, buf: Any) -> None:
+        self._coalescers.append(buf)
+
+    def flush_coalesced(self) -> None:
+        for buf in self._coalescers:
+            buf.flush()
+
+    def wait(self) -> None:
+        self.client.drain()
+
+    def _quant_kind(self) -> str:
+        raise NotImplementedError
+
+    def _encode(self, delta: np.ndarray) -> tuple:
+        c = self.client
+        return wire.encode_delta(
+            np.asarray(delta, self.dtype), c.quant,
+            table=self.table_id, kind=self._quant_kind(),
+            residuals=c.residuals, rng=c._rng, block=c.block)
+
+
+class RemoteArrayTable(_RemoteTable):
+    """Dense 1-D table over the wire (local twin:
+    ``tables/array_table.py``)."""
+
+    def __init__(self, client: WireClient,
+                 meta: Dict[str, Any]) -> None:
+        super().__init__(client, meta)
+        self.size = int(meta.get("size", 0))
+        self.num_cols = 1
+
+    def get(self) -> np.ndarray:
+        _, arrays = self.client.call("get", {"table": self.table_id})
+        return np.array(arrays[0])    # copy out of the frame buffer
+
+    def add(self, delta, option=None, sync: bool = False
+            ) -> RemoteHandle:
+        quant, payload = self._encode(delta)
+        header = {"op": "add", "table": self.table_id, "quant": quant,
+                  "option": _option_dict(option)}
+        rid = self.client.submit(header, payload)
+        handle = RemoteHandle(self.client, rid)
+        if sync:
+            handle.wait()
+        return handle
+
+    add_async = add
+
+    def _quant_kind(self) -> str:
+        return "dense"
+
+
+class RemoteKVTable(_RemoteTable):
+    """Hashed KV table over the wire (local twin:
+    ``tables/kv_table.py``; ``tiered`` creates a
+    ``storage/tiered_kv.py`` table server-side)."""
+
+    def __init__(self, client: WireClient,
+                 meta: Dict[str, Any]) -> None:
+        super().__init__(client, meta)
+        self.value_dim = int(meta.get("value_dim", 0))
+        self.num_cols = max(self.value_dim, 1)
+
+    def get(self, keys) -> Tuple[np.ndarray, np.ndarray]:
+        keys = np.ascontiguousarray(np.asarray(keys, np.uint64))
+        _, arrays = self.client.call("kv_get",
+                                     {"table": self.table_id}, [keys])
+        return np.array(arrays[0]), np.array(arrays[1])
+
+    def add(self, keys, deltas, option=None, sync: bool = False
+            ) -> RemoteHandle:
+        keys = np.ascontiguousarray(np.asarray(keys, np.uint64))
+        quant, payload = self._encode(deltas)
+        header = {"op": "kv_add", "table": self.table_id,
+                  "quant": quant, "option": _option_dict(option)}
+        rid = self.client.submit(header, [keys] + payload)
+        handle = RemoteHandle(self.client, rid)
+        if sync:
+            handle.wait()
+        return handle
+
+    add_async = add
+
+    def _quant_kind(self) -> str:
+        # 1-bit EF needs stable geometry per residual; a KV batch's key
+        # set varies, so KV always quantizes with the unbiased
+        # stateless int8 path (encode_delta enforces it too)
+        return "kv"
+
+
+class DeltaBatcher:
+    """Jax-free client-side coalescer: sum K dense deltas locally,
+    ship ONE wire frame. The minimal twin of ``client/coalesce.py``
+    (which needs the package; this one runs in bare workers) — same
+    contract: buffered deltas are invisible until the flush."""
+
+    def __init__(self, table: RemoteArrayTable,
+                 max_deltas: int = 8) -> None:
+        if max_deltas < 1:
+            raise ValueError("max_deltas must be >= 1")
+        self.table = table
+        self.max_deltas = int(max_deltas)
+        self._acc: Optional[np.ndarray] = None
+        self._count = 0
+        self.flushes = 0
+
+    def add(self, delta) -> None:
+        delta = np.asarray(delta, self.table.dtype)
+        if self._acc is None:
+            self._acc = delta.copy()
+        else:
+            self._acc += delta
+        self._count += 1
+        if self._count >= self.max_deltas:
+            self.flush()
+
+    def flush(self) -> Optional[RemoteHandle]:
+        if self._acc is None:
+            return None
+        handle = self.table.add(self._acc)
+        self._acc = None
+        self._count = 0
+        self.flushes += 1
+        return handle
+
+
+def connect(address: str, *, client: Optional[str] = None,
+            quant: Optional[str] = "env",
+            seed: Optional[int] = None) -> WireClient:
+    """Dial a table server; ``quant="env"`` reads ``MVTPU_WIRE_QUANT``
+    (pass ``None``/"1bit"/"int8" to override)."""
+    return WireClient(address, client=client, quant=quant, seed=seed)

@@ -25,6 +25,13 @@ Spec grammar (semicolon-separated rules)::
     *after* the payload is on disk,
   - ``crash``   — raise :class:`ChaosCrash` (NOT an OSError: retry
     policies never swallow it — it simulates the process dying),
+  - ``drop``    — for wire points: connection drop. Raises
+    :class:`ChaosConnDrop` (a ``ConnectionError``, so transport retry
+    policies reconnect); the wire layer closes the socket first, so
+    the peer sees a real EOF/reset, not just a client-side exception.
+    At ``wire.send`` a ``torn`` rule means a TORN FRAME: the transport
+    puts PART of the encoded frame on the wire, then drops the
+    connection — the receiver must discard the partial frame,
   - ``nan``     — VALUE corruption: poison deterministic elements of
     the tensor flowing through a :func:`chaos_corrupt` point (the
     ``table.add`` delta paths) with NaN. Nothing raises — the bad
@@ -72,6 +79,14 @@ Fault points in the codebase (grep ``chaos_point(`` for ground truth):
                       additionally covered by ``io.write`` + retry
 ``storage.fill``      tiered KV: cold-tier bucket fill (ranged read,
                       CRC-verified)
+``wire.send``         one frame onto a parameter-server wire socket
+                      (`client/transport.py` + `server/table_server.py`)
+                      — ``torn`` here = a TORN FRAME: partial bytes hit
+                      the wire, then the connection drops
+``wire.recv``         one frame off a wire socket (``drop`` = the
+                      connection dies before/while the reply arrives)
+``wire.accept``       server accept loop (`server/table_server.py`) —
+                      ``drop`` closes the just-accepted connection
 ====================  =====================================================
 
 The injector is process-global and OFF unless installed: fault points
@@ -97,6 +112,13 @@ class ChaosError(OSError):
 
 class ChaosTornWrite(ChaosError):
     """Injected crash between payload write and commit rename."""
+
+
+class ChaosConnDrop(ChaosError, ConnectionError):
+    """Injected connection drop (wire points). Both a
+    :class:`ChaosError` and a ``ConnectionError``: transport retry
+    policies treat it exactly like a real peer reset — reconnect and
+    resend."""
 
 
 class ChaosCrash(BaseException):
@@ -131,7 +153,7 @@ class ChaosRule:
         return fnmatch.fnmatchcase(point, self.pattern)
 
 
-KINDS = ("error", "latency", "torn", "crash", "nan")
+KINDS = ("error", "latency", "torn", "crash", "nan", "drop")
 
 
 def parse_chaos_spec(spec: str) -> "ChaosInjector":
@@ -280,6 +302,9 @@ class ChaosInjector:
             raise ChaosTornWrite(
                 f"chaos: injected torn write at {point!r} — payload "
                 "written, commit rename suppressed")
+        if rule.kind == "drop":
+            raise ChaosConnDrop(
+                f"chaos: injected connection drop at {point!r}")
         raise ChaosCrash(f"chaos: injected crash at {point!r}")
 
     def counts(self) -> Dict[str, int]:

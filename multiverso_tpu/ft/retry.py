@@ -33,11 +33,43 @@ from __future__ import annotations
 
 import os
 import random
+import sys
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional, Tuple, Type
 
-from multiverso_tpu.telemetry import metrics as telemetry
+
+class _TelemetryShim:
+    """Metrics through ``sys.modules`` only (the ``ft/chaos.py``
+    pattern): this module is file-path loadable with ZERO package
+    imports, so jax-free wire-worker processes get the real
+    :class:`RetryPolicy` without dragging the package (and jax) in.
+    When the registry module is loaded, counters/histograms record as
+    before; when it is not, they are no-ops."""
+
+    class _Null:
+        def inc(self, n: float = 1) -> None:
+            pass
+
+        def observe(self, v: float) -> None:
+            pass
+
+    _null = _Null()
+
+    @staticmethod
+    def _mod():
+        return sys.modules.get("multiverso_tpu.telemetry.metrics")
+
+    def counter(self, name: str, **labels):
+        m = self._mod()
+        return m.counter(name, **labels) if m is not None else self._null
+
+    def histogram(self, name: str, **labels):
+        m = self._mod()
+        return m.histogram(name, **labels) if m is not None else self._null
+
+
+telemetry = _TelemetryShim()
 
 
 class RetryError(Exception):

@@ -1,0 +1,30 @@
+"""Server process: the table fleet behind a wire (PAPER.md §1).
+
+The reference framework's defining shape is worker *processes* talking
+to server *processes* over MPI/ZeroMQ. This package is that shape for
+the TPU port: :class:`TableServer` owns the table fleet (single
+dispatch thread + the existing table / tiered-storage / telemetry
+layers + statusz) and speaks the length-prefixed, batched Get/Add
+frame protocol in :mod:`multiverso_tpu.server.wire` over unix-domain
+or TCP sockets; N worker processes drive it through
+:mod:`multiverso_tpu.client.transport`.
+
+Run one as its own process::
+
+    python -m multiverso_tpu.server --address unix:/tmp/mvtpu.sock
+
+``TableServer`` is imported lazily (PEP 562): :mod:`.wire` must stay
+importable by jax-free worker processes, and pulling the table layer
+in at package import would drag jax along.
+"""
+
+from multiverso_tpu.server import wire  # noqa: F401  (jax-free codec)
+
+__all__ = ["TableServer", "wire"]
+
+
+def __getattr__(name: str):
+    if name == "TableServer":
+        from multiverso_tpu.server.table_server import TableServer
+        return TableServer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

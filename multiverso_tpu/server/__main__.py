@@ -1,0 +1,65 @@
+"""``python -m multiverso_tpu.server``: run one table-server process.
+
+The process half of the reference's ``multiverso server`` role: init
+the runtime (mesh, chaos-from-env, statusz), serve the wire address
+until SIGTERM/SIGINT, then drain.
+
+Flags:
+
+``--address unix:/path | tcp:host:port``
+    wire address to listen on (default ``unix:/tmp/mvtpu.sock``;
+    ``tcp:host:0`` picks an ephemeral port — see ``--ready-file``).
+``--name NAME``
+    server name for logs/telemetry (default ``tables``).
+``--ready-file PATH``
+    after binding, atomically write the RESOLVED dialable address here.
+    The launcher (``benchmarks/serving_mp.py``, ``make mp-smoke``)
+    polls this file instead of racing the bind — and it is how an
+    ephemeral tcp port gets back to the workers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m multiverso_tpu.server",
+        description="multiverso_tpu table-server process")
+    parser.add_argument("--address", default="unix:/tmp/mvtpu.sock")
+    parser.add_argument("--name", default="tables")
+    parser.add_argument("--ready-file", default=None)
+    args = parser.parse_args(argv)
+
+    from multiverso_tpu import core
+    from multiverso_tpu.server.table_server import TableServer
+
+    core.init()
+    server = TableServer(args.address, name=args.name)
+    bound = server.start()
+
+    if args.ready_file:
+        tmp = args.ready_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(bound)
+        os.replace(tmp, args.ready_file)
+
+    def _stop(signum, frame):
+        server.stop()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    try:
+        server.serve_forever()
+    finally:
+        server.stop()
+        core.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,457 @@
+"""TableServer: one process owning the table fleet behind a wire.
+
+The reference framework's server role (`src/server.cpp`: ZeroMQ/MPI
+recv loop → ProcessGet/ProcessAdd on the owned table shards) mapped
+onto this port: a :class:`TableServer` listens on one wire address,
+worker *processes* connect through
+:mod:`multiverso_tpu.client.transport`, and every table op funnels into
+ONE dispatch thread — the same single-dispatch-thread contract the rest
+of the repo keeps for multi-device collectives (`benchmarks/serving.py`
+has the in-process version of this exact loop).
+
+Thread topology per server::
+
+    accept thread ──► per-conn reader ──┐
+                      per-conn reader ──┼──► dispatch queue ─► ONE
+                      per-conn reader ──┘    dispatch thread (table ops)
+                                              │ replies
+                      per-conn writer ◄───────┘ (per-conn send queues)
+
+Fault containment is the design center, not an afterthought:
+
+- A connection dying (worker SIGKILL, chaos ``drop``/``torn``) kills
+  its reader/writer pair and nothing else — the dispatch thread and
+  every other connection keep going.
+- A handler error (bad table id, shape mismatch) becomes an
+  ``{ok: false, error: ...}`` reply; the dispatch thread never dies on
+  a request.
+- Mutating ops are **deduplicated** by ``(client id, request id)``: the
+  client transport resends unacked adds after a reconnect
+  (at-least-once delivery), and this table keeps replay from becoming
+  double-apply (exactly-once effect) — the property the chaos-storm
+  bit-identical test pins down.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import socket
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from multiverso_tpu import core
+from multiverso_tpu.ft import chaos as _chaos
+from multiverso_tpu.io import wiresock
+from multiverso_tpu.server import wire
+from multiverso_tpu.telemetry import metrics as telemetry
+from multiverso_tpu.updaters import AddOption
+from multiverso_tpu.utils import log
+
+#: AddOption fields a client may set over the wire (``step`` stays
+#: server-owned: each table's option advances it per applied add)
+_OPTION_FIELDS = ("learning_rate", "momentum", "rho", "lam")
+
+#: replies cached per client for dedup replay; must exceed the client
+#: transport's max pipelined-unacked window (64) with slack
+_DEDUP_CACHE = 256
+
+#: live servers in this process, for the /statusz transport section
+_SERVERS: List["TableServer"] = []
+
+
+def status_all() -> List[Dict[str, Any]]:
+    """One status row per live server (statusz hook)."""
+    return [s.status() for s in list(_SERVERS)]
+
+
+class _Conn:
+    """One client connection: socket + its writer queue + dedup state."""
+
+    _ids = iter(range(1, 1 << 62))
+    _ids_lock = threading.Lock()
+
+    def __init__(self, sock: socket.socket) -> None:
+        self.sock = sock
+        with _Conn._ids_lock:
+            self.conn_id = next(_Conn._ids)
+        self.client_id: str = f"conn{self.conn_id}"
+        self.sendq: "queue.Queue" = queue.Queue()
+        self.alive = True
+
+    def close(self) -> None:
+        self.alive = False
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class TableServer:
+    """Serve the table fleet over one wire address.
+
+    ``start()`` binds + spins the threads and returns the dialable
+    address (resolving ``tcp:host:0``'s ephemeral port); ``stop()``
+    drains everything. Usable in-process (tests run a TableServer on a
+    thread next to the pytest client) or as its own process via
+    ``python -m multiverso_tpu.server``.
+    """
+
+    def __init__(self, address: str, *, name: str = "tables") -> None:
+        self.name = name
+        self.address = address
+        self._listener: Optional[socket.socket] = None
+        self._conns: Dict[int, _Conn] = {}
+        self._conns_lock = threading.Lock()
+        self._dispatchq: "queue.Queue" = queue.Queue()
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+        self._tables: Dict[int, Any] = {}
+        self._by_name: Dict[str, int] = {}
+        self._next_table = 0
+        # (client_id) -> OrderedDict(rid -> reply) for mutation replay
+        self._dedup: Dict[str, "collections.OrderedDict"] = {}
+        self._g_conns = telemetry.gauge("wire.connections",
+                                        server=self.name)
+        self._ops = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> str:
+        core.init()     # idempotent; tables need the mesh
+        self._listener = wiresock.listen_socket(self.address)
+        self.address = wiresock.bound_address(self._listener,
+                                              self.address)
+        self._spawn(self._accept_loop, "wire-accept")
+        self._spawn(self._dispatch_loop, "wire-dispatch")
+        _SERVERS.append(self)
+        log.info("table server %r listening on %s", self.name,
+                 self.address)
+        return self.address
+
+    def _spawn(self, fn, name: str, *args) -> threading.Thread:
+        t = threading.Thread(target=fn, args=args,
+                             name=f"{name}-{self.name}", daemon=True)
+        t.start()
+        self._threads.append(t)
+        return t
+
+    def stop(self) -> None:
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        if self._listener is not None:
+            # shutdown-then-close (wire._close_socket rationale): a
+            # plain close does NOT wake a thread blocked in accept()
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._conns_lock:
+            conns = list(self._conns.values())
+        for conn in conns:
+            conn.sendq.put(None)
+            conn.close()
+        self._dispatchq.put(None)
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(timeout=5.0)
+        if self in _SERVERS:
+            _SERVERS.remove(self)
+        log.info("table server %r stopped (%d ops served)", self.name,
+                 self._ops)
+
+    def serve_forever(self) -> None:
+        """Block until :meth:`stop` (signal handlers call it)."""
+        self._stop.wait()
+
+    def status(self) -> Dict[str, Any]:
+        with self._conns_lock:
+            n_conns = len(self._conns)
+        return {"name": self.name, "address": self.address,
+                "connections": n_conns, "tables": len(self._tables),
+                "ops": self._ops,
+                "queued": self._dispatchq.qsize()}
+
+    # -- accept / read / write threads -------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                if self._stop.is_set():
+                    return
+                continue
+            try:
+                _chaos.chaos_point("wire.accept")
+            except _chaos.ChaosError as exc:
+                # injected accept fault: the worker's dial dies at the
+                # handshake and its RetryPolicy redials — the server
+                # just sheds the connection
+                log.warn("wire.accept chaos: %s", exc)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
+            if sock.family == socket.AF_INET:
+                sock.setsockopt(socket.IPPROTO_TCP,
+                                socket.TCP_NODELAY, 1)
+            conn = _Conn(sock)
+            with self._conns_lock:
+                self._conns[conn.conn_id] = conn
+                self._g_conns.set(len(self._conns))
+            self._spawn(self._read_loop, f"wire-read{conn.conn_id}",
+                        conn)
+            self._spawn(self._write_loop, f"wire-write{conn.conn_id}",
+                        conn)
+
+    def _drop_conn(self, conn: _Conn) -> None:
+        with self._conns_lock:
+            live = self._conns.pop(conn.conn_id, None)
+            self._g_conns.set(len(self._conns))
+        if live is not None:
+            conn.sendq.put(None)
+            conn.close()
+
+    def _read_loop(self, conn: _Conn) -> None:
+        """Reader: frames off this connection into the dispatch queue.
+        ANY wire failure here is this connection's problem only."""
+        while conn.alive and not self._stop.is_set():
+            try:
+                header, arrays, _ = wire.recv_frame(conn.sock,
+                                                    role="server")
+            except (ConnectionError, wire.WireProtocolError, OSError,
+                    ValueError) as exc:
+                if conn.alive and not self._stop.is_set():
+                    log.debug("conn %d reader closing: %s",
+                              conn.conn_id, exc)
+                break
+            self._dispatchq.put((conn, header, arrays))
+        self._drop_conn(conn)
+
+    def _write_loop(self, conn: _Conn) -> None:
+        while True:
+            item = conn.sendq.get()
+            if item is None:
+                return
+            header, arrays = item
+            try:
+                wire.send_frame(conn.sock, header, arrays,
+                                role="server")
+            except (ConnectionError, OSError) as exc:
+                if conn.alive and not self._stop.is_set():
+                    log.debug("conn %d writer closing: %s",
+                              conn.conn_id, exc)
+                self._drop_conn(conn)
+                return
+
+    # -- the single dispatch thread ----------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        h_dispatch = telemetry.histogram("wire.dispatch.seconds",
+                                         telemetry.LATENCY_BUCKETS,
+                                         server=self.name)
+        import time as _time
+        while True:
+            item = self._dispatchq.get()
+            if item is None:
+                return
+            conn, header, arrays = item
+            op = str(header.get("op", "?"))
+            rid = header.get("rid")
+            t0 = _time.monotonic()
+            try:
+                reply = self._execute(conn, op, header, arrays)
+            except Exception as exc:      # noqa: BLE001 — reply, don't die
+                telemetry.counter("wire.server.errors", op=op).inc()
+                log.warn("wire op %s failed: %s: %s", op,
+                            type(exc).__name__, exc)
+                reply = ({"ok": False, "rid": rid,
+                          "error": f"{type(exc).__name__}: {exc}"}, [])
+            h_dispatch.observe(_time.monotonic() - t0)
+            self._ops += 1
+            telemetry.counter("wire.requests", op=op).inc()
+            if reply is not None and conn.alive:
+                rheader, rarrays = reply
+                rheader.setdefault("rid", rid)
+                conn.sendq.put((rheader, rarrays))
+
+    def _execute(self, conn: _Conn, op: str, header: Dict[str, Any],
+                 arrays: List[np.ndarray]
+                 ) -> Optional[Tuple[Dict[str, Any], list]]:
+        if op == "hello":
+            requested = str(header.get("client") or conn.client_id)
+            conn.client_id = requested
+            self._dedup.setdefault(requested,
+                                   collections.OrderedDict())
+            return ({"ok": True, "client_id": requested,
+                     "server": self.name,
+                     "quant": wire.quant_mode_from_env()}, [])
+        if op == "ping":
+            return ({"ok": True}, [])
+        if op == "stats":
+            return ({"ok": True, "status": self.status()}, [])
+        if op == "shutdown":
+            # reply first (queued), then stop — the writer drains the
+            # queue before the socket closes under it
+            conn.sendq.put(({"ok": True, "rid": header.get("rid")}, []))
+            threading.Thread(target=self.stop, daemon=True).start()
+            return None
+
+        # mutating ops replay from the dedup cache: a resend after a
+        # reconnect must not re-apply
+        mutating = op in ("create", "add", "kv_add")
+        if mutating:
+            cached = self._dedup_get(conn.client_id, header.get("rid"))
+            if cached is not None:
+                telemetry.counter("wire.dedup.replays", op=op).inc()
+                return cached
+
+        if op == "create":
+            reply = self._op_create(header)
+        elif op == "get":
+            reply = self._op_get(header)
+        elif op == "kv_get":
+            reply = self._op_kv_get(header, arrays)
+        elif op == "add":
+            reply = self._op_add(header, arrays)
+        elif op == "kv_add":
+            reply = self._op_kv_add(header, arrays)
+        else:
+            raise ValueError(f"unknown wire op {op!r}")
+        if mutating:
+            self._dedup_put(conn.client_id, header.get("rid"), reply)
+        return reply
+
+    # -- dedup cache -------------------------------------------------------
+
+    def _dedup_get(self, client: str, rid) -> Optional[tuple]:
+        if rid is None:
+            return None
+        cache = self._dedup.setdefault(client,
+                                       collections.OrderedDict())
+        entry = cache.get(int(rid))
+        if entry is not None:
+            header, arrays = entry
+            return (dict(header), list(arrays))
+        return None
+
+    def _dedup_put(self, client: str, rid, reply: tuple) -> None:
+        if rid is None:
+            return
+        cache = self._dedup.setdefault(client,
+                                       collections.OrderedDict())
+        cache[int(rid)] = reply
+        while len(cache) > _DEDUP_CACHE:
+            cache.popitem(last=False)
+
+    # -- table ops ---------------------------------------------------------
+
+    def _table(self, header: Dict[str, Any]):
+        tid = int(header.get("table", -1))
+        table = self._tables.get(tid)
+        if table is None:
+            raise KeyError(f"no table {tid} on this server")
+        return table
+
+    def _op_create(self, header: Dict[str, Any]) -> tuple:
+        name = str(header["name"])
+        kind = str(header.get("kind", "array"))
+        spec = dict(header.get("spec") or {})
+        if name in self._by_name:
+            # idempotent by name: N workers all issue the same creates
+            # at startup; first one builds, the rest attach
+            tid = self._by_name[name]
+            table = self._tables[tid]
+        else:
+            table = self._build_table(name, kind, spec)
+            tid = self._next_table
+            self._next_table += 1
+            self._tables[tid] = table
+            self._by_name[name] = tid
+            log.info("server %r created table %d %r kind=%s", self.name,
+                     tid, name, kind)
+        meta = {"ok": True, "table": tid, "name": name, "kind": kind,
+                "dtype": np.dtype(table.dtype).str}
+        value_dim = getattr(table, "value_dim", None)
+        if value_dim is not None:
+            meta["value_dim"] = int(value_dim)
+        size = getattr(table, "size", None)
+        if size is not None:
+            meta["size"] = int(size)
+        return (meta, [])
+
+    def _build_table(self, name: str, kind: str, spec: Dict[str, Any]):
+        common = {"name": name}
+        for key in ("dtype", "updater"):
+            if key in spec:
+                common[key] = spec[key]
+        if kind == "array":
+            from multiverso_tpu.tables.array_table import ArrayTable
+            return ArrayTable(int(spec["size"]),
+                              init_value=spec.get("init_value", 0),
+                              **common)
+        if kind == "kv":
+            from multiverso_tpu.tables.kv_table import KVTable
+            return KVTable(int(spec["capacity"]),
+                           int(spec.get("value_dim", 0)), **common)
+        if kind == "tiered_kv":
+            from multiverso_tpu.storage.tiered_kv import TieredKVTable
+            return TieredKVTable(int(spec["capacity"]),
+                                 int(spec.get("value_dim", 0)),
+                                 **common)
+        raise ValueError(f"unknown table kind {kind!r} "
+                         "(array | kv | tiered_kv)")
+
+    @staticmethod
+    def _option(header: Dict[str, Any]) -> Optional[AddOption]:
+        raw = header.get("option")
+        if not raw:
+            return None
+        fields = {k: float(raw[k]) for k in _OPTION_FIELDS if k in raw}
+        return AddOption(**fields)
+
+    def _op_get(self, header: Dict[str, Any]) -> tuple:
+        table = self._table(header)
+        values = table.get()
+        return ({"ok": True}, [np.ascontiguousarray(values)])
+
+    def _op_kv_get(self, header: Dict[str, Any],
+                   arrays: List[np.ndarray]) -> tuple:
+        table = self._table(header)
+        keys = np.ascontiguousarray(arrays[0]).astype(np.uint64,
+                                                      copy=False)
+        values, found = table.get(keys)
+        return ({"ok": True}, [np.ascontiguousarray(values),
+                               np.ascontiguousarray(found)])
+
+    def _op_add(self, header: Dict[str, Any],
+                arrays: List[np.ndarray]) -> tuple:
+        table = self._table(header)
+        # dequant-before-apply: the table layer only ever sees floats
+        delta = wire.decode_delta(header.get("quant"), arrays)
+        handle = table.add(delta, self._option(header),
+                           sync=bool(header.get("sync")))
+        return ({"ok": True, "gen": handle.generation}, [])
+
+    def _op_kv_add(self, header: Dict[str, Any],
+                   arrays: List[np.ndarray]) -> tuple:
+        table = self._table(header)
+        keys = np.ascontiguousarray(arrays[0]).astype(np.uint64,
+                                                      copy=False)
+        delta = wire.decode_delta(header.get("quant"), arrays[1:])
+        handle = table.add(keys, delta, self._option(header),
+                           sync=bool(header.get("sync")))
+        return ({"ok": True, "gen": handle.generation}, [])

@@ -1,0 +1,440 @@
+"""Wire protocol: length-prefixed frames with zero-copy numpy payloads
+and optional quantized delta encoding.
+
+This is the codec both ends of the parameter-server wire speak —
+:class:`~multiverso_tpu.server.table_server.TableServer` on the server
+side, :mod:`multiverso_tpu.client.transport` on the worker side. It is
+the analog of the reference's ZeroMQ message layer + its
+``quantization_util.h`` delta filters, collapsed into one module.
+
+Frame layout (little-endian)::
+
+    | "MVW1" | u32 body_len | u32 header_len |  ← 12-byte prefix
+    | header JSON (header_len bytes)         |
+    | pad to 8 | payload 0 | pad to 8 | payload 1 | ...
+
+- The header is small JSON (op, request id, table id, quant metadata,
+  and the dtype/shape of every payload). Payload offsets are NOT
+  stored: both ends derive them from the same rule (each payload
+  8-byte aligned, in header order), which keeps the header free of a
+  circular offsets-change-header-length dependency.
+- Payloads are raw array bytes. **Encoding** gather-writes the header
+  and each array's buffer straight to the socket (``sendmsg`` — no
+  join copy); **decoding** reads the body into ONE buffer and returns
+  ``np.frombuffer`` views into it — zero-copy on both sides.
+
+Quantized delta frames (``MVTPU_WIRE_QUANT=1bit|int8``): a delta
+payload may ride the wire as
+
+- ``1bit`` — sign bits (packed 8/byte) + per-block pos/neg mean
+  magnitudes, with client-side error feedback: the quantization error
+  is carried in a :class:`ResidualStore` keyed per **(table, kind,
+  block geometry)** and added to the next same-geometry delta. Biased
+  per step, convergent over steps (the 1-bit-SGD trick). Dense adds
+  only: a KV batch's key set changes frame to frame, so a geometry
+  residual would be fed back to *different keys'* deltas — for KV this
+  mode silently uses int8 instead.
+- ``int8`` — stochastic rounding to int8 with a per-block scale.
+  Unbiased per element (E[dequant] = value) and stateless, so it is
+  safe for any payload, including variable-key KV batches.
+
+The server dequantizes BEFORE apply: tables always see float deltas.
+
+This module is stdlib + numpy only and file-path loadable standalone
+(the ``telemetry/watchdog.py`` convention): worker processes load the
+client transport without importing the package, so a fleet of workers
+never pays the jax import. Dependencies resolve through
+:func:`_dep` — already-loaded module, else normal import when the
+package is up, else a file-path load registered under the canonical
+module name (so chaos/retry/metrics state stays process-global either
+way).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import sys
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _dep(modname: str, *relpath: str):
+    """Resolve a sibling module without forcing the package (and jax)
+    in: sys.modules hit → that module; package already imported →
+    normal import; else file-path load registered under the canonical
+    name."""
+    mod = sys.modules.get(modname)
+    if mod is not None:
+        return mod
+    if "multiverso_tpu" in sys.modules:
+        import importlib
+        return importlib.import_module(modname)
+    import importlib.util
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, *relpath)
+    spec = importlib.util.spec_from_file_location(modname, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[modname] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        sys.modules.pop(modname, None)
+        raise
+    return mod
+
+
+_chaos = _dep("multiverso_tpu.ft.chaos", "ft", "chaos.py")
+_metrics = _dep("multiverso_tpu.telemetry.metrics", "telemetry",
+                "metrics.py")
+wiresock = _dep("multiverso_tpu.io.wiresock", "io", "wiresock.py")
+
+MAGIC = b"MVW1"
+_PREFIX = struct.Struct("<4sII")
+PREFIX_BYTES = _PREFIX.size
+_ALIGN = 8
+_PAD = b"\0" * _ALIGN
+
+QUANT_ENV = "MVTPU_WIRE_QUANT"
+BLOCK_ENV = "MVTPU_WIRE_BLOCK"
+QUANT_MODES = ("1bit", "int8")
+#: payloads smaller than this ship raw — block scales would outweigh
+#: the savings and tiny frames are latency- not bandwidth-bound
+MIN_QUANT_ELEMS = 64
+
+
+class WireProtocolError(RuntimeError):
+    """Corrupt or non-protocol bytes on the wire. Deliberately NOT an
+    OSError: a desynced stream is the same desynced stream on every
+    attempt — retry policies must reconnect, not re-read."""
+
+
+def quant_mode_from_env() -> Optional[str]:
+    """``MVTPU_WIRE_QUANT`` → "1bit" | "int8" | None (off). A typo'd
+    mode raises — silently shipping fp32 would fake the bench."""
+    raw = os.environ.get(QUANT_ENV, "").strip().lower()
+    if raw in ("", "0", "none", "off", "raw"):
+        return None
+    if raw not in QUANT_MODES:
+        raise ValueError(f"{QUANT_ENV}={raw!r}: expected one of "
+                         f"{QUANT_MODES} (or unset)")
+    return raw
+
+
+def wire_block() -> int:
+    """Quantizer block length (``MVTPU_WIRE_BLOCK``, default 512 —
+    must be a multiple of 8 for the packed sign format)."""
+    try:
+        block = int(os.environ.get(BLOCK_ENV, "") or 512)
+    except ValueError:
+        block = 512
+    return max(8, (block // 8) * 8)
+
+
+# -- frame codec -----------------------------------------------------------
+
+def encode_frame(header: Dict[str, Any],
+                 arrays: Sequence[np.ndarray] = ()
+                 ) -> Tuple[List[Any], int]:
+    """Encode one frame → (buffer list for a gather-write, total
+    bytes). The buffer list references each array's memory directly —
+    no join copy; callers must not mutate the arrays until sent."""
+    header = dict(header)
+    arrs = [np.ascontiguousarray(a) for a in arrays]
+    header["arrays"] = [{"dtype": a.dtype.str, "shape": list(a.shape)}
+                        for a in arrs]
+    hbytes = json.dumps(header, separators=(",", ":")).encode()
+    bufs: List[Any] = [None, hbytes]        # prefix patched below
+    off = len(hbytes)
+    for a in arrs:
+        pad = (-off) % _ALIGN
+        if pad:
+            bufs.append(_PAD[:pad])
+        bufs.append(memoryview(a).cast("B"))
+        off += pad + a.nbytes
+    if off > wiresock.MAX_FRAME_BYTES:
+        raise WireProtocolError(f"frame body {off} bytes exceeds "
+                                f"MAX_FRAME_BYTES")
+    bufs[0] = _PREFIX.pack(MAGIC, off, len(hbytes))
+    return bufs, PREFIX_BYTES + off
+
+
+def decode_frame_body(body: bytearray, header_len: int
+                      ) -> Tuple[Dict[str, Any], List[np.ndarray]]:
+    """Parse a received frame body; the returned arrays are ZERO-COPY
+    ``np.frombuffer`` views into ``body``."""
+    try:
+        header = json.loads(bytes(memoryview(body)[:header_len]))
+    except (ValueError, UnicodeDecodeError) as exc:
+        raise WireProtocolError(f"undecodable frame header: {exc}") \
+            from exc
+    arrays: List[np.ndarray] = []
+    off = header_len
+    for spec in header.get("arrays", ()):
+        off += (-off) % _ALIGN
+        dt = np.dtype(str(spec["dtype"]))
+        shape = tuple(int(s) for s in spec["shape"])
+        count = int(np.prod(shape)) if shape else 1
+        end = off + count * dt.itemsize
+        if end > len(body):
+            raise WireProtocolError(
+                f"frame payload overruns body ({end} > {len(body)})")
+        arrays.append(np.frombuffer(body, dtype=dt, count=count,
+                                    offset=off).reshape(shape))
+        off = end
+    return header, arrays
+
+
+def _count(name: str, n: float = 1, **labels) -> None:
+    try:
+        _metrics.counter(name, **labels).inc(n)
+    except Exception:
+        pass
+
+
+def send_frame(sock, header: Dict[str, Any],
+               arrays: Sequence[np.ndarray] = (), *,
+               role: str = "client") -> int:
+    """Encode + gather-write one frame. Returns bytes put on the wire.
+    Chaos point ``wire.send``: ``torn`` puts HALF the frame on the
+    wire then drops the connection (the receiver sees a torn frame);
+    ``drop`` closes before anything is sent."""
+    bufs, nbytes = encode_frame(header, arrays)
+    try:
+        _chaos.chaos_point("wire.send")
+    except _chaos.ChaosTornWrite as exc:
+        flat = b"".join(bytes(b) for b in bufs)
+        try:
+            sock.sendall(flat[:max(1, len(flat) // 2)])
+        except OSError:
+            pass
+        _close_socket(sock)
+        raise ConnectionError(f"wire: torn frame ({exc})") from exc
+    except _chaos.ChaosConnDrop:
+        _close_socket(sock)
+        raise
+    wiresock.send_buffers(sock, bufs)
+    _count("wire.tx.bytes", nbytes, role=role)
+    _count("wire.tx.frames", role=role)
+    return nbytes
+
+
+def recv_frame(sock, *, role: str = "client"
+               ) -> Tuple[Dict[str, Any], List[np.ndarray], int]:
+    """Read one frame → (header, zero-copy arrays, bytes read).
+    Raises ``ConnectionError`` on EOF / peer death mid-frame,
+    :class:`WireProtocolError` on non-protocol bytes."""
+    try:
+        _chaos.chaos_point("wire.recv")
+    except (_chaos.ChaosConnDrop, _chaos.ChaosTornWrite) as exc:
+        _close_socket(sock)
+        if isinstance(exc, _chaos.ChaosConnDrop):
+            raise
+        raise ConnectionError(f"wire: torn read ({exc})") from exc
+    prefix = wiresock.recv_exact(sock, PREFIX_BYTES)
+    magic, body_len, header_len = _PREFIX.unpack(bytes(prefix))
+    if magic != MAGIC:
+        raise WireProtocolError(f"bad frame magic {magic!r}")
+    if body_len > wiresock.MAX_FRAME_BYTES or header_len > body_len:
+        raise WireProtocolError(
+            f"implausible frame lengths body={body_len} "
+            f"header={header_len}")
+    body = bytearray(body_len)
+    wiresock.recv_exact_into(sock, memoryview(body))
+    header, arrays = decode_frame_body(body, header_len)
+    nbytes = PREFIX_BYTES + body_len
+    _count("wire.rx.bytes", nbytes, role=role)
+    _count("wire.rx.frames", role=role)
+    return header, arrays, nbytes
+
+
+def _close_socket(sock) -> None:
+    """Shutdown-then-close. The shutdown matters: plain ``close()`` on
+    an fd another thread is blocked in ``recv`` on does NOT wake that
+    thread — the kernel socket stays referenced by the blocked syscall,
+    so the peer never sees EOF and both ends hang. ``shutdown`` tears
+    the connection down immediately for everyone."""
+    try:
+        sock.shutdown(2)            # SHUT_RDWR
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+# -- numpy delta quantizers (jax twins live in utils/quantization.py) ------
+
+def _block_view_np(x: np.ndarray, block: int) -> Tuple[np.ndarray, int]:
+    """Flatten + zero-pad to whole blocks → ([n_blocks, block], n)."""
+    flat = np.asarray(x, np.float32).reshape(-1)
+    n = flat.size
+    pad = (-n) % block
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+    return flat.reshape(-1, block), n
+
+
+def one_bit_quantize_np(delta: np.ndarray,
+                        residual: Optional[np.ndarray] = None,
+                        block: int = 512):
+    """1-bit quantization with error feedback — numpy twin of
+    :class:`multiverso_tpu.utils.quantization.OneBitQuantizer` (bit-
+    level parity asserted in tests). Returns (packed signs uint8
+    [n_blocks, block//8] LSB-first, pos/neg scales f32 [n_blocks],
+    new_residual shaped like ``delta``)."""
+    delta = np.asarray(delta, np.float32)
+    if residual is not None:
+        delta = delta + residual
+    blocks, n = _block_view_np(delta, block)
+    valid = np.arange(blocks.size).reshape(blocks.shape) < n
+    sign = blocks >= 0
+    pos = sign & valid
+    neg = (~sign) & valid
+    pos_scale = (np.where(pos, blocks, 0.0).sum(axis=1)
+                 / np.maximum(pos.sum(axis=1), 1)).astype(np.float32)
+    neg_scale = (np.where(neg, -blocks, 0.0).sum(axis=1)
+                 / np.maximum(neg.sum(axis=1), 1)).astype(np.float32)
+    deq = np.where(sign, pos_scale[:, None], -neg_scale[:, None])
+    new_residual = (blocks - deq).reshape(-1)[:n] \
+        .reshape(delta.shape).astype(np.float32)
+    packed = np.packbits(sign, axis=1, bitorder="little")
+    return packed, pos_scale, neg_scale, new_residual
+
+
+def one_bit_dequantize_np(packed: np.ndarray, pos_scale: np.ndarray,
+                          neg_scale: np.ndarray, shape: Tuple[int, ...],
+                          block: int = 512) -> np.ndarray:
+    sign = np.unpackbits(packed, axis=1, count=block,
+                         bitorder="little").astype(bool)
+    deq = np.where(sign, pos_scale[:, None],
+                   -neg_scale[:, None]).astype(np.float32)
+    n = int(np.prod(shape)) if shape else 1
+    return deq.reshape(-1)[:n].reshape(shape)
+
+
+def rounding_quantize_np(delta: np.ndarray, rng: np.random.Generator,
+                         bits: int = 8, block: int = 512):
+    """Unbiased stochastic rounding — numpy twin of
+    :class:`multiverso_tpu.utils.quantization.RoundingQuantizer`.
+    Returns (q int8/int16 [n_blocks, block], scales f32)."""
+    qmax = (1 << (bits - 1)) - 1
+    blocks, _ = _block_view_np(delta, block)
+    scale = np.maximum(np.abs(blocks).max(axis=1) / qmax,
+                       1e-30).astype(np.float32)
+    scaled = blocks / scale[:, None]
+    low = np.floor(scaled)
+    up = rng.random(scaled.shape) < (scaled - low)
+    q = np.clip(low + up, -qmax, qmax)
+    return q.astype(np.int8 if bits <= 8 else np.int16), scale
+
+
+def rounding_dequantize_np(q: np.ndarray, scale: np.ndarray,
+                           shape: Tuple[int, ...]) -> np.ndarray:
+    deq = q.astype(np.float32) * scale[:, None]
+    n = int(np.prod(shape)) if shape else 1
+    return deq.reshape(-1)[:n].reshape(shape)
+
+
+class ResidualStore:
+    """Error-feedback residual state keyed per **(table, kind, block
+    geometry)**.
+
+    The naive EF pattern — one ``residual`` variable threaded through
+    successive ``quantize`` calls — silently cross-contaminates the
+    moment a client interleaves tables or batch shapes: table A's
+    quantization error gets added to table B's next delta (or to a
+    differently-shaped batch, where it is outright shape-invalid).
+    This store makes the keying explicit: a residual is taken and
+    replaced under ``(table_id, kind, delta shape, block)``, so only
+    the *next same-geometry delta to the same table* ever sees it.
+    Thread-safe.
+    """
+
+    def __init__(self) -> None:
+        self._store: Dict[tuple, np.ndarray] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _key(table: int, kind: str, shape, block: int) -> tuple:
+        return (int(table), str(kind),
+                tuple(int(s) for s in shape), int(block))
+
+    def take(self, table: int, kind: str, shape,
+             block: int) -> Optional[np.ndarray]:
+        """Pop the residual for this geometry (None on first use)."""
+        with self._lock:
+            return self._store.pop(self._key(table, kind, shape, block),
+                                   None)
+
+    def put(self, table: int, kind: str, shape, block: int,
+            residual: np.ndarray) -> None:
+        with self._lock:
+            self._store[self._key(table, kind, shape, block)] = residual
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._store)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._store.clear()
+
+
+# -- delta payload codec ---------------------------------------------------
+
+def encode_delta(delta: np.ndarray, mode: Optional[str], *,
+                 table: int, kind: str,
+                 residuals: Optional[ResidualStore] = None,
+                 rng: Optional[np.random.Generator] = None,
+                 block: Optional[int] = None
+                 ) -> Tuple[Dict[str, Any], List[np.ndarray]]:
+    """One delta payload → (quant header metadata, wire arrays).
+
+    ``kind`` is the add kind ("dense" | "kv"): 1-bit error feedback is
+    dense-only (see module docstring) — KV batches under ``1bit`` ship
+    int8. Small / non-float payloads always ship raw."""
+    delta = np.asarray(delta)
+    if (mode not in QUANT_MODES or delta.size < MIN_QUANT_ELEMS
+            or delta.dtype.kind != "f"):
+        return {"mode": "raw"}, [delta]
+    block = int(block) if block else wire_block()
+    meta = {"mode": mode, "shape": list(delta.shape), "block": block,
+            "dtype": delta.dtype.str}
+    if mode == "1bit" and kind == "dense":
+        res = residuals.take(table, kind, delta.shape, block) \
+            if residuals is not None else None
+        packed, pos, neg, new_res = one_bit_quantize_np(delta, res,
+                                                        block)
+        if residuals is not None:
+            residuals.put(table, kind, delta.shape, block, new_res)
+        return meta, [packed, pos, neg]
+    meta["mode"] = "int8"
+    if rng is None:
+        rng = np.random.default_rng()
+    q, scale = rounding_quantize_np(delta, rng, bits=8, block=block)
+    return meta, [q, scale]
+
+
+def decode_delta(meta: Optional[Dict[str, Any]],
+                 arrays: Sequence[np.ndarray]) -> np.ndarray:
+    """Inverse of :func:`encode_delta` — dequant-before-apply on the
+    server side."""
+    mode = (meta or {}).get("mode", "raw")
+    if mode == "raw":
+        return np.asarray(arrays[0])
+    shape = tuple(int(s) for s in meta["shape"])
+    block = int(meta["block"])
+    if mode == "1bit":
+        out = one_bit_dequantize_np(arrays[0], arrays[1], arrays[2],
+                                    shape, block)
+    elif mode == "int8":
+        out = rounding_dequantize_np(arrays[0], arrays[1], shape)
+    else:
+        raise WireProtocolError(f"unknown delta encoding {mode!r}")
+    return out.astype(np.dtype(str(meta.get("dtype", "<f4"))),
+                      copy=False)

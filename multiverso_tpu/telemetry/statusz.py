@@ -150,6 +150,7 @@ def _statusz_doc() -> dict:
         },
         "health": _health_status(),
         "storage": _storage_status(),
+        "transport": _transport_status(counters, gauges),
     }
 
 
@@ -163,6 +164,28 @@ def _health_status() -> Optional[dict]:
         return health.status()
     except Exception:
         return None
+
+
+def _transport_status(counters: dict, gauges: dict) -> Optional[dict]:
+    """Parameter-server wire section: ``wire.*`` byte/frame/request
+    counters plus one row per live in-process TableServer, via
+    sys.modules like the lookups above (a process with no wire pays
+    nothing)."""
+    wire_counters = {k: v for k, v in counters.items()
+                     if k.startswith("wire.")}
+    wire_gauges = {k: v for k, v in gauges.items()
+                   if k.startswith("wire.")}
+    ts = sys.modules.get("multiverso_tpu.server.table_server")
+    servers = None
+    if ts is not None:
+        try:
+            servers = ts.status_all()
+        except Exception:
+            servers = None
+    if not wire_counters and not wire_gauges and not servers:
+        return None
+    return {"counters": wire_counters, "gauges": wire_gauges,
+            "servers": servers}
 
 
 def _storage_status() -> Optional[list]:

@@ -122,3 +122,35 @@ class RoundingQuantizer:
         deq = q.astype(jnp.float32) * scale[:, None]
         n = int(np.prod(shape))
         return deq.reshape(-1)[:n].reshape(shape)
+
+
+# -- wire-side numpy twins + error-feedback state --------------------------
+#
+# The parameter-server wire (server/wire.py) quantizes deltas in
+# jax-free worker processes, so it carries NUMPY twins of the two
+# quantizers above — bit-for-bit parity is pinned in
+# tests/test_wire.py (same packed signs, same scales, same residual).
+# Re-exported here so quantization users find one module.
+#
+# ResidualStore is also the fix for a real error-feedback hazard the
+# single-residual API above leaves to the caller: OneBitQuantizer's
+# ``residual`` is positional state, and a client interleaving TABLES or
+# BATCH SHAPES (two dense tables, or a dense table and a KV stream)
+# would feed table A's quantization error into table B's next delta —
+# silent cross-contamination (or a shape error, in the lucky case).
+# The store keys every residual by (table id, add kind, delta shape,
+# block), so error feedback only ever flows between same-geometry
+# deltas of the same table. The wire's 1-bit path refuses KV batches
+# outright (their key sets change per batch, so "same geometry" does
+# not mean "same keys") and falls back to the unbiased stateless int8
+# path — see ``server/wire.py:encode_delta``.
+
+from multiverso_tpu.server.wire import (      # noqa: E402,F401
+    ResidualStore, one_bit_dequantize_np, one_bit_quantize_np,
+    rounding_dequantize_np, rounding_quantize_np)
+
+__all__ = [
+    "OneBitQuantizer", "RoundingQuantizer", "ResidualStore",
+    "one_bit_quantize_np", "one_bit_dequantize_np",
+    "rounding_quantize_np", "rounding_dequantize_np",
+]

@@ -75,7 +75,11 @@ DEFAULT_WATCH = ("value", "e2e_words_per_sec", "lda_doc_tokens_per_sec",
                  # tiered KV storage bench (benchmarks/tiered_kv.py):
                  # get throughput under fault-in churn with the device
                  # budget a fraction of the table
-                 "tiered_kv_get_ops_per_sec")
+                 "tiered_kv_get_ops_per_sec",
+                 # multi-process wire bench (benchmarks/serving_mp.py):
+                 # bytes-on-wire throughput across worker processes —
+                 # its step tail rides DEFAULT_WATCH_LOWER below
+                 "wire_mb_per_sec")
 
 # LOWER-is-better watches: a rise past the threshold regresses
 DEFAULT_WATCH_LOWER = ("serving_p99_ms",
@@ -84,7 +88,11 @@ DEFAULT_WATCH_LOWER = ("serving_p99_ms",
                        "tiered_kv_miss_ratio",
                        # cold-start miss-storm tail (serving bench's
                        # tiered lane)
-                       "serving_tiered_p99_ms")
+                       "serving_tiered_p99_ms",
+                       # multi-process wire bench worker step tail —
+                       # a rise means the socket transport crept onto
+                       # the training step's critical path
+                       "serving_mp_p99_ms")
 
 
 def _flatten(prefix: str, obj, out: Dict[str, float]) -> None:
@@ -355,6 +363,31 @@ def selftest() -> int:
         assert main([sv_old, sv_fast, "--watch-lower",
                      "serving_p999_ms"]) == 1, \
             "explicit lower-is-better watch catches the p999 rise"
+        # multi-process wire bench lines: wire_mb_per_sec is the
+        # higher-is-better headline, serving_mp_p99_ms the
+        # lower-is-better worker step tail — both watched by default
+        mp_old = put("mp_old.json", {
+            "metric": "wire_mb_per_sec", "value": 10.0,
+            "unit": "MiB/s", "wire_mb_per_sec": 10.0,
+            "serving_mp_p50_ms": 4.0, "serving_mp_p99_ms": 12.0,
+            "wire_bytes_ratio": 9.5})
+        mp_doc = json.loads(json.dumps(json.load(open(mp_old))))
+        mp_doc["wire_mb_per_sec"] = 3.0                 # -70%
+        mp_doc["value"] = 3.0
+        mp_bad = put("mp_bad.json", mp_doc)
+        assert main([mp_old, mp_old]) == 0, "identical mp line passes"
+        assert main([mp_old, mp_bad]) == 1, \
+            "wire throughput drop must fail"
+        mp_doc2 = json.loads(json.dumps(json.load(open(mp_old))))
+        mp_doc2["serving_mp_p99_ms"] = 60.0             # 5x slower
+        mp_slow = put("mp_slow.json", mp_doc2)
+        assert main([mp_old, mp_slow]) == 1, \
+            "mp step-tail rise must fail (lower is better)"
+        mp_doc3 = json.loads(json.dumps(json.load(open(mp_old))))
+        mp_doc3["serving_mp_p99_ms"] = 6.0              # faster
+        mp_doc3["wire_bytes_ratio"] = 4.1               # unwatched drop
+        assert main([mp_old, put("mp_fast.json", mp_doc3)]) == 0, \
+            "a faster mp tail passes; bytes ratio rides along unwatched"
         # unusable inputs exit 2, not a traceback
         hung = put("hung.json", {"rc": 124, "tail": "...", "parsed": None})
         assert main([hung, raw_ok]) == 2, "no parsed line -> exit 2"
