@@ -42,9 +42,12 @@
 #                   an ephemeral statusz server + SLO rule armed, then
 #                   scrape /metrics /healthz /statusz /trace over HTTP
 #                   and assert non-null serving p50/p99/p999
-#   make mp-smoke - multi-process wire smoke: a TableServer process +
-#                   2 jax-free worker processes over a unix socket,
-#                   dense-fp32 and 1bit-quantized lanes (~15s budget;
+#   make mp-smoke - multi-process wire smoke: TableServer processes +
+#                   jax-free worker processes; dense-fp32, 1bit-quant
+#                   and shm:// ring train lanes, a fusion-on-vs-off
+#                   cross-client ops comparison (fused adds must be
+#                   bit-identical to unfused AND faster), and a paired
+#                   staleness-read RTT probe (shm ring vs tcp loopback;
 #                   asserts the quant lane ships >= 4x fewer bytes at
 #                   matched loss; emits serving_mp_bench.json)
 #   make chaos    - the chaos lane: fault-injection test subset

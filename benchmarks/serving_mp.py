@@ -6,31 +6,53 @@ processes driving a parameter-server process over a transport — where
 server subprocess (``python -m multiverso_tpu.server``) owns the
 tables; worker subprocesses are **jax-free** (they file-path-load
 ``client/transport.py`` and assert jax never imported) and train a
-softmax logistic regression against the server in two lanes:
+softmax logistic regression against the server in three lanes:
 
-- **dense** — fp32 deltas on the wire,
+- **dense** — fp32 deltas over the unix socket,
 - **quant** — ``1bit`` quantized deltas with client-side error
-  feedback (``MVTPU_WIRE_QUANT``'s headline mode).
+  feedback (``MVTPU_WIRE_QUANT``'s headline mode),
+- **shm** — fp32 deltas over the ``shm://`` shared-memory ring
+  transport (same MVW1 frames, no socket copies on the data path).
+
+Then the server **hot path** is measured head-to-head: an *ops* lane
+(pipelined dense adds, no model math) runs once against a server with
+request fusion OFF (``--fuse 1``) and once against a second server
+with fusion ON (``--fuse 16``), plus a pipelined replica-read RTT
+probe over tcp loopback vs ``shm://``.
 
 What the bench asserts (the perf claim, measured not vibed):
 
-- both lanes CONVERGE: final loss well below the initial loss, and the
-  quant lane's final loss within ``LOSS_TOL`` of the dense lane's;
+- all training lanes CONVERGE: final loss well below the initial
+  loss, and the quant lane's final loss within ``LOSS_TOL`` of the
+  dense lane's;
 - error feedback works: quant-lane final params within ``PARAM_TOL``
   relative L2 of the dense-lane params;
 - quantization moves ≥ :data:`MIN_BYTES_RATIO`× fewer add-path bytes
-  than fp32 (client→server tx compared between lanes).
+  than fp32 (client→server tx compared between lanes);
+- the shm lane really rode the ring (every worker reports
+  ``transport == "shm"``) and converged like dense;
+- fusion is a real speedup: fused ops/sec ≥ ``FUSE_RATIO``× unfused
+  (2.0 full, relaxed in TINY) while the final table is BIT-IDENTICAL
+  between the two servers (integer-grid deltas make fp32 sums exact,
+  so fused apply order cannot hide behind rounding);
+- ``shm://`` round trips beat tcp loopback.
 
 Emits (stdout JSON + ``serving_mp_bench.json``):
 
 - ``serving_mp_p99_ms`` — p99 worker step latency (get + pipelined
   add submit), the lower-is-better watch in ``tools/bench_diff.py``;
-- ``wire_mb_per_sec`` — total bytes-on-wire / lane wall time, the
-  higher-is-better watch.
+- ``wire_mb_per_sec`` — dense+quant bytes-on-wire / lane wall time,
+  the higher-is-better watch;
+- ``serving_mp_ops_per_sec`` — fused-lane add throughput (watched
+  higher-is-better), plus ``serving_mp_ops_per_sec_unfused`` and
+  ``serving_mp_fuse_ratio``;
+- ``shm_rtt_us`` — median ``shm://`` get() round trip (watched
+  lower-is-better), plus ``tcp_rtt_us`` for the loopback baseline.
 
 ``MVTPU_SERVING_MP_TINY=1`` shrinks everything to the ``make
-mp-smoke`` budget. ``MVTPU_SERVING_MP_WORKERS`` overrides the worker
-count (default 2).
+mp-smoke`` budget. ``MVTPU_SERVING_MP_WORKERS`` overrides the
+training-lane worker count (default 2);
+``MVTPU_SERVING_MP_OPS_WORKERS`` the ops-lane count (default 4).
 """
 
 from __future__ import annotations
@@ -42,7 +64,7 @@ import subprocess
 import sys
 import tempfile
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -51,18 +73,37 @@ PKG = os.path.join(REPO, "multiverso_tpu")
 
 TINY = os.environ.get("MVTPU_SERVING_MP_TINY", "") not in ("", "0")
 N_WORKERS = int(os.environ.get("MVTPU_SERVING_MP_WORKERS", "") or 2)
+OPS_WORKERS = int(os.environ.get("MVTPU_SERVING_MP_OPS_WORKERS", "")
+                 or 4)
 
 # model geometry: W is (features x classes), flattened onto one dense
 # ArrayTable — big enough that delta bytes dominate frame headers
 SIZES = ({"features": 128, "classes": 8, "rows": 256, "steps": 24}
          if TINY else
          {"features": 256, "classes": 8, "rows": 512, "steps": 48})
+# ops lane: pipelined adds with no model math — pure hot-path pressure
+OPS = ({"size": 1024, "steps": 150} if TINY
+       else {"size": 4096, "steps": 400})
 LR = 0.2
 DATA_SEED = 42
 
 LOSS_TOL = 1.10          # quant final loss ≤ dense final loss * this
 PARAM_TOL = 0.20         # rel-L2(quant W, dense W) ≤ this
 MIN_BYTES_RATIO = 4.0    # dense add-path tx ≥ this × quant tx
+# fused ops/sec ≥ this × unfused; the speedup grows with frame rate,
+# so the TINY smoke keeps a softer floor for noisy CI boxes
+FUSE_RATIO = float(os.environ.get("MVTPU_SERVING_MP_FUSE_RATIO", "")
+                   or (1.1 if TINY else 2.0))
+FUSE_K = 16
+# RTT probe: pipelined staleness reads of a 512 KiB table — big
+# replies + a drained pipeline make the TRANSPORT the variable
+# (kernel copies + flow control vs ring memcpys), not the scheduler
+# wakeups that dominate a lone small ping on a small host. tcp and
+# shm rounds are INTERLEAVED on two live connections so scheduler
+# drift on a busy box cancels out of the comparison.
+RTT_SIZE = 131072
+RTT_DEPTH = 8
+RTT_ROUNDS = 30 if TINY else 60
 STARTUP_S = 60.0
 LANE_TIMEOUT_S = 120.0
 
@@ -110,6 +151,15 @@ def softmax_loss_grad(w_flat: np.ndarray, x: np.ndarray,
     return loss, grad.astype(np.float32).reshape(-1)
 
 
+def ops_delta(rank: int) -> np.ndarray:
+    """Integer-grid delta for the ops lane: values in [1, 5+rank], so
+    every partial sum across workers*steps stays far below 2**24 and
+    fp32 addition is EXACT — fused and unfused finals must match to
+    the bit, whatever order the server applied frames in."""
+    size = OPS["size"]
+    return ((np.arange(size) % 5) + 1 + rank).astype(np.float32)
+
+
 # -- worker process --------------------------------------------------------
 
 def run_worker(address: str, lane: str, rank: int, workers: int,
@@ -144,23 +194,55 @@ def run_worker(address: str, lane: str, rank: int, workers: int,
     out = {"rank": rank, "lane": lane, "steps": s["steps"],
            "tx_bytes": client.tx_bytes, "rx_bytes": client.rx_bytes,
            "reconnects": client.reconnects, "shard_loss": loss,
+           "transport": client.transport,
            "lat_ms": [round(v, 4) for v in lat_ms]}
+    client.close()
+    print(json.dumps(out), flush=True)
+
+
+def run_ops_worker(address: str, lane: str, rank: int,
+                   workers: int) -> None:
+    """One jax-free ops worker: pipelined integer-grid dense adds, no
+    model math. The timed window is add-submit through drain — the
+    server's apply throughput is the bottleneck by construction."""
+    transport = _load_transport()
+    assert "jax" not in sys.modules, \
+        "worker process imported jax — the jax-free contract is broken"
+    transport._chaos.chaos_from_env()
+
+    client = transport.connect(address, client=f"{lane}-w{rank}",
+                               quant=None, seed=4321 + rank)
+    table = client.create_array("w_ops", OPS["size"],
+                                updater="default")
+    delta = ops_delta(rank)
+    table.get()     # warm the table + connection outside the window
+    t0 = time.perf_counter()
+    for _ in range(OPS["steps"]):
+        table.add(delta)
+    client.drain()
+    wall = time.perf_counter() - t0
+    out = {"rank": rank, "lane": lane, "adds": OPS["steps"],
+           "add_wall_s": wall, "tx_bytes": client.tx_bytes,
+           "transport": client.transport}
     client.close()
     print(json.dumps(out), flush=True)
 
 
 # -- parent orchestration --------------------------------------------------
 
-def _start_server(tmpdir: str) -> tuple:
-    ready = os.path.join(tmpdir, "ready")
-    addr = "unix:" + os.path.join(tmpdir, "mvtpu.sock")
+def _start_server(tmpdir: str, name: str, addresses: List[str],
+                  fuse: Optional[int] = None) -> tuple:
+    """Start one server subprocess; returns (proc, {scheme: bound})."""
+    ready = os.path.join(tmpdir, f"ready-{name}")
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                PYTHONPATH=REPO + os.pathsep
                + os.environ.get("PYTHONPATH", ""))
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "multiverso_tpu.server",
-         "--address", addr, "--ready-file", ready, "--name", "mp"],
-        env=env, cwd=REPO)
+    cmd = [sys.executable, "-m", "multiverso_tpu.server",
+           "--address", ",".join(addresses), "--ready-file", ready,
+           "--name", name]
+    if fuse is not None:
+        cmd += ["--fuse", str(fuse)]
+    proc = subprocess.Popen(cmd, env=env, cwd=REPO)
     deadline = time.monotonic() + STARTUP_S
     while not os.path.exists(ready):
         if proc.poll() is not None:
@@ -172,17 +254,32 @@ def _start_server(tmpdir: str) -> tuple:
                              f"{STARTUP_S}s")
         time.sleep(0.05)
     with open(ready) as f:
-        return proc, f.read().strip()
+        bound = [a.strip() for a in f.read().split(",") if a.strip()]
+    by_scheme = {}
+    for addr in bound:
+        by_scheme[addr.split(":", 1)[0]] = addr
+    return proc, by_scheme
 
 
-def _run_lane(address: str, lane: str,
-              quant: Optional[str]) -> Dict[str, object]:
+def _stop_server(proc) -> None:
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def _run_lane(address: str, lane: str, quant: Optional[str],
+              *, mode: str = "train",
+              workers: Optional[int] = None) -> Dict[str, object]:
+    n = workers if workers is not None else N_WORKERS
     t0 = time.perf_counter()
     procs = []
-    for rank in range(N_WORKERS):
+    for rank in range(n):
         cmd = [sys.executable, os.path.abspath(__file__), "--worker",
-               "--address", address, "--lane", lane,
-               "--rank", str(rank), "--workers", str(N_WORKERS)]
+               "--address", address, "--lane", lane, "--mode", mode,
+               "--rank", str(rank), "--workers", str(n)]
         if quant:
             cmd += ["--quant", quant]
         procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
@@ -200,48 +297,136 @@ def _run_lane(address: str, lane: str,
                              f"(rc={p.returncode})")
         results.append(json.loads(out.strip().splitlines()[-1]))
     wall_s = time.perf_counter() - t0
-    return {"lane": lane, "wall_s": wall_s, "workers": results,
-            "tx_bytes": sum(r["tx_bytes"] for r in results),
-            "rx_bytes": sum(r["rx_bytes"] for r in results),
-            "reconnects": sum(r["reconnects"] for r in results),
-            "lat_ms": [v for r in results for v in r["lat_ms"]]}
+    agg = {"lane": lane, "wall_s": wall_s, "workers": results,
+           "tx_bytes": sum(r["tx_bytes"] for r in results)}
+    if mode == "train":
+        agg.update(
+            rx_bytes=sum(r["rx_bytes"] for r in results),
+            reconnects=sum(r["reconnects"] for r in results),
+            lat_ms=[v for r in results for v in r["lat_ms"]])
+    else:
+        total_adds = sum(r["adds"] for r in results)
+        slowest = max(r["add_wall_s"] for r in results)
+        agg["ops_per_sec"] = total_adds / max(slowest, 1e-9)
+    return agg
+
+
+def _rtt_round(client, table_id: int, rid: List[int]) -> float:
+    """One pipelined round of ``RTT_DEPTH`` raw staleness-read frames
+    on ``client``'s channel; returns the per-request wall. Raw frames
+    keep the client's own op bookkeeping out of the measurement."""
+    chan = client._chan
+    t0 = time.perf_counter()
+    for _ in range(RTT_DEPTH):
+        chan.send({"op": "get", "table": table_id, "rid": rid[0],
+                   "staleness": 1 << 20}, [])
+        rid[0] += 1
+    # Bind the payload so the PREVIOUS reply's buffers stay alive while
+    # the next one is copied out of the transport: dropping a >mmap-
+    # threshold buffer on every recv puts glibc munmap + fresh-page
+    # faults on the critical path, which on a small host double-counts
+    # against the measured round trip.
+    h = arrays = None
+    for _ in range(RTT_DEPTH):
+        h, arrays, _ = chan.recv()
+        assert h.get("ok"), h
+    del arrays
+    dt = (time.perf_counter() - t0) / RTT_DEPTH
+    assert h.get("replica"), \
+        "rtt probe: staleness reads not replica-served"
+    return dt
+
+
+def _rtt_pair(tcp_address: str, shm_address: str
+              ) -> Tuple[float, float]:
+    """Median per-request round trip in µs over tcp loopback and the
+    shm ring, reading a ``RTT_SIZE``-float table through the
+    staleness/replica hot path (reader-thread serve, no dispatch
+    queue). Rounds alternate between the two live connections so both
+    sides see the same scheduler weather."""
+    transport = _load_transport()
+    probes = []
+    for address, tag, base in ((tcp_address, "tcp", 1 << 20),
+                               (shm_address, "shm", 1 << 21)):
+        client = transport.connect(address, client=f"rtt-{tag}",
+                                   quant=None)
+        table = client.create_array("rtt", RTT_SIZE,
+                                    updater="default")
+        for _ in range(10):
+            table.get(staleness=1 << 20)
+        rid = [base]
+        for _ in range(8):      # warm the raw path; ends replica-hot
+            _rtt_round(client, table.table_id, rid)
+        probes.append((client, table.table_id, rid))
+    tcp_s: List[float] = []
+    shm_s: List[float] = []
+    for _ in range(RTT_ROUNDS):
+        tcp_s.append(_rtt_round(*probes[0]))
+        shm_s.append(_rtt_round(*probes[1]))
+    for client, _, _ in probes:
+        client.close()
+    return (float(np.median(tcp_s) * 1e6),
+            float(np.median(shm_s) * 1e6))
 
 
 def main() -> None:
     x, y = make_dataset()
     transport = _load_transport()
     with tempfile.TemporaryDirectory(prefix="mvtpu_mp_") as tmpdir:
-        server, address = _start_server(tmpdir)
+        # server A: fusion OFF (the default), three transports
+        server_a, addrs_a = _start_server(
+            tmpdir, "mp",
+            ["unix:" + os.path.join(tmpdir, "mvtpu.sock"),
+             "tcp:127.0.0.1:0",
+             "shm://" + os.path.join(tmpdir, "mvtpu-shm.sock")])
+        # server B: identical tables, fusion ON — the hot-path claim
+        server_b, addrs_b = _start_server(
+            tmpdir, "mpf",
+            ["unix:" + os.path.join(tmpdir, "mvtpu-b.sock")],
+            fuse=FUSE_K)
         try:
-            lanes = [_run_lane(address, "dense", None),
-                     _run_lane(address, "quant", "1bit")]
-            # final params come off the SERVER (whatever the workers'
+            unix_a = addrs_a["unix"]
+            lanes = [_run_lane(unix_a, "dense", None),
+                     _run_lane(unix_a, "quant", "1bit"),
+                     _run_lane(addrs_a["shm"], "shm", None)]
+            ops_unfused = _run_lane(unix_a, "ops_unfused", None,
+                                    mode="ops", workers=OPS_WORKERS)
+            ops_fused = _run_lane(addrs_b["unix"], "ops_fused", None,
+                                  mode="ops", workers=OPS_WORKERS)
+            tcp_rtt_us, shm_rtt_us = _rtt_pair(addrs_a["tcp"],
+                                               addrs_a["shm"])
+            # final params come off the SERVERS (whatever the workers'
             # views were, this is what training produced)
-            scorer = transport.connect(address, client="scorer",
+            scorer = transport.connect(unix_a, client="scorer",
                                        quant=None)
             finals = {}
-            for lane in lanes:
+            for lane_name in ("dense", "quant", "shm"):
                 t = scorer.create_array(
-                    f"w_{lane['lane']}",
+                    f"w_{lane_name}",
                     SIZES["features"] * SIZES["classes"],
                     updater="default")
-                finals[lane["lane"]] = t.get()
+                finals[lane_name] = t.get()
+            ops_final_a = scorer.create_array(
+                "w_ops", OPS["size"], updater="default").get()
             scorer.shutdown_server()
             scorer.close()
+            scorer_b = transport.connect(addrs_b["unix"],
+                                         client="scorer-b", quant=None)
+            ops_final_b = scorer_b.create_array(
+                "w_ops", OPS["size"], updater="default").get()
+            scorer_b.shutdown_server()
+            scorer_b.close()
         finally:
-            if server.poll() is None:
-                server.terminate()
-                try:
-                    server.wait(timeout=10)
-                except subprocess.TimeoutExpired:
-                    server.kill()
+            _stop_server(server_a)
+            _stop_server(server_b)
 
-    dense, quant = lanes
+    dense, quant, shm_lane = lanes
     loss0, _ = softmax_loss_grad(
         np.zeros(SIZES["features"] * SIZES["classes"], np.float32),
         x, y)
     dense_loss, _ = softmax_loss_grad(finals["dense"], x, y)
     quant_loss, _ = softmax_loss_grad(finals["quant"], x, y)
+    shm_loss, _ = softmax_loss_grad(finals["shm"], x, y)
 
     # -- the acceptance gates ---------------------------------------------
     assert dense_loss < 0.8 * loss0, \
@@ -259,10 +444,36 @@ def main() -> None:
     assert ratio >= MIN_BYTES_RATIO, \
         f"quantized lane only saved {ratio:.2f}x bytes-on-wire " \
         f"(need >= {MIN_BYTES_RATIO}x)"
+    # shm lane: same fp32 frames as dense, so it must converge the
+    # same way — and every worker must actually have ridden the ring
+    assert shm_loss < 0.8 * loss0, \
+        f"shm lane did not converge: {shm_loss:.4f} vs init {loss0:.4f}"
+    shm_transports = [r["transport"] for r in shm_lane["workers"]]
+    assert shm_transports == ["shm"] * len(shm_transports), \
+        f"shm lane fell back to sockets: {shm_transports}"
+
+    # fusion: bit-identical result, materially faster
+    expected = np.zeros(OPS["size"], np.float32)
+    for rank in range(OPS_WORKERS):
+        expected += OPS["steps"] * ops_delta(rank)
+    assert ops_final_a.tobytes() == expected.tobytes(), \
+        "unfused ops final != exact integer-grid expectation"
+    assert ops_final_a.tobytes() == ops_final_b.tobytes(), \
+        "fused server produced a different table than unfused"
+    fuse_ratio = (ops_fused["ops_per_sec"]
+                  / max(ops_unfused["ops_per_sec"], 1e-9))
+    assert fuse_ratio >= FUSE_RATIO, \
+        f"fusion speedup {fuse_ratio:.2f}x < required {FUSE_RATIO}x " \
+        f"(fused {ops_fused['ops_per_sec']:.0f} vs unfused " \
+        f"{ops_unfused['ops_per_sec']:.0f} adds/s)"
+    assert shm_rtt_us < tcp_rtt_us, \
+        f"shm rtt {shm_rtt_us:.1f}us not better than tcp loopback " \
+        f"{tcp_rtt_us:.1f}us"
 
     all_lat = np.asarray(dense["lat_ms"] + quant["lat_ms"])
-    total_bytes = sum(l["tx_bytes"] + l["rx_bytes"] for l in lanes)
-    total_wall = sum(l["wall_s"] for l in lanes)
+    total_bytes = sum(l["tx_bytes"] + l["rx_bytes"]
+                      for l in (dense, quant))
+    total_wall = dense["wall_s"] + quant["wall_s"]
     mb_per_s = total_bytes / (1024 * 1024) / max(total_wall, 1e-9)
 
     line = {
@@ -277,6 +488,13 @@ def main() -> None:
             float(np.percentile(all_lat, 50)), 3),
         "serving_mp_workers": N_WORKERS,
         "serving_mp_steps": SIZES["steps"],
+        "serving_mp_ops_per_sec": round(ops_fused["ops_per_sec"], 1),
+        "serving_mp_ops_per_sec_unfused": round(
+            ops_unfused["ops_per_sec"], 1),
+        "serving_mp_fuse_ratio": round(fuse_ratio, 2),
+        "serving_mp_ops_workers": OPS_WORKERS,
+        "shm_rtt_us": round(shm_rtt_us, 1),
+        "tcp_rtt_us": round(tcp_rtt_us, 1),
         "wire_bytes_ratio": round(ratio, 2),
         "wire_dense_tx_mb": round(dense["tx_bytes"] / 2**20, 4),
         "wire_quant_tx_mb": round(quant["tx_bytes"] / 2**20, 4),
@@ -284,6 +502,7 @@ def main() -> None:
         "loss_init": round(loss0, 4),
         "loss_dense": round(dense_loss, 4),
         "loss_quant": round(quant_loss, 4),
+        "loss_shm": round(shm_loss, 4),
         "param_rel_l2": round(rel, 4),
     }
     out = os.environ.get("MVTPU_SERVING_MP_BENCH_JSON",
@@ -298,12 +517,18 @@ if __name__ == "__main__":
     parser.add_argument("--worker", action="store_true")
     parser.add_argument("--address")
     parser.add_argument("--lane", default="dense")
+    parser.add_argument("--mode", default="train",
+                        choices=("train", "ops"))
     parser.add_argument("--rank", type=int, default=0)
     parser.add_argument("--workers", type=int, default=N_WORKERS)
     parser.add_argument("--quant", default=None)
     args = parser.parse_args()
     if args.worker:
-        run_worker(args.address, args.lane, args.rank, args.workers,
-                   args.quant)
+        if args.mode == "ops":
+            run_ops_worker(args.address, args.lane, args.rank,
+                           args.workers)
+        else:
+            run_worker(args.address, args.lane, args.rank,
+                       args.workers, args.quant)
     else:
         main()

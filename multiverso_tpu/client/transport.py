@@ -33,6 +33,20 @@ unacked mutation; the server dedups by (client id, request id).
 :class:`~multiverso_tpu.ft.chaos.ChaosCrash` is a BaseException and is
 NEVER retried — a simulated process kill stays a kill.
 
+The client talks to a transport-agnostic **Channel**
+(:func:`multiverso_tpu.server.wire.dial_channel`): ``unix:``/``tcp:``
+addresses get socket frames, ``shm://`` addresses negotiate the
+same-host shared-memory ring pair (``io/shmring.py``) with graceful
+fallback to the socket when the server doesn't take the offer.
+Everything here — pipelining, resend, coalescing, quantization — is
+identical on either transport.
+
+Reads tolerate staleness explicitly: ``get(staleness=K)`` on a remote
+table asks the server to answer from a read replica at most K
+generations behind, off the dispatch queue entirely (reads stop paying
+for writes). ``staleness=None`` (default) keeps strict
+read-your-queue semantics through the dispatch thread.
+
 Like :mod:`multiverso_tpu.server.wire`, this module is file-path
 loadable with no package import: worker processes stay jax-free.
 Use :func:`load_transport` from a bare script::
@@ -173,7 +187,7 @@ class WireClient:
         self._policy = retry_policy if retry_policy is not None \
             else wire_retry_policy()
         self._lock = threading.RLock()
-        self._sock = None
+        self._chan = None
         self._rid = 0
         self._pending: "collections.deque[_Pending]" = collections.deque()
         self._acked_rid = 0
@@ -225,37 +239,47 @@ class WireClient:
     # -- connection management ---------------------------------------------
 
     def _mark_dead(self) -> None:
-        if self._sock is not None:
-            wire._close_socket(self._sock)
-            self._sock = None
+        if self._chan is not None:
+            try:
+                self._chan.close()
+            except OSError:
+                pass
+            self._chan = None
             for p in self._pending:
                 p.sent = False
+
+    @property
+    def transport(self) -> Optional[str]:
+        """The live channel's transport kind ("socket" | "shm"), or
+        None while disconnected."""
+        chan = self._chan
+        return chan.transport if chan is not None else None
 
     def _ensure_connected(self) -> None:
         """Dial + hello + resend every unacked mutation. Runs under the
         retry policy: any OSError here is retried with backoff."""
-        if self._sock is not None:
+        if self._chan is not None:
             return
         if self._closed:
             raise RemoteError("wire client is closed")
-        sock = wiresock.connect_socket(self.address)
+        chan = wire.dial_channel(self.address)
         try:
             self._rid += 1
             hello_rid = self._rid
-            self._tx(sock, {"op": "hello", "rid": hello_rid,
+            self._tx(chan, {"op": "hello", "rid": hello_rid,
                             "client": self.client_id}, [])
-            header, _, nbytes = wire.recv_frame(sock, role="client")
+            header, _, nbytes = chan.recv()
             self.rx_bytes += nbytes
             if not header.get("ok") or header.get("rid") != hello_rid:
                 raise wire.WireProtocolError(
                     f"bad hello reply: {header}")
         except BaseException:
             try:
-                sock.close()
+                chan.close()
             except OSError:
                 pass
             raise
-        self._sock = sock
+        self._chan = chan
         if self.reconnects or self._pending:
             self.reconnects += 1
             self._count("wire.reconnects")
@@ -268,15 +292,14 @@ class WireClient:
         # budget whenever the acked rid advances)
         while self._pending:
             p = self._pending[0]
-            self._tx(sock, p.header, p.arrays)
+            self._tx(chan, p.header, p.arrays)
             p.sent = True
-            header, _, nbytes = wire.recv_frame(sock, role="client")
+            header, _, nbytes = chan.recv()
             self.rx_bytes += nbytes
             self._consume_ack(header)
 
-    def _tx(self, sock, header, arrays) -> None:
-        self.tx_bytes += wire.send_frame(sock, header, arrays,
-                                         role="client")
+    def _tx(self, chan, header, arrays) -> None:
+        self.tx_bytes += chan.send(header, arrays)
 
     @staticmethod
     def _count(name: str, n: float = 1, **labels) -> None:
@@ -294,8 +317,7 @@ class WireClient:
         return self._rid
 
     def _recv_reply(self) -> Tuple[Dict[str, Any], List[np.ndarray]]:
-        header, arrays, nbytes = wire.recv_frame(self._sock,
-                                                 role="client")
+        header, arrays, nbytes = self._chan.recv()
         self.rx_bytes += nbytes
         return header, arrays
 
@@ -339,7 +361,7 @@ class WireClient:
             def attempt():
                 try:
                     self._ensure_connected()
-                    self._tx(self._sock, req, arrays)
+                    self._tx(self._chan, req, arrays)
                     return self._recv_until(req["rid"])
                 except (ConnectionError, OSError):
                     self._mark_dead()
@@ -363,7 +385,7 @@ class WireClient:
                     self._ensure_connected()
                     for q in self._pending:
                         if not q.sent:
-                            self._tx(self._sock, q.header, q.arrays)
+                            self._tx(self._chan, q.header, q.arrays)
                             q.sent = True
                     while len(self._pending) > MAX_PIPELINE:
                         self._consume_ack(self._recv_reply()[0])
@@ -405,12 +427,12 @@ class WireClient:
                 self.drain()
             finally:
                 self._closed = True
-                if self._sock is not None:
+                if self._chan is not None:
                     try:
-                        self._sock.close()
+                        self._chan.close()
                     except OSError:
                         pass
-                    self._sock = None
+                    self._chan = None
 
     def __enter__(self) -> "WireClient":
         return self
@@ -523,8 +545,15 @@ class RemoteArrayTable(_RemoteTable):
         self.size = int(meta.get("size", 0))
         self.num_cols = 1
 
-    def get(self) -> np.ndarray:
-        _, arrays = self.client.call("get", {"table": self.table_id})
+    def get(self, staleness: Optional[int] = None) -> np.ndarray:
+        """Whole-table fetch. ``staleness=K`` allows the server to
+        answer from its read replica when it is at most K generations
+        behind — served on the reader thread, never queued behind
+        writes."""
+        header: Dict[str, Any] = {"table": self.table_id}
+        if staleness is not None:
+            header["staleness"] = int(staleness)
+        _, arrays = self.client.call("get", header)
         return np.array(arrays[0])    # copy out of the frame buffer
 
     def add(self, delta, option=None, sync: bool = False
@@ -555,10 +584,16 @@ class RemoteKVTable(_RemoteTable):
         self.value_dim = int(meta.get("value_dim", 0))
         self.num_cols = max(self.value_dim, 1)
 
-    def get(self, keys) -> Tuple[np.ndarray, np.ndarray]:
+    def get(self, keys, staleness: Optional[int] = None
+            ) -> Tuple[np.ndarray, np.ndarray]:
+        """Batch lookup. ``staleness=K`` as on
+        :meth:`RemoteArrayTable.get` — replica-served when fresh
+        enough, at most K generations behind."""
         keys = np.ascontiguousarray(np.asarray(keys, np.uint64))
-        _, arrays = self.client.call("kv_get",
-                                     {"table": self.table_id}, [keys])
+        header: Dict[str, Any] = {"table": self.table_id}
+        if staleness is not None:
+            header["staleness"] = int(staleness)
+        _, arrays = self.client.call("kv_get", header, [keys])
         return np.array(arrays[0]), np.array(arrays[1])
 
     def add(self, keys, deltas, option=None, sync: bool = False

@@ -87,6 +87,17 @@ Fault points in the codebase (grep ``chaos_point(`` for ground truth):
                       connection dies before/while the reply arrives)
 ``wire.accept``       server accept loop (`server/table_server.py`) —
                       ``drop`` closes the just-accepted connection
+``wire.shm.ring``     one frame into a shared-memory ring
+                      (`server/wire.py` ShmChannel over `io/shmring.py`)
+                      — ``torn`` publishes HALF a ring record then
+                      closes (the peer sees a producer that died
+                      mid-copy); ``latency`` models a slow same-host
+                      hop; ``drop`` closes the doorbell socket
+``server.fuse``       one fused dispatch cycle's group execute
+                      (`server/table_server.py`) — an ``error`` here
+                      exercises the per-frame fallback: affected
+                      requests re-run individually, the dispatch
+                      thread never dies
 ====================  =====================================================
 
 The injector is process-global and OFF unless installed: fault points

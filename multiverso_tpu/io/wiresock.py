@@ -15,6 +15,11 @@ Address grammar (one string, both ends agree):
 - ``unix:/path/to.sock`` — unix-domain socket (the default transport
   for same-host worker fleets: no port allocation, filesystem perms),
 - ``tcp:host:port``      — TCP (cross-host),
+- ``shm:///path/to.sock`` (or ``shm:/path``) — shared-memory ring
+  transport (`io/shmring.py`): a unix socket at the path carries the
+  handshake + doorbell, the frames travel through mmap'd rings. At the
+  socket layer shm IS a unix listener — a plain-socket client may dial
+  the same path and both sides fall back to socket frames gracefully,
 - a bare path containing ``/`` is taken as unix, a bare ``host:port``
   as tcp.
 """
@@ -41,6 +46,13 @@ def parse_address(addr: str) -> Address:
         if not path:
             raise ValueError(f"wire address {addr!r}: empty unix path")
         return ("unix", path)
+    if addr.startswith("shm:"):
+        path = addr[4:]
+        if path.startswith("//"):       # URI form shm:///abs/path
+            path = path[2:]
+        if not path:
+            raise ValueError(f"wire address {addr!r}: empty shm path")
+        return ("shm", path)
     if addr.startswith("tcp:"):
         rest = addr[4:]
         host, sep, port = rest.rpartition(":")
@@ -60,6 +72,8 @@ def parse_address(addr: str) -> Address:
 def format_address(parsed: Address) -> str:
     if parsed[0] == "unix":
         return f"unix:{parsed[1]}"
+    if parsed[0] == "shm":
+        return f"shm://{parsed[1]}"
     return f"tcp:{parsed[1]}:{parsed[2]}"
 
 
@@ -68,7 +82,7 @@ def listen_socket(addr: str, backlog: int = 64) -> socket.socket:
     file from a dead server is unlinked first (the pidfile-less
     convention: the bind is the lock)."""
     parsed = parse_address(addr)
-    if parsed[0] == "unix":
+    if parsed[0] in ("unix", "shm"):
         path = parsed[1]
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         try:
@@ -107,7 +121,7 @@ def bound_address(sock: socket.socket, addr: str) -> str:
     """The address clients should dial — resolves ``tcp:host:0``'s
     ephemeral port from the bound socket."""
     parsed = parse_address(addr)
-    if parsed[0] == "unix":
+    if parsed[0] in ("unix", "shm"):
         return format_address(parsed)
     host, port = sock.getsockname()[:2]
     return format_address(("tcp", parsed[1], port))
@@ -132,7 +146,7 @@ def connect_socket(addr: str, timeout: float = 10.0) -> socket.socket:
     timeout armed (``socket.timeout`` is an OSError — retry policies
     treat a stuck reply like any transport fault)."""
     parsed = parse_address(addr)
-    if parsed[0] == "unix":
+    if parsed[0] in ("unix", "shm"):
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         target = parsed[1]
     else:

@@ -6,16 +6,23 @@ until SIGTERM/SIGINT, then drain.
 
 Flags:
 
-``--address unix:/path | tcp:host:port``
-    wire address to listen on (default ``unix:/tmp/mvtpu.sock``;
-    ``tcp:host:0`` picks an ephemeral port — see ``--ready-file``).
+``--address unix:/path | tcp:host:port | shm:///path [, ...]``
+    wire address(es) to listen on, comma-separated (default
+    ``unix:/tmp/mvtpu.sock``; ``tcp:host:0`` picks an ephemeral port —
+    see ``--ready-file``; ``shm://`` serves the shared-memory ring
+    transport, falling back to socket frames per connection for
+    clients that dial it as plain unix).
 ``--name NAME``
     server name for logs/telemetry (default ``tables``).
+``--fuse K``
+    drain + fuse up to K queued frames per dispatch cycle (default:
+    ``MVTPU_SERVER_FUSE`` env, else 1 = off).
 ``--ready-file PATH``
-    after binding, atomically write the RESOLVED dialable address here.
-    The launcher (``benchmarks/serving_mp.py``, ``make mp-smoke``)
-    polls this file instead of racing the bind — and it is how an
-    ephemeral tcp port gets back to the workers.
+    after binding, atomically write the RESOLVED dialable address list
+    here (comma-separated, same order as ``--address``). The launcher
+    (``benchmarks/serving_mp.py``, ``make mp-smoke``) polls this file
+    instead of racing the bind — and it is how an ephemeral tcp port
+    gets back to the workers.
 """
 
 from __future__ import annotations
@@ -32,6 +39,7 @@ def main(argv=None) -> int:
         description="multiverso_tpu table-server process")
     parser.add_argument("--address", default="unix:/tmp/mvtpu.sock")
     parser.add_argument("--name", default="tables")
+    parser.add_argument("--fuse", type=int, default=None)
     parser.add_argument("--ready-file", default=None)
     args = parser.parse_args(argv)
 
@@ -39,7 +47,7 @@ def main(argv=None) -> int:
     from multiverso_tpu.server.table_server import TableServer
 
     core.init()
-    server = TableServer(args.address, name=args.name)
+    server = TableServer(args.address, name=args.name, fuse=args.fuse)
     bound = server.start()
 
     if args.ready_file:

@@ -2,8 +2,8 @@
 
 The reference framework's server role (`src/server.cpp`: ZeroMQ/MPI
 recv loop → ProcessGet/ProcessAdd on the owned table shards) mapped
-onto this port: a :class:`TableServer` listens on one wire address,
-worker *processes* connect through
+onto this port: a :class:`TableServer` listens on one or more wire
+addresses, worker *processes* connect through
 :mod:`multiverso_tpu.client.transport`, and every table op funnels into
 ONE dispatch thread — the same single-dispatch-thread contract the rest
 of the repo keeps for multi-device collectives (`benchmarks/serving.py`
@@ -11,33 +11,56 @@ has the in-process version of this exact loop).
 
 Thread topology per server::
 
-    accept thread ──► per-conn reader ──┐
+    accept thread ──► per-conn reader ──┬─(staleness get: replica hit,
+                      per-conn reader ──┤  answered right here)
                       per-conn reader ──┼──► dispatch queue ─► ONE
-                      per-conn reader ──┘    dispatch thread (table ops)
-                                              │ replies
-                      per-conn writer ◄───────┘ (per-conn send queues)
+                                        │    dispatch thread (table ops,
+                                        │    FUSED up to MVTPU_SERVER_FUSE
+                                        │    frames per cycle)
+                      per-conn writer ◄─┴──── replies (per-conn queues)
+
+The hot path is batched like the reference's server loop processes its
+message queue: each dispatch cycle drains up to ``MVTPU_SERVER_FUSE``
+queued frames (default 1 = off), groups compatible ops by (table, op
+kind, AddOption, sync), concatenates the payloads host-side with
+cross-request duplicate pre-summing (the CoalescingBuffer grouping
+rules; only for linear updaters — stateful-updater groups run per-frame
+inside the cycle so fusion never changes their math), executes ONE
+``apply``/``lookup`` per group, and fans per-request replies back — K
+workers' small adds become one device dispatch. Reads that carry a
+``staleness`` bound never enter the queue at all: they are served from
+per-table snapshot replicas on the reader threads
+(:mod:`multiverso_tpu.server.replica`).
 
 Fault containment is the design center, not an afterthought:
 
 - A connection dying (worker SIGKILL, chaos ``drop``/``torn``) kills
   its reader/writer pair and nothing else — the dispatch thread and
-  every other connection keep going.
+  every other connection keep going. This holds on the shm transport
+  too: the doorbell socket's EOF is the death signal.
 - A handler error (bad table id, shape mismatch) becomes an
   ``{ok: false, error: ...}`` reply; the dispatch thread never dies on
-  a request.
+  a request. A fault mid-fusion-cycle (chaos ``server.fuse``) falls
+  back to per-frame execution, so only genuinely-failing requests fail.
 - Mutating ops are **deduplicated** by ``(client id, request id)``: the
   client transport resends unacked adds after a reconnect
   (at-least-once delivery), and this table keeps replay from becoming
   double-apply (exactly-once effect) — the property the chaos-storm
-  bit-identical test pins down.
+  bit-identical test pins down. Both dedup layers are bounded LRUs
+  (``MVTPU_WIRE_DEDUP`` replies per client, floor ``96`` so the window
+  always exceeds the client's 64-deep pipeline;
+  ``MVTPU_WIRE_DEDUP_CLIENTS`` client entries) so a long-lived server
+  cannot grow without limit.
 """
 
 from __future__ import annotations
 
 import collections
+import os
 import queue
 import socket
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -46,6 +69,7 @@ from multiverso_tpu import core
 from multiverso_tpu.ft import chaos as _chaos
 from multiverso_tpu.io import wiresock
 from multiverso_tpu.server import wire
+from multiverso_tpu.server.replica import TableReplica
 from multiverso_tpu.telemetry import metrics as telemetry
 from multiverso_tpu.updaters import AddOption
 from multiverso_tpu.utils import log
@@ -54,9 +78,31 @@ from multiverso_tpu.utils import log
 #: server-owned: each table's option advances it per applied add)
 _OPTION_FIELDS = ("learning_rate", "momentum", "rho", "lam")
 
-#: replies cached per client for dedup replay; must exceed the client
-#: transport's max pipelined-unacked window (64) with slack
+FUSE_ENV = "MVTPU_SERVER_FUSE"
+DEDUP_ENV = "MVTPU_WIRE_DEDUP"
+DEDUP_CLIENTS_ENV = "MVTPU_WIRE_DEDUP_CLIENTS"
+
+#: default replies cached per client for dedup replay
 _DEDUP_CACHE = 256
+#: hard floor for ``MVTPU_WIRE_DEDUP``: the replay window must exceed
+#: the client transport's max pipelined-unacked window (64) with slack,
+#: or a plain reconnect resend would fall outside it
+_DEDUP_FLOOR = 96
+#: default bound on distinct clients carrying a dedup cache
+_DEDUP_CLIENTS = 1024
+
+#: ops the dispatch thread may fuse across requests
+_FUSABLE = ("add", "kv_add", "get", "kv_get")
+
+#: updaters whose apply is linear in the delta: pre-summing K requests
+#: into one apply is exact for them (the CoalescingBuffer dense rule).
+#: Stateful updaters (adagrad/adam/momentum/ftrl) are nonlinear — their
+#: groups execute per-frame inside the cycle instead, so fusion never
+#: changes their math
+_PRESUM_UPDATERS = ("default", "sgd")
+
+#: frames-per-cycle histogram bounds (server.fuse.batch)
+_FUSE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
 
 #: live servers in this process, for the /statusz transport section
 _SERVERS: List["TableServer"] = []
@@ -67,14 +113,26 @@ def status_all() -> List[Dict[str, Any]]:
     return [s.status() for s in list(_SERVERS)]
 
 
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
 class _Conn:
-    """One client connection: socket + its writer queue + dedup state."""
+    """One client connection: its channel + writer queue + identity."""
 
     _ids = iter(range(1, 1 << 62))
     _ids_lock = threading.Lock()
 
-    def __init__(self, sock: socket.socket) -> None:
+    def __init__(self, sock: socket.socket, scheme: str,
+                 listen_path: Optional[str]) -> None:
         self.sock = sock
+        self.scheme = scheme
+        self.listen_path = listen_path
+        self.chan: Optional[Any] = None     # set by the conn thread's
+        # accept_channel handshake, before the read/write loops run
         with _Conn._ids_lock:
             self.conn_id = next(_Conn._ids)
         self.client_id: str = f"conn{self.conn_id}"
@@ -83,6 +141,13 @@ class _Conn:
 
     def close(self) -> None:
         self.alive = False
+        chan = self.chan
+        if chan is not None:
+            try:
+                chan.close()
+            except OSError:
+                pass
+            return
         try:
             self.sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -93,20 +158,41 @@ class _Conn:
             pass
 
 
-class TableServer:
-    """Serve the table fleet over one wire address.
+class _Unit:
+    """One executable unit of a fusion cycle: either a singleton
+    (control op / unfusable) or a group of same-(table, op, option,
+    sync) frames."""
 
-    ``start()`` binds + spins the threads and returns the dialable
-    address (resolving ``tcp:host:0``'s ephemeral port); ``stop()``
-    drains everything. Usable in-process (tests run a TableServer on a
-    thread next to the pytest client) or as its own process via
-    ``python -m multiverso_tpu.server``.
+    __slots__ = ("key", "items")
+
+    def __init__(self, key: Optional[tuple], item: tuple) -> None:
+        self.key = key
+        self.items = [item]     # (batch_idx, conn, header, arrays)
+
+
+class TableServer:
+    """Serve the table fleet over one or more wire addresses.
+
+    ``address`` may be a comma-separated list (e.g.
+    ``"unix:/run/a.sock,tcp:127.0.0.1:0,shm:///run/b.sock"``) — one
+    listener each, one shared dispatch thread. ``start()`` binds + spins
+    the threads and returns the dialable address list (resolving
+    ``tcp:host:0``'s ephemeral ports); ``stop()`` drains everything.
+    ``fuse`` (default: ``MVTPU_SERVER_FUSE``, else 1 = off) caps how
+    many queued frames one dispatch cycle may drain and fuse. Usable
+    in-process (tests run a TableServer on a thread next to the pytest
+    client) or as its own process via ``python -m multiverso_tpu.server``.
     """
 
-    def __init__(self, address: str, *, name: str = "tables") -> None:
+    def __init__(self, address: str, *, name: str = "tables",
+                 fuse: Optional[int] = None) -> None:
         self.name = name
-        self.address = address
-        self._listener: Optional[socket.socket] = None
+        self._addresses = [a.strip() for a in str(address).split(",")
+                           if a.strip()]
+        if not self._addresses:
+            raise ValueError("TableServer needs at least one address")
+        self.address = ",".join(self._addresses)
+        self._listeners: List[socket.socket] = []
         self._conns: Dict[int, _Conn] = {}
         self._conns_lock = threading.Lock()
         self._dispatchq: "queue.Queue" = queue.Queue()
@@ -114,25 +200,52 @@ class TableServer:
         self._stop = threading.Event()
         self._tables: Dict[int, Any] = {}
         self._by_name: Dict[str, int] = {}
+        self._replicas: Dict[int, TableReplica] = {}
         self._next_table = 0
-        # (client_id) -> OrderedDict(rid -> reply) for mutation replay
-        self._dedup: Dict[str, "collections.OrderedDict"] = {}
+        self._fuse = max(int(fuse) if fuse is not None
+                         else _env_int(FUSE_ENV, 1), 1)
+        self._dedup_depth = max(_env_int(DEDUP_ENV, _DEDUP_CACHE),
+                                _DEDUP_FLOOR)
+        self._dedup_clients = max(
+            _env_int(DEDUP_CLIENTS_ENV, _DEDUP_CLIENTS), 1)
+        # LRU of LRUs: client_id -> OrderedDict(rid -> reply)
+        self._dedup: "collections.OrderedDict[str, collections.OrderedDict]" \
+            = collections.OrderedDict()
         self._g_conns = telemetry.gauge("wire.connections",
                                         server=self.name)
+        self._g_depth = telemetry.gauge("server.queue.depth",
+                                        server=self.name)
+        self._h_batch = telemetry.histogram("server.fuse.batch",
+                                            _FUSE_BUCKETS,
+                                            server=self.name)
+        self._h_age = telemetry.histogram("server.queue.age",
+                                          telemetry.LATENCY_BUCKETS,
+                                          server=self.name)
+        self._c_fuse_groups = telemetry.counter("server.fuse.groups",
+                                                server=self.name)
+        self._c_fuse_frames = telemetry.counter("server.fuse.frames",
+                                                server=self.name)
         self._ops = 0
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> str:
         core.init()     # idempotent; tables need the mesh
-        self._listener = wiresock.listen_socket(self.address)
-        self.address = wiresock.bound_address(self._listener,
-                                              self.address)
-        self._spawn(self._accept_loop, "wire-accept")
+        bound = []
+        for addr in self._addresses:
+            parsed = wiresock.parse_address(addr)
+            listener = wiresock.listen_socket(addr)
+            self._listeners.append(listener)
+            bound.append(wiresock.bound_address(listener, addr))
+            path = parsed[1] if parsed[0] in ("unix", "shm") else None
+            self._spawn(self._accept_loop,
+                        f"wire-accept{len(bound)}", listener,
+                        parsed[0], path)
+        self.address = ",".join(bound)
         self._spawn(self._dispatch_loop, "wire-dispatch")
         _SERVERS.append(self)
-        log.info("table server %r listening on %s", self.name,
-                 self.address)
+        log.info("table server %r listening on %s (fuse=%d)",
+                 self.name, self.address, self._fuse)
         return self.address
 
     def _spawn(self, fn, name: str, *args) -> threading.Thread:
@@ -146,15 +259,15 @@ class TableServer:
         if self._stop.is_set():
             return
         self._stop.set()
-        if self._listener is not None:
+        for listener in self._listeners:
             # shutdown-then-close (wire._close_socket rationale): a
             # plain close does NOT wake a thread blocked in accept()
             try:
-                self._listener.shutdown(socket.SHUT_RDWR)
+                listener.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
             try:
-                self._listener.close()
+                listener.close()
             except OSError:
                 pass
         with self._conns_lock:
@@ -162,6 +275,8 @@ class TableServer:
         for conn in conns:
             conn.sendq.put(None)
             conn.close()
+        for rep in self._replicas.values():
+            rep.stop()
         self._dispatchq.put(None)
         for t in self._threads:
             if t is not threading.current_thread():
@@ -180,15 +295,18 @@ class TableServer:
             n_conns = len(self._conns)
         return {"name": self.name, "address": self.address,
                 "connections": n_conns, "tables": len(self._tables),
-                "ops": self._ops,
-                "queued": self._dispatchq.qsize()}
+                "ops": self._ops, "fuse": self._fuse,
+                "queued": self._dispatchq.qsize(),
+                "replicas": [rep.status()
+                             for rep in self._replicas.values()]}
 
     # -- accept / read / write threads -------------------------------------
 
-    def _accept_loop(self) -> None:
+    def _accept_loop(self, listener: socket.socket, scheme: str,
+                     listen_path: Optional[str]) -> None:
         while not self._stop.is_set():
             try:
-                sock, _ = self._listener.accept()
+                sock, _ = listener.accept()
             except OSError:
                 if self._stop.is_set():
                     return
@@ -208,14 +326,31 @@ class TableServer:
             if sock.family == socket.AF_INET:
                 sock.setsockopt(socket.IPPROTO_TCP,
                                 socket.TCP_NODELAY, 1)
-            conn = _Conn(sock)
+            conn = _Conn(sock, scheme, listen_path)
             with self._conns_lock:
                 self._conns[conn.conn_id] = conn
                 self._g_conns.set(len(self._conns))
-            self._spawn(self._read_loop, f"wire-read{conn.conn_id}",
+            self._spawn(self._conn_main, f"wire-read{conn.conn_id}",
                         conn)
-            self._spawn(self._write_loop, f"wire-write{conn.conn_id}",
-                        conn)
+
+    def _conn_main(self, conn: _Conn) -> None:
+        """Per-connection thread: channel handshake (shm listeners
+        negotiate rings off the accept thread, so a stalled client
+        cannot block other accepts), then the read loop."""
+        try:
+            conn.chan = wire.accept_channel(
+                conn.sock, conn.scheme, listen_path=conn.listen_path,
+                role="server")
+        except (ConnectionError, wire.WireProtocolError, OSError,
+                ValueError) as exc:
+            if not self._stop.is_set():
+                log.debug("conn %d handshake failed: %s", conn.conn_id,
+                          exc)
+            self._drop_conn(conn)
+            return
+        self._spawn(self._write_loop, f"wire-write{conn.conn_id}",
+                    conn)
+        self._read_loop(conn)
 
     def _drop_conn(self, conn: _Conn) -> None:
         with self._conns_lock:
@@ -226,20 +361,40 @@ class TableServer:
             conn.close()
 
     def _read_loop(self, conn: _Conn) -> None:
-        """Reader: frames off this connection into the dispatch queue.
+        """Reader: frames off this connection into the dispatch queue —
+        except staleness-tolerant reads, answered HERE from the table's
+        replica when fresh enough (never a jax call; see replica.py).
         ANY wire failure here is this connection's problem only."""
         while conn.alive and not self._stop.is_set():
             try:
-                header, arrays, _ = wire.recv_frame(conn.sock,
-                                                    role="server")
+                header, arrays, _ = conn.chan.recv()
             except (ConnectionError, wire.WireProtocolError, OSError,
                     ValueError) as exc:
                 if conn.alive and not self._stop.is_set():
                     log.debug("conn %d reader closing: %s",
                               conn.conn_id, exc)
                 break
-            self._dispatchq.put((conn, header, arrays))
+            if header.get("staleness") is not None \
+                    and header.get("op") in ("get", "kv_get"):
+                try:
+                    reply = self._serve_replica(header, arrays)
+                except Exception:   # noqa: BLE001 — containment: a
+                    reply = None    # replica bug degrades to dispatch
+                if reply is not None:
+                    rheader, rarrays = reply
+                    rheader.setdefault("rid", header.get("rid"))
+                    conn.sendq.put((rheader, rarrays))
+                    continue
+            self._dispatchq.put((conn, header, arrays,
+                                 time.monotonic()))
         self._drop_conn(conn)
+
+    def _serve_replica(self, header: Dict[str, Any],
+                       arrays: List[np.ndarray]) -> Optional[tuple]:
+        rep = self._replicas.get(int(header.get("table", -1)))
+        if rep is None:
+            return None
+        return rep.serve(header, arrays)
 
     def _write_loop(self, conn: _Conn) -> None:
         while True:
@@ -248,8 +403,7 @@ class TableServer:
                 return
             header, arrays = item
             try:
-                wire.send_frame(conn.sock, header, arrays,
-                                role="server")
+                conn.chan.send(header, arrays)
             except (ConnectionError, OSError) as exc:
                 if conn.alive and not self._stop.is_set():
                     log.debug("conn %d writer closing: %s",
@@ -263,39 +417,281 @@ class TableServer:
         h_dispatch = telemetry.histogram("wire.dispatch.seconds",
                                          telemetry.LATENCY_BUCKETS,
                                          server=self.name)
-        import time as _time
         while True:
             item = self._dispatchq.get()
             if item is None:
                 return
-            conn, header, arrays = item
+            batch = [item]
+            stop_after = False
+            while len(batch) < self._fuse:
+                try:
+                    nxt = self._dispatchq.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    stop_after = True
+                    break
+                batch.append(nxt)
+            self._g_depth.set(float(self._dispatchq.qsize()))
+            self._h_batch.observe(float(len(batch)))
+            now = time.monotonic()
+            for _, _, _, enq_ts in batch:
+                self._h_age.observe(max(now - enq_ts, 0.0))
+            if len(batch) == 1:
+                conn, header, arrays, _ = batch[0]
+                op = str(header.get("op", "?"))
+                t0 = time.monotonic()
+                reply = self._safe_execute(conn, op, header, arrays)
+                self._finish(conn, op, header.get("rid"), reply, t0,
+                             h_dispatch)
+            else:
+                self._run_fused_batch(batch, h_dispatch)
+            if stop_after:
+                return
+
+    def _safe_execute(self, conn: _Conn, op: str,
+                      header: Dict[str, Any], arrays: List[np.ndarray],
+                      force_sync: bool = False) -> Optional[tuple]:
+        try:
+            return self._execute(conn, op, header, arrays,
+                                 force_sync=force_sync)
+        except Exception as exc:      # noqa: BLE001 — reply, don't die
+            telemetry.counter("wire.server.errors", op=op).inc()
+            log.warn("wire op %s failed: %s: %s", op,
+                     type(exc).__name__, exc)
+            return ({"ok": False, "rid": header.get("rid"),
+                     "error": f"{type(exc).__name__}: {exc}"}, [])
+
+    def _finish(self, conn: _Conn, op: str, rid,
+                reply: Optional[tuple], t0: float, h_dispatch) -> None:
+        h_dispatch.observe(time.monotonic() - t0)
+        self._ops += 1
+        telemetry.counter("wire.requests", op=op).inc()
+        if reply is not None and conn.alive:
+            rheader, rarrays = reply
+            rheader.setdefault("rid", rid)
+            conn.sendq.put((rheader, rarrays))
+
+    # -- request fusion ----------------------------------------------------
+
+    def _run_fused_batch(self, batch: List[tuple],
+                         h_dispatch) -> None:
+        """One fusion cycle: plan units in arrival order, execute each
+        (groups get ONE table op), then fan replies back in arrival
+        order — per-connection reply order is what the client's
+        in-order ack matching relies on."""
+        t0 = time.monotonic()
+        replies: Dict[int, Optional[tuple]] = {}
+        for unit in self._plan_units(batch):
+            if unit.key is None or len(unit.items) == 1:
+                for idx, conn, header, arrays in unit.items:
+                    op = str(header.get("op", "?"))
+                    replies[idx] = self._safe_execute(conn, op, header,
+                                                      arrays)
+            else:
+                replies.update(self._execute_group(unit))
+        for idx, (conn, header, _arrays, _ts) in enumerate(batch):
+            self._finish(conn, str(header.get("op", "?")),
+                         header.get("rid"), replies.get(idx), t0,
+                         h_dispatch)
+
+    def _plan_units(self, batch: List[tuple]) -> List[_Unit]:
+        """Group the cycle's frames. A frame may only join a group that
+        is still OPEN for its table — any interleaved different op /
+        option / sync on the same table seals the group — so per-table
+        op order is preserved exactly (frames only ever execute
+        *earlier* than they would have, never later than a subsequent
+        same-table op). Control ops are singleton units in sequence."""
+        units: List[_Unit] = []
+        open_by_table: Dict[int, _Unit] = {}
+        for idx, (conn, header, arrays, _ts) in enumerate(batch):
             op = str(header.get("op", "?"))
-            rid = header.get("rid")
-            t0 = _time.monotonic()
+            item = (idx, conn, header, arrays)
+            tid = header.get("table")
+            if op in _FUSABLE and tid is not None:
+                try:
+                    tid = int(tid)
+                    key = self._group_key(op, tid, header)
+                except (TypeError, ValueError):
+                    units.append(_Unit(None, item))
+                    continue
+                unit = open_by_table.get(tid)
+                if unit is not None and unit.key == key:
+                    unit.items.append(item)
+                    continue
+                unit = _Unit(key, item)
+                open_by_table[tid] = unit
+                units.append(unit)
+            else:
+                units.append(_Unit(None, item))
+        return units
+
+    @staticmethod
+    def _group_key(op: str, tid: int, header: Dict[str, Any]) -> tuple:
+        opt = header.get("option") or {}
+        return (op, tid, bool(header.get("sync")),
+                tuple(sorted((str(k), float(v))
+                             for k, v in opt.items())))
+
+    def _execute_group(self, unit: _Unit) -> Dict[int, tuple]:
+        """Execute one fused group. Dedup replays answer from the
+        cache first (a resend inside a fusion cycle must not
+        re-apply); a fault mid-group falls back to per-frame execution
+        so only genuinely-failing requests fail."""
+        op = unit.key[0]
+        mutating = op in ("add", "kv_add")
+        out: Dict[int, tuple] = {}
+        fresh: List[tuple] = []
+        for item in unit.items:
+            idx, conn, header, _arrays = item
+            if mutating:
+                cached = self._dedup_get(conn.client_id,
+                                         header.get("rid"))
+                if cached is not None:
+                    telemetry.counter("wire.dedup.replays",
+                                      op=op).inc()
+                    out[idx] = cached
+                    continue
+            fresh.append(item)
+        if not fresh:
+            return out
+        if len(fresh) == 1:
+            idx, conn, header, arrays = fresh[0]
+            out[idx] = self._safe_execute(conn, op, header, arrays)
+            return out
+        if mutating:
             try:
-                reply = self._execute(conn, op, header, arrays)
-            except Exception as exc:      # noqa: BLE001 — reply, don't die
-                telemetry.counter("wire.server.errors", op=op).inc()
-                log.warn("wire op %s failed: %s: %s", op,
-                            type(exc).__name__, exc)
-                reply = ({"ok": False, "rid": rid,
-                          "error": f"{type(exc).__name__}: {exc}"}, [])
-            h_dispatch.observe(_time.monotonic() - t0)
-            self._ops += 1
-            telemetry.counter("wire.requests", op=op).inc()
-            if reply is not None and conn.alive:
-                rheader, rarrays = reply
-                rheader.setdefault("rid", rid)
-                conn.sendq.put((rheader, rarrays))
+                upd = self._table(fresh[0][2]).updater.name
+            except Exception:   # noqa: BLE001 — bad table id etc.:
+                upd = None      # per-frame path replies the error
+            if upd not in _PRESUM_UPDATERS:
+                # Nonlinear updater state: a merged delta is NOT K
+                # sequential applies. Run the group per-frame — same
+                # cycle, zero semantic drift.
+                telemetry.counter("server.fuse.stateful_bypass",
+                                  op=op).inc()
+                for idx, conn, header, arrays in fresh:
+                    out[idx] = self._safe_execute(conn, op, header,
+                                                  arrays)
+                return out
+        try:
+            _chaos.chaos_point("server.fuse")
+            fused = self._apply_group(op, fresh)
+            self._c_fuse_groups.inc()
+            self._c_fuse_frames.inc(len(fresh))
+        except Exception as exc:    # noqa: BLE001 — containment
+            telemetry.counter("server.fuse.fallbacks", op=op).inc()
+            log.warn("fused %s x%d fell back to per-frame: %s: %s",
+                     op, len(fresh), type(exc).__name__, exc)
+            # kv_add fallback forces sync so every request gets its OWN
+            # commit/overflow verdict (a fused overflow names no
+            # culprit)
+            for idx, conn, header, arrays in fresh:
+                out[idx] = self._safe_execute(
+                    conn, op, header, arrays,
+                    force_sync=(op == "kv_add"))
+            return out
+        for idx, conn, header, _arrays in fresh:
+            reply = fused[idx]
+            if mutating:
+                self._dedup_put(conn.client_id, header.get("rid"),
+                                reply)
+            out[idx] = reply
+        return out
+
+    def _apply_group(self, op: str,
+                     items: List[tuple]) -> Dict[int, tuple]:
+        """The fused table op for one group: K compatible frames, ONE
+        device dispatch."""
+        header0 = items[0][2]
+        table = self._table(header0)
+        option = self._option(header0)
+        sync = bool(header0.get("sync"))
+        k = len(items)
+        if op == "add":
+            # CoalescingBuffer dense rule: pre-sum the deltas in table
+            # dtype, apply once
+            total: Optional[np.ndarray] = None
+            for _idx, _conn, header, arrays in items:
+                delta = wire.decode_delta(header.get("quant"), arrays) \
+                    .astype(table.dtype, copy=False)
+                if total is None:
+                    total = delta.astype(table.dtype, copy=True)
+                elif delta.shape != total.shape:
+                    raise ValueError(
+                        f"fused add shape mismatch {delta.shape} vs "
+                        f"{total.shape}")
+                else:
+                    total += delta
+            handle = table.add(total, option, sync=sync)
+            reply = {"ok": True, "gen": handle.generation, "fused": k}
+            return {idx: (dict(reply), []) for idx, *_ in items}
+        if op == "kv_add":
+            all_keys, all_deltas = [], []
+            for _idx, _conn, header, arrays in items:
+                keys = np.ascontiguousarray(arrays[0]) \
+                    .astype(np.uint64, copy=False)
+                delta = np.asarray(
+                    wire.decode_delta(header.get("quant"), arrays[1:]),
+                    dtype=table.dtype)
+                if len(delta) != len(keys):
+                    raise ValueError(
+                        f"kv_add keys/delta length mismatch "
+                        f"{len(keys)} vs {len(delta)}")
+                all_keys.append(keys)
+                all_deltas.append(delta)
+            cat_keys = np.concatenate(all_keys)
+            cat_deltas = np.concatenate(all_deltas, axis=0)
+            # CoalescingBuffer KV rule: cross-request duplicates
+            # pre-sum so the stateful-updater unique-ids contract
+            # holds for the ONE fused batch
+            uniq, inverse = np.unique(cat_keys, return_inverse=True)
+            summed = np.zeros((len(uniq),) + cat_deltas.shape[1:],
+                              cat_deltas.dtype)
+            np.add.at(summed, inverse, cat_deltas)
+            handle = table.add(uniq, summed, option, sync=sync)
+            # per-request overflow verdict: the fused batch drops
+            # atomically on overflow, so ONE readback per cycle buys a
+            # truthful reply for every request in it (the raise lands
+            # in _execute_group's fallback, which re-runs per frame)
+            table._check_overflow()
+            reply = {"ok": True, "gen": handle.generation, "fused": k}
+            return {idx: (dict(reply), []) for idx, *_ in items}
+        if op == "get":
+            for _idx, _conn, header, _arrays in items:
+                self._maybe_arm_replica(header)
+            values = np.ascontiguousarray(table.get())
+            return {idx: ({"ok": True, "fused": k}, [values])
+                    for idx, *_ in items}
+        if op == "kv_get":
+            lens = []
+            all_keys = []
+            for _idx, _conn, header, arrays in items:
+                self._maybe_arm_replica(header)
+                keys = np.ascontiguousarray(arrays[0]) \
+                    .astype(np.uint64, copy=False)
+                all_keys.append(keys)
+                lens.append(len(keys))
+            values, found = table.get(np.concatenate(all_keys))
+            out: Dict[int, tuple] = {}
+            off = 0
+            for (idx, *_), n in zip(items, lens):
+                out[idx] = ({"ok": True, "fused": k},
+                            [np.ascontiguousarray(values[off:off + n]),
+                             np.ascontiguousarray(found[off:off + n])])
+                off += n
+            return out
+        raise ValueError(f"unfusable op {op!r}")
+
+    # -- request execution (single-frame path) ------------------------------
 
     def _execute(self, conn: _Conn, op: str, header: Dict[str, Any],
-                 arrays: List[np.ndarray]
+                 arrays: List[np.ndarray], force_sync: bool = False
                  ) -> Optional[Tuple[Dict[str, Any], list]]:
         if op == "hello":
             requested = str(header.get("client") or conn.client_id)
             conn.client_id = requested
-            self._dedup.setdefault(requested,
-                                   collections.OrderedDict())
+            self._dedup_cache(requested)
             return ({"ok": True, "client_id": requested,
                      "server": self.name,
                      "quant": wire.quant_mode_from_env()}, [])
@@ -326,23 +722,32 @@ class TableServer:
         elif op == "kv_get":
             reply = self._op_kv_get(header, arrays)
         elif op == "add":
-            reply = self._op_add(header, arrays)
+            reply = self._op_add(header, arrays, force_sync=force_sync)
         elif op == "kv_add":
-            reply = self._op_kv_add(header, arrays)
+            reply = self._op_kv_add(header, arrays,
+                                    force_sync=force_sync)
         else:
             raise ValueError(f"unknown wire op {op!r}")
         if mutating:
             self._dedup_put(conn.client_id, header.get("rid"), reply)
         return reply
 
-    # -- dedup cache -------------------------------------------------------
+    # -- dedup cache (bounded LRU of bounded LRUs) --------------------------
+
+    def _dedup_cache(self, client: str) -> "collections.OrderedDict":
+        cache = self._dedup.get(client)
+        if cache is None:
+            cache = self._dedup[client] = collections.OrderedDict()
+            while len(self._dedup) > self._dedup_clients:
+                self._dedup.popitem(last=False)
+        else:
+            self._dedup.move_to_end(client)
+        return cache
 
     def _dedup_get(self, client: str, rid) -> Optional[tuple]:
         if rid is None:
             return None
-        cache = self._dedup.setdefault(client,
-                                       collections.OrderedDict())
-        entry = cache.get(int(rid))
+        entry = self._dedup_cache(client).get(int(rid))
         if entry is not None:
             header, arrays = entry
             return (dict(header), list(arrays))
@@ -351,10 +756,9 @@ class TableServer:
     def _dedup_put(self, client: str, rid, reply: tuple) -> None:
         if rid is None:
             return
-        cache = self._dedup.setdefault(client,
-                                       collections.OrderedDict())
+        cache = self._dedup_cache(client)
         cache[int(rid)] = reply
-        while len(cache) > _DEDUP_CACHE:
+        while len(cache) > self._dedup_depth:
             cache.popitem(last=False)
 
     # -- table ops ---------------------------------------------------------
@@ -381,6 +785,12 @@ class TableServer:
             self._next_table += 1
             self._tables[tid] = table
             self._by_name[name] = tid
+            if kind in ("array", "kv"):
+                # dormant until the first staleness-tolerant read;
+                # tiered tables excluded (device arrays are one tier,
+                # a snapshot of them would serve partial data)
+                self._replicas[tid] = TableReplica(table, kind,
+                                                   server=self.name)
             log.info("server %r created table %d %r kind=%s", self.name,
                      tid, name, kind)
         meta = {"ok": True, "table": tid, "name": name, "kind": kind,
@@ -423,14 +833,27 @@ class TableServer:
         fields = {k: float(raw[k]) for k in _OPTION_FIELDS if k in raw}
         return AddOption(**fields)
 
+    def _maybe_arm_replica(self, header: Dict[str, Any]) -> None:
+        """A staleness-tolerant read that reached the dispatch thread
+        is a replica miss: arm the table's replica (first use) and
+        kick a refresh so the NEXT one hits on the reader thread."""
+        if header.get("staleness") is None:
+            return
+        rep = self._replicas.get(int(header.get("table", -1)))
+        if rep is not None:
+            rep.arm()
+            rep.refresh()
+
     def _op_get(self, header: Dict[str, Any]) -> tuple:
         table = self._table(header)
+        self._maybe_arm_replica(header)
         values = table.get()
         return ({"ok": True}, [np.ascontiguousarray(values)])
 
     def _op_kv_get(self, header: Dict[str, Any],
                    arrays: List[np.ndarray]) -> tuple:
         table = self._table(header)
+        self._maybe_arm_replica(header)
         keys = np.ascontiguousarray(arrays[0]).astype(np.uint64,
                                                       copy=False)
         values, found = table.get(keys)
@@ -438,20 +861,22 @@ class TableServer:
                                np.ascontiguousarray(found)])
 
     def _op_add(self, header: Dict[str, Any],
-                arrays: List[np.ndarray]) -> tuple:
+                arrays: List[np.ndarray],
+                force_sync: bool = False) -> tuple:
         table = self._table(header)
         # dequant-before-apply: the table layer only ever sees floats
         delta = wire.decode_delta(header.get("quant"), arrays)
         handle = table.add(delta, self._option(header),
-                           sync=bool(header.get("sync")))
+                           sync=bool(header.get("sync")) or force_sync)
         return ({"ok": True, "gen": handle.generation}, [])
 
     def _op_kv_add(self, header: Dict[str, Any],
-                   arrays: List[np.ndarray]) -> tuple:
+                   arrays: List[np.ndarray],
+                   force_sync: bool = False) -> tuple:
         table = self._table(header)
         keys = np.ascontiguousarray(arrays[0]).astype(np.uint64,
                                                       copy=False)
         delta = wire.decode_delta(header.get("quant"), arrays[1:])
         handle = table.add(keys, delta, self._option(header),
-                           sync=bool(header.get("sync")))
+                           sync=bool(header.get("sync")) or force_sync)
         return ({"ok": True, "gen": handle.generation}, [])

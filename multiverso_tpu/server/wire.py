@@ -91,6 +91,7 @@ _chaos = _dep("multiverso_tpu.ft.chaos", "ft", "chaos.py")
 _metrics = _dep("multiverso_tpu.telemetry.metrics", "telemetry",
                 "metrics.py")
 wiresock = _dep("multiverso_tpu.io.wiresock", "io", "wiresock.py")
+shmring = _dep("multiverso_tpu.io.shmring", "io", "shmring.py")
 
 MAGIC = b"MVW1"
 _PREFIX = struct.Struct("<4sII")
@@ -265,6 +266,179 @@ def _close_socket(sock) -> None:
         sock.close()
     except OSError:
         pass
+
+
+# -- channels: one send/recv surface over sockets OR shm rings -------------
+#
+# `WireClient` and the server's per-connection loops talk to a Channel,
+# not a socket: `send(header, arrays) -> nbytes`, `recv() -> (header,
+# arrays, nbytes)`, `close()`. The socket channel is the frame calls
+# above; the shm channel moves the SAME encoded frames through
+# `io/shmring.py` rings and keeps the socket as doorbell + liveness.
+# Everything above the channel (CoalescingBuffer, DeltaBatcher, dedup,
+# retry) is transport-agnostic and runs unchanged on either.
+
+class SocketChannel:
+    """Frames over a stream socket (the PR-11 wire, unchanged)."""
+
+    transport = "socket"
+
+    def __init__(self, sock, *, role: str = "client",
+                 first: Optional[tuple] = None) -> None:
+        self.sock = sock
+        self.role = role
+        self._first = first     # a frame consumed during accept
+
+    def send(self, header: Dict[str, Any],
+             arrays: Sequence[np.ndarray] = ()) -> int:
+        return send_frame(self.sock, header, arrays, role=self.role)
+
+    def recv(self) -> Tuple[Dict[str, Any], List[np.ndarray], int]:
+        if self._first is not None:
+            first, self._first = self._first, None
+            return first
+        return recv_frame(self.sock, role=self.role)
+
+    def close(self) -> None:
+        _close_socket(self.sock)
+
+
+class ShmChannel:
+    """Frames through a shared-memory ring pair (same host only).
+
+    Chaos point ``wire.shm.ring`` fires on every ring send next to the
+    generic ``wire.send``: ``torn`` publishes HALF a record then closes
+    (the peer sees a dead producer, exactly a SIGKILL mid-copy);
+    ``latency`` stalls inside the chaos hook; ``drop`` closes before
+    anything lands in the ring."""
+
+    transport = "shm"
+
+    def __init__(self, endpoint, *, role: str = "client") -> None:
+        self.endpoint = endpoint
+        self.role = role
+
+    def send(self, header: Dict[str, Any],
+             arrays: Sequence[np.ndarray] = ()) -> int:
+        bufs, nbytes = encode_frame(header, arrays)
+        try:
+            _chaos.chaos_point("wire.send")
+            _chaos.chaos_point("wire.shm.ring")
+        except _chaos.ChaosTornWrite as exc:
+            try:
+                self.endpoint.send_torn(bufs, nbytes)
+            except OSError:
+                pass
+            self.close()
+            raise ConnectionError(
+                f"wire: torn shm record ({exc})") from exc
+        except _chaos.ChaosConnDrop:
+            self.close()
+            raise
+        try:
+            self.endpoint.send_bytes(bufs, nbytes,
+                                     wiresock.io_timeout_s())
+        except TimeoutError as exc:
+            # ring full past the IO timeout == dead/stuck consumer:
+            # same retry class as a socket that stopped acking
+            self.close()
+            raise ConnectionError(str(exc)) from exc
+        _count("wire.tx.bytes", nbytes, role=self.role)
+        _count("wire.tx.frames", role=self.role)
+        _count("wire.shm.frames", role=self.role)
+        return nbytes
+
+    def recv(self) -> Tuple[Dict[str, Any], List[np.ndarray], int]:
+        try:
+            _chaos.chaos_point("wire.recv")
+        except (_chaos.ChaosConnDrop, _chaos.ChaosTornWrite) as exc:
+            self.close()
+            if isinstance(exc, _chaos.ChaosConnDrop):
+                raise
+            raise ConnectionError(f"wire: torn read ({exc})") from exc
+        buf = self.endpoint.recv_bytes()
+        if len(buf) < PREFIX_BYTES:
+            raise WireProtocolError(f"shm record too short ({len(buf)})")
+        magic, body_len, header_len = _PREFIX.unpack_from(buf, 0)
+        if magic != MAGIC:
+            raise WireProtocolError(f"bad frame magic {magic!r}")
+        if body_len != len(buf) - PREFIX_BYTES or header_len > body_len:
+            raise WireProtocolError(
+                f"implausible shm frame lengths body={body_len} "
+                f"header={header_len} record={len(buf)}")
+        header, arrays = decode_frame_body(
+            memoryview(buf)[PREFIX_BYTES:], header_len)
+        nbytes = PREFIX_BYTES + body_len
+        _count("wire.rx.bytes", nbytes, role=self.role)
+        _count("wire.rx.frames", role=self.role)
+        return header, arrays, nbytes
+
+    def close(self) -> None:
+        self.endpoint.close()
+
+
+def dial_channel(address: str, *, timeout: float = 10.0,
+                 role: str = "client"):
+    """Dial an address → a Channel. For ``shm://`` the client offers a
+    ring pair over the unix socket at the path; a server that does not
+    take the offer (plain unix listener at the same path) gets a
+    normal :class:`SocketChannel` on the very same socket — graceful
+    fallback, frames and semantics identical."""
+    parsed = wiresock.parse_address(address)
+    sock = wiresock.connect_socket(address, timeout=timeout)
+    if parsed[0] != "shm":
+        return SocketChannel(sock, role=role)
+    try:
+        try:
+            c2s, s2c, cap = shmring.create_ring_pair(parsed[1])
+        except OSError:
+            # can't place ring files next to the socket (perms/quota):
+            # the unix socket still works — fall back
+            return SocketChannel(sock, role=role)
+        try:
+            send_frame(sock, {"op": "shm.map", "c2s": c2s, "s2c": s2c,
+                              "bytes": cap}, role=role)
+            header, _, _ = recv_frame(sock, role=role)
+            if header.get("ok") and header.get("op") == "shm.ok":
+                ep = shmring.open_endpoint(sock, tx_path=c2s,
+                                           rx_path=s2c)
+                return ShmChannel(ep, role=role)
+            return SocketChannel(sock, role=role)
+        finally:
+            shmring.unlink_quiet(c2s, s2c)
+    except BaseException:
+        _close_socket(sock)
+        raise
+
+
+def accept_channel(sock, scheme: str, *, listen_path: Optional[str] = None,
+                   role: str = "server"):
+    """Server half: wrap an accepted socket in a Channel. On an shm
+    listener the FIRST frame decides — an ``shm.map`` offer maps the
+    client's rings (paths are pinned to the listen socket's directory)
+    and acks; anything else is a plain-socket client that dialed the
+    same path, served over a :class:`SocketChannel` with that first
+    frame stashed for the read loop."""
+    if scheme != "shm":
+        return SocketChannel(sock, role=role)
+    first = recv_frame(sock, role=role)
+    header = first[0]
+    if header.get("op") != "shm.map":
+        return SocketChannel(sock, role=role, first=first)
+    expect_dir = os.path.dirname(os.path.abspath(listen_path)) \
+        if listen_path else None
+    try:
+        ep = shmring.open_endpoint(sock, tx_path=str(header["s2c"]),
+                                   rx_path=str(header["c2s"]),
+                                   expect_dir=expect_dir)
+    except (OSError, ValueError, KeyError) as exc:
+        send_frame(sock, {"ok": False, "op": "shm.ok",
+                          "error": f"{type(exc).__name__}: {exc}"},
+                   role=role)
+        return SocketChannel(sock, role=role)
+    send_frame(sock, {"ok": True, "op": "shm.ok", "bytes": ep.tx.cap},
+               role=role)
+    return ShmChannel(ep, role=role)
 
 
 # -- numpy delta quantizers (jax twins live in utils/quantization.py) ------

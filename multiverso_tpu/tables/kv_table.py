@@ -177,6 +177,8 @@ class KVTable:
         self._build_jits()
         # checkpoint-export copier, built lazily on the first export
         self._export_copy = None
+        # read-replica copier (keys+values only), lazy like _export_copy
+        self._kv_snapshot_copy = None
         self.table_id = _register(self)  # type: ignore[arg-type]
         lbl = f"{self.table_id}:{self.name}"
         self._h_get = telemetry.histogram(
@@ -730,6 +732,19 @@ class KVTable:
         """Number of live keys (device count — there is no host mirror)."""
         self._check_overflow()
         return int(np.asarray(self._count_live(self.keys)))
+
+    def snapshot_kv_async(self):
+        """Light async copy of (keys, values) for read replicas: jitted
+        device copies that survive the next add's donation, returned as
+        futures for an off-thread ``np.asarray``. Unlike
+        :meth:`export_checkpoint_async` this does NOT flush coalescers
+        or drain overflow flags — it is a dispatch-thread hot-path call
+        and must never block or raise for unrelated pending adds."""
+        if self._kv_snapshot_copy is None:
+            self._kv_snapshot_copy = jax.jit(
+                lambda k, v: (jnp.copy(k), jnp.copy(v)),
+                out_shardings=(self._key_sharding, self._val_sharding))
+        return self._kv_snapshot_copy(self.keys, self.values)
 
     # -- checkpoint --------------------------------------------------------
 

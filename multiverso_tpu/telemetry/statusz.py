@@ -150,7 +150,8 @@ def _statusz_doc() -> dict:
         },
         "health": _health_status(),
         "storage": _storage_status(),
-        "transport": _transport_status(counters, gauges),
+        "transport": _transport_status(counters, gauges,
+                                       snap.get("histograms", {})),
     }
 
 
@@ -166,15 +167,21 @@ def _health_status() -> Optional[dict]:
         return None
 
 
-def _transport_status(counters: dict, gauges: dict) -> Optional[dict]:
-    """Parameter-server wire section: ``wire.*`` byte/frame/request
-    counters plus one row per live in-process TableServer, via
-    sys.modules like the lookups above (a process with no wire pays
-    nothing)."""
-    wire_counters = {k: v for k, v in counters.items()
-                     if k.startswith("wire.")}
-    wire_gauges = {k: v for k, v in gauges.items()
-                   if k.startswith("wire.")}
+def _transport_status(counters: dict, gauges: dict,
+                      histograms: Optional[dict] = None
+                      ) -> Optional[dict]:
+    """Parameter-server wire section: ``wire.*``/``server.*``
+    byte/frame/request counters, the dispatch-drain histograms
+    (``server.fuse.batch`` frames-per-cycle, ``server.queue.age``) and
+    per-table replica generation/staleness gauges, plus one row per
+    live in-process TableServer — via sys.modules like the lookups
+    above (a process with no wire pays nothing)."""
+    def _wire(d: dict) -> dict:
+        return {k: v for k, v in d.items()
+                if k.startswith(("wire.", "server."))}
+    wire_counters = _wire(counters)
+    wire_gauges = _wire(gauges)
+    wire_hists = _wire(histograms or {})
     ts = sys.modules.get("multiverso_tpu.server.table_server")
     servers = None
     if ts is not None:
@@ -182,10 +189,11 @@ def _transport_status(counters: dict, gauges: dict) -> Optional[dict]:
             servers = ts.status_all()
         except Exception:
             servers = None
-    if not wire_counters and not wire_gauges and not servers:
+    if not wire_counters and not wire_gauges and not wire_hists \
+            and not servers:
         return None
     return {"counters": wire_counters, "gauges": wire_gauges,
-            "servers": servers}
+            "histograms": wire_hists, "servers": servers}
 
 
 def _storage_status() -> Optional[list]:

@@ -190,7 +190,7 @@ class TestFaultTolerance:
             r0 = replays.value
             with c._lock:
                 for _ in range(2):
-                    c._tx(c._sock, header, payload)
+                    c._tx(c._chan, header, payload)
                 for _ in range(2):
                     h, _ = c._recv_reply()
                     assert h["ok"] and h["rid"] == 777
@@ -332,5 +332,10 @@ def test_wire_env_knob_docs_match_code():
     """README documents MVTPU_WIRE_*; the knobs must exist in code."""
     assert wire.QUANT_ENV == "MVTPU_WIRE_QUANT"
     assert wire.BLOCK_ENV == "MVTPU_WIRE_BLOCK"
-    from multiverso_tpu.io import wiresock
+    from multiverso_tpu.io import shmring, wiresock
+    from multiverso_tpu.server import table_server
     assert wiresock.TIMEOUT_ENV == "MVTPU_WIRE_TIMEOUT_S"
+    assert table_server.FUSE_ENV == "MVTPU_SERVER_FUSE"
+    assert table_server.DEDUP_ENV == "MVTPU_WIRE_DEDUP"
+    assert table_server.DEDUP_CLIENTS_ENV == "MVTPU_WIRE_DEDUP_CLIENTS"
+    assert shmring.RING_ENV == "MVTPU_SHM_RING_MB"

@@ -79,7 +79,12 @@ DEFAULT_WATCH = ("value", "e2e_words_per_sec", "lda_doc_tokens_per_sec",
                  # multi-process wire bench (benchmarks/serving_mp.py):
                  # bytes-on-wire throughput across worker processes —
                  # its step tail rides DEFAULT_WATCH_LOWER below
-                 "wire_mb_per_sec")
+                 "wire_mb_per_sec",
+                 # ...and its fused ops lane: cross-client adds per
+                 # second with dispatch-cycle request fusion ON — a
+                 # regression here means the fusion drain stopped
+                 # batching the dispatch hot path
+                 "serving_mp_ops_per_sec")
 
 # LOWER-is-better watches: a rise past the threshold regresses
 DEFAULT_WATCH_LOWER = ("serving_p99_ms",
@@ -92,7 +97,11 @@ DEFAULT_WATCH_LOWER = ("serving_p99_ms",
                        # multi-process wire bench worker step tail —
                        # a rise means the socket transport crept onto
                        # the training step's critical path
-                       "serving_mp_p99_ms")
+                       "serving_mp_p99_ms",
+                       # same-host shm-ring round trip (serving_mp's
+                       # staleness-read probe) — a rise means the ring
+                       # transport lost its edge over tcp loopback
+                       "shm_rtt_us")
 
 
 def _flatten(prefix: str, obj, out: Dict[str, float]) -> None:
@@ -388,6 +397,28 @@ def selftest() -> int:
         mp_doc3["wire_bytes_ratio"] = 4.1               # unwatched drop
         assert main([mp_old, put("mp_fast.json", mp_doc3)]) == 0, \
             "a faster mp tail passes; bytes ratio rides along unwatched"
+        # ...the hot-path lanes: fused ops/s is higher-is-better, the
+        # shm-ring round trip lower-is-better — both watched by default
+        hp_old = put("hp_old.json", {
+            "metric": "wire_mb_per_sec", "value": 10.0,
+            "unit": "MiB/s", "wire_mb_per_sec": 10.0,
+            "serving_mp_ops_per_sec": 5000.0,
+            "serving_mp_ops_per_sec_unfused": 900.0,
+            "serving_mp_fuse_ratio": 5.5,
+            "shm_rtt_us": 300.0, "tcp_rtt_us": 450.0})
+        hp_doc = json.loads(json.dumps(json.load(open(hp_old))))
+        hp_doc["serving_mp_ops_per_sec"] = 1000.0       # -80%
+        assert main([hp_old, put("hp_fuse.json", hp_doc)]) == 1, \
+            "fused ops/s drop must fail (fusion drain regressed)"
+        hp_doc2 = json.loads(json.dumps(json.load(open(hp_old))))
+        hp_doc2["shm_rtt_us"] = 1200.0                  # 4x slower
+        assert main([hp_old, put("hp_rtt.json", hp_doc2)]) == 1, \
+            "shm round-trip rise must fail (lower is better)"
+        hp_doc3 = json.loads(json.dumps(json.load(open(hp_old))))
+        hp_doc3["shm_rtt_us"] = 150.0                   # faster
+        hp_doc3["tcp_rtt_us"] = 900.0                   # unwatched rise
+        assert main([hp_old, put("hp_fast.json", hp_doc3)]) == 0, \
+            "a faster shm ring passes; tcp baseline rides unwatched"
         # unusable inputs exit 2, not a traceback
         hung = put("hung.json", {"rc": 124, "tail": "...", "parsed": None})
         assert main([hung, raw_ok]) == 2, "no parsed line -> exit 2"
