@@ -50,6 +50,15 @@
 #                   staleness-read RTT probe (shm ring vs tcp loopback;
 #                   asserts the quant lane ships >= 4x fewer bytes at
 #                   matched loss; emits serving_mp_bench.json)
+#   make flood-smoke - overload/admission smoke: a deliberate flooder
+#                   client vs protected workers through one admission-
+#                   controlled server (QoS classes + token bucket +
+#                   bounded queue); asserts the flooder is shed with
+#                   retry-after, the protected p999 holds the armed
+#                   MVTPU_SLO rule (slo_violations == 0), and both
+#                   final tables stay bit-exact (no shed-resent add
+#                   double-applies); emits serving_mp_flood.json —
+#                   a partial line on every give-up path
 #   make chaos    - the chaos lane: fault-injection test subset
 #                   (ft subsystem + overwrite crash-window fuzz) plus a
 #                   CLI checkpoint/resume smoke under an active
@@ -63,7 +72,7 @@ NEW ?= BENCH_r05.json
 
 .PHONY: test dryrun bench bench-dryrun bench-diff bench-diff-selftest \
 	client-bench ckpt-bench kernel-bench tier-bench serve-smoke \
-	mp-smoke health-smoke chaos fuzz lint native ci
+	mp-smoke flood-smoke health-smoke chaos fuzz lint native ci
 
 fuzz:
 	$(PY) tests/deep_fuzz.py
@@ -100,6 +109,9 @@ serve-smoke:
 
 mp-smoke:
 	MVTPU_SERVING_MP_TINY=1 $(PY) benchmarks/serving_mp.py
+
+flood-smoke:
+	MVTPU_SERVING_MP_TINY=1 $(PY) benchmarks/serving_mp.py --flood
 
 health-smoke:
 	$(PY) tools/health_smoke.py
@@ -139,4 +151,4 @@ native:
 
 ci: lint bench-diff-selftest native test dryrun bench-dryrun \
 	client-bench ckpt-bench kernel-bench tier-bench serve-smoke \
-	mp-smoke health-smoke chaos
+	mp-smoke flood-smoke health-smoke chaos

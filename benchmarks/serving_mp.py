@@ -53,6 +53,25 @@ Emits (stdout JSON + ``serving_mp_bench.json``):
 mp-smoke`` budget. ``MVTPU_SERVING_MP_WORKERS`` overrides the
 training-lane worker count (default 2);
 ``MVTPU_SERVING_MP_OPS_WORKERS`` the ops-lane count (default 4).
+
+``--flood`` runs the OVERLOAD lane instead (``make flood-smoke``): a
+deliberate flooder client hammers a server armed with admission
+control (``--qos`` weighted-fair classes + a token bucket on the
+flooder's class, ``--queue`` bound) while protected workers train
+through the same dispatch thread. The parent merges the protected
+workers' per-step latencies into a real registry histogram and scores
+it against the armed ``MVTPU_SLO`` rule (default
+``serving.protected.p999<250ms``) through the actual SLO monitor —
+the ROADMAP item-2 acceptance, measured not vibed: the flooder is
+shed with retry-after (``server_shed_per_sec``), the protected p999
+holds (``serving_protected_p999_ms``, ``slo_violations == 0``), the
+queue depth stays bounded, and BOTH final tables are bit-exact
+integer-grid sums — a shed-then-resent add that double-applied would
+break the byte compare. Every give-up path (server death, worker
+hang, failed gate) still emits a *partial* flood JSON line with
+``"partial": true`` and the fields measured so far — the chip-probe
+contract (ROADMAP item 6): a lane that dies mid-run must leave a
+parseable artifact, never a null capture.
 """
 
 from __future__ import annotations
@@ -107,6 +126,26 @@ RTT_ROUNDS = 30 if TINY else 60
 STARTUP_S = 60.0
 LANE_TIMEOUT_S = 120.0
 
+# flood lane: one deliberately-misbehaving client vs protected
+# workers, through one admission-controlled dispatch thread. The
+# flooder's class is token-bucketed (rate/burst) AND outweighed 8:1;
+# integer-grid deltas keep both final tables bit-exact under any
+# shed/resend interleaving.
+FLOOD = ({"size": 512, "prot_steps": 80, "flood_steps": 240,
+          "prot_workers": 2}
+         if TINY else
+         {"size": 2048, "prot_steps": 200, "flood_steps": 800,
+          "prot_workers": 3})
+FLOOD_RATE = 400.0       # flooder bucket: requests/sec refill
+FLOOD_BURST = 16.0       # ...and capacity
+FLOOD_QUEUE = 64         # dispatch-queue bound (frames)
+FLOOD_QOS = (f"prot:match=prot-*,weight=8;"
+             f"flood:match=flood-*,weight=1,"
+             f"rate={FLOOD_RATE:g},burst={FLOOD_BURST:g}")
+# the armed rule; MVTPU_SLO overrides (same grammar the server's own
+# monitor reads)
+FLOOD_RULE_DEFAULT = "serving.protected.p999<250ms"
+
 
 def _load_transport():
     import importlib.util
@@ -120,6 +159,44 @@ def _load_transport():
     sys.modules[modname] = mod
     spec.loader.exec_module(mod)
     return mod
+
+
+def _load_slo():
+    """File-path-load the SLO monitor + metrics registry, jax-free.
+
+    ``telemetry/metrics.py`` and ``telemetry/watchdog.py`` are stdlib-
+    standalone by design; ``telemetry/slo.py`` imports them through the
+    package (``from multiverso_tpu.telemetry import ...``), so after
+    loading the two leaves we register stub package modules whose
+    attributes point at them — the import machinery resolves against
+    sys.modules and never touches ``multiverso_tpu/__init__`` (which
+    would drag jax into the bench parent)."""
+    import importlib.util
+    import types
+    transport = _load_transport()
+    metrics = transport._dep("multiverso_tpu.telemetry.metrics",
+                             "telemetry", "metrics.py")
+    watchdog = transport._dep("multiverso_tpu.telemetry.watchdog",
+                              "telemetry", "watchdog.py")
+    slo = sys.modules.get("multiverso_tpu.telemetry.slo")
+    if slo is not None:
+        return metrics, slo
+    for pkgname in ("multiverso_tpu", "multiverso_tpu.telemetry"):
+        if pkgname not in sys.modules:
+            pkg = types.ModuleType(pkgname)
+            pkg.__path__ = []
+            sys.modules[pkgname] = pkg
+    tele = sys.modules["multiverso_tpu.telemetry"]
+    tele.metrics = metrics
+    tele.watchdog = watchdog
+    spec = importlib.util.spec_from_file_location(
+        "multiverso_tpu.telemetry.slo",
+        os.path.join(PKG, "telemetry", "slo.py"))
+    slo = importlib.util.module_from_spec(spec)
+    sys.modules["multiverso_tpu.telemetry.slo"] = slo
+    spec.loader.exec_module(slo)
+    tele.slo = slo
+    return metrics, slo
 
 
 def make_dataset():
@@ -228,10 +305,84 @@ def run_ops_worker(address: str, lane: str, rank: int,
     print(json.dumps(out), flush=True)
 
 
+def flood_delta(rank: int) -> np.ndarray:
+    """Integer-grid delta for the flood lane (values in [1+rank,
+    5+rank]): every partial sum stays far below 2**24, so fp32 adds
+    are exact and the final tables expose ANY double-applied
+    shed-resend as a byte mismatch."""
+    size = FLOOD["size"]
+    return ((np.arange(size) % 5) + 1 + rank).astype(np.float32)
+
+
+def run_prot_worker(address: str, lane: str, rank: int,
+                    workers: int) -> None:
+    """One protected worker: sync get + pipelined add per step — the
+    per-step latency IS the protected-class tail the SLO rule holds,
+    measured while the flooder hammers the same dispatch thread."""
+    transport = _load_transport()
+    assert "jax" not in sys.modules, \
+        "worker process imported jax — the jax-free contract is broken"
+    transport._chaos.chaos_from_env()
+
+    client = transport.connect(address, client=f"{lane}-w{rank}",
+                               quant=None, seed=7000 + rank)
+    table = client.create_array("w_prot", FLOOD["size"],
+                                updater="default")
+    delta = flood_delta(rank)
+    table.get()     # warm the connection outside the window
+    lat_ms: List[float] = []
+    t_start = time.time()
+    for _ in range(FLOOD["prot_steps"]):
+        t0 = time.perf_counter()
+        table.get()
+        table.add(delta)
+        lat_ms.append((time.perf_counter() - t0) * 1e3)
+    client.drain()
+    out = {"rank": rank, "lane": lane, "steps": FLOOD["prot_steps"],
+           "sheds": client.sheds, "reconnects": client.reconnects,
+           "tx_bytes": client.tx_bytes, "t_start": t_start,
+           "t_end": time.time(),
+           "lat_ms": [round(v, 4) for v in lat_ms]}
+    client.close()
+    print(json.dumps(out), flush=True)
+
+
+def run_flood_worker(address: str, lane: str, rank: int,
+                     workers: int) -> None:
+    """The deliberate flooder: pipelined adds as fast as the transport
+    lets it. The admission layer sheds it down to its bucket rate; the
+    client honors every retry-after and resends identical bytes, so
+    despite heavy shedding every add still applies exactly once."""
+    transport = _load_transport()
+    assert "jax" not in sys.modules, \
+        "worker process imported jax — the jax-free contract is broken"
+    transport._chaos.chaos_from_env()
+
+    client = transport.connect(address, client=f"{lane}-w{rank}",
+                               quant=None, seed=9000 + rank)
+    table = client.create_array("w_flood", FLOOD["size"],
+                                updater="default")
+    delta = flood_delta(100 + rank)
+    t_start = time.time()
+    t0 = time.perf_counter()
+    for _ in range(FLOOD["flood_steps"]):
+        table.add(delta)
+    client.drain()
+    wall = time.perf_counter() - t0
+    out = {"rank": rank, "lane": lane, "adds": FLOOD["flood_steps"],
+           "sheds": client.sheds, "reconnects": client.reconnects,
+           "wall_s": wall, "tx_bytes": client.tx_bytes,
+           "t_start": t_start, "t_end": time.time()}
+    client.close()
+    print(json.dumps(out), flush=True)
+
+
 # -- parent orchestration --------------------------------------------------
 
 def _start_server(tmpdir: str, name: str, addresses: List[str],
-                  fuse: Optional[int] = None) -> tuple:
+                  fuse: Optional[int] = None,
+                  qos: Optional[str] = None,
+                  queue: Optional[int] = None) -> tuple:
     """Start one server subprocess; returns (proc, {scheme: bound})."""
     ready = os.path.join(tmpdir, f"ready-{name}")
     env = dict(os.environ, JAX_PLATFORMS="cpu",
@@ -242,6 +393,10 @@ def _start_server(tmpdir: str, name: str, addresses: List[str],
            "--name", name]
     if fuse is not None:
         cmd += ["--fuse", str(fuse)]
+    if qos is not None:
+        cmd += ["--qos", qos]
+    if queue is not None:
+        cmd += ["--queue", str(queue)]
     proc = subprocess.Popen(cmd, env=env, cwd=REPO)
     deadline = time.monotonic() + STARTUP_S
     while not os.path.exists(ready):
@@ -270,11 +425,8 @@ def _stop_server(proc) -> None:
             proc.kill()
 
 
-def _run_lane(address: str, lane: str, quant: Optional[str],
-              *, mode: str = "train",
-              workers: Optional[int] = None) -> Dict[str, object]:
-    n = workers if workers is not None else N_WORKERS
-    t0 = time.perf_counter()
+def _spawn_workers(address: str, lane: str, mode: str, n: int,
+                   quant: Optional[str] = None) -> list:
     procs = []
     for rank in range(n):
         cmd = [sys.executable, os.path.abspath(__file__), "--worker",
@@ -284,6 +436,10 @@ def _run_lane(address: str, lane: str, quant: Optional[str],
             cmd += ["--quant", quant]
         procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                       text=True))
+    return procs
+
+
+def _collect(procs: list, lane: str) -> List[dict]:
     results = []
     for p in procs:
         try:
@@ -296,6 +452,16 @@ def _run_lane(address: str, lane: str, quant: Optional[str],
             raise SystemExit(f"serving_mp: lane {lane!r} worker failed "
                              f"(rc={p.returncode})")
         results.append(json.loads(out.strip().splitlines()[-1]))
+    return results
+
+
+def _run_lane(address: str, lane: str, quant: Optional[str],
+              *, mode: str = "train",
+              workers: Optional[int] = None) -> Dict[str, object]:
+    n = workers if workers is not None else N_WORKERS
+    t0 = time.perf_counter()
+    procs = _spawn_workers(address, lane, mode, n, quant)
+    results = _collect(procs, lane)
     wall_s = time.perf_counter() - t0
     agg = {"lane": lane, "wall_s": wall_s, "workers": results,
            "tx_bytes": sum(r["tx_bytes"] for r in results)}
@@ -367,6 +533,144 @@ def _rtt_pair(tcp_address: str, shm_address: str
         client.close()
     return (float(np.median(tcp_s) * 1e6),
             float(np.median(shm_s) * 1e6))
+
+
+# -- flood lane (overload & admission control) -----------------------------
+
+def _emit_flood(line: Dict[str, object]) -> None:
+    out = os.environ.get("MVTPU_FLOOD_BENCH_JSON",
+                         "serving_mp_flood.json")
+    with open(out, "w") as f:
+        json.dump(line, f, indent=1)
+    print(json.dumps(line), flush=True)
+
+
+def _flood_run(line: Dict[str, object], rule_spec: str) -> None:
+    """The flood scenario body; fills ``line`` incrementally so a
+    give-up at any stage still has every field measured so far."""
+    transport = _load_transport()
+    metrics_mod, slo_mod = _load_slo()
+    rules = slo_mod.parse_slo(rule_spec)   # parse BEFORE spending time
+    with tempfile.TemporaryDirectory(prefix="mvtpu_flood_") as tmpdir:
+        line["flood_stage"] = "server-start"
+        server, addrs = _start_server(
+            tmpdir, "flood",
+            ["unix:" + os.path.join(tmpdir, "flood.sock")],
+            qos=FLOOD_QOS, queue=FLOOD_QUEUE)
+        try:
+            addr = addrs["unix"]
+            line["flood_stage"] = "flooding"
+            t0 = time.perf_counter()
+            flood_procs = _spawn_workers(addr, "flood", "flood", 1)
+            # let the flood establish before the protected window
+            time.sleep(0.25 if TINY else 0.5)
+            prot_procs = _spawn_workers(addr, "prot", "prot",
+                                        FLOOD["prot_workers"])
+            prot = _collect(prot_procs, "prot")
+            flood = _collect(flood_procs, "flood")
+            wall_s = time.perf_counter() - t0
+            line["flood_stage"] = "score"
+            scorer = transport.connect(addr, client="scorer",
+                                       quant=None)
+            admission = scorer.call(
+                "stats", {})[0]["status"]["admission"]
+            prot_final = scorer.create_array(
+                "w_prot", FLOOD["size"], updater="default").get()
+            flood_final = scorer.create_array(
+                "w_flood", FLOOD["size"], updater="default").get()
+            scorer.shutdown_server()
+            scorer.close()
+        finally:
+            _stop_server(server)
+
+    lat = np.asarray([v for r in prot for v in r["lat_ms"]])
+    p999 = float(np.percentile(lat, 99.9))
+    flood_sheds = sum(r["sheds"] for r in flood)
+    # headline = SLO margin (bound / measured p999): higher is better,
+    # so the generic `value` watch in bench_diff points the right way;
+    # the raw tail is watched lower-is-better under its own key
+    margin = rules[0].bound_s * 1e3 / max(p999, 1e-9)
+    line.update({
+        "value": round(margin, 2),
+        "serving_protected_slo_margin": round(margin, 2),
+        "serving_protected_p999_ms": round(p999, 3),
+        "serving_protected_p50_ms": round(
+            float(np.percentile(lat, 50)), 3),
+        "server_shed_per_sec": round(
+            admission["shed"] / max(wall_s, 1e-9), 1),
+        "server_shed_total": admission["shed"],
+        "serving_flood_sheds": flood_sheds,
+        "serving_prot_sheds": sum(r["sheds"] for r in prot),
+        "serving_flood_adds_per_sec": round(
+            sum(r["adds"] for r in flood)
+            / max(max(r["wall_s"] for r in flood), 1e-9), 1),
+        "admission_queue_depth": admission["queue"]["depth"],
+        "admission_queue_bound": admission["queue"]["bound"],
+        "flood_reconnects": sum(r["reconnects"]
+                                for r in prot + flood),
+    })
+
+    # -- the acceptance gates ---------------------------------------------
+    assert flood_sheds > 0, \
+        "the flooder was never shed — admission control is not engaging"
+    assert admission["shed"] >= flood_sheds, \
+        f"server shed ledger {admission['shed']} < flooder-observed " \
+        f"{flood_sheds}"
+    depth = admission["queue"]["depth"]
+    assert depth <= FLOOD_QUEUE, \
+        f"dispatch queue depth {depth} exceeds the bound {FLOOD_QUEUE}"
+    # exactly-once under shedding: both tables bit-exact integer sums
+    expected_prot = np.zeros(FLOOD["size"], np.float32)
+    for rank in range(FLOOD["prot_workers"]):
+        expected_prot += FLOOD["prot_steps"] * flood_delta(rank)
+    assert prot_final.tobytes() == expected_prot.tobytes(), \
+        "protected table != exact expectation — an add was lost or " \
+        "double-applied under flood"
+    expected_flood = (FLOOD["flood_steps"]
+                      * flood_delta(100)).astype(np.float32)
+    assert flood_final.tobytes() == expected_flood.tobytes(), \
+        "flooder table != exact expectation — a shed-resent add was " \
+        "lost or double-applied"
+
+    # -- the armed SLO rule, scored by the real monitor --------------------
+    hist = metrics_mod.histogram("serving.protected.seconds",
+                                 bounds=metrics_mod.LATENCY_BUCKETS,
+                                 klass="prot")
+    for v in lat:
+        hist.observe(float(v) / 1e3)
+    monitor = slo_mod.SloMonitor(rules, every_s=3600.0)
+    violations = monitor.check_once()
+    line["slo_violations"] = len(violations)
+    assert not violations, \
+        f"protected-class SLO violated under flood: {violations}"
+
+
+def flood_main() -> None:
+    """``--flood``: the overload lane. See the module docstring; the
+    partial-JSON contract lives HERE — any exception (worker hang,
+    server death, failed gate) still emits the line before the
+    nonzero exit."""
+    rule_spec = (os.environ.get("MVTPU_SLO", "").strip()
+                 or FLOOD_RULE_DEFAULT)
+    line: Dict[str, object] = {
+        "metric": "serving_protected_slo_margin",
+        "value": -1.0,          # -1 = not measured (partial give-up)
+        "unit": "x",
+        "tiny": TINY,
+        "partial": True,
+        "flood_qos": FLOOD_QOS,
+        "flood_queue": FLOOD_QUEUE,
+        "slo_rule": rule_spec,
+    }
+    try:
+        _flood_run(line, rule_spec)
+    except BaseException as e:
+        line["giveup"] = f"{type(e).__name__}: {e}"
+        _emit_flood(line)
+        raise
+    line["partial"] = False
+    line.pop("flood_stage", None)
+    _emit_flood(line)
 
 
 def main() -> None:
@@ -515,10 +819,13 @@ def main() -> None:
 if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--worker", action="store_true")
+    parser.add_argument("--flood", action="store_true",
+                        help="run the overload/admission lane instead "
+                             "of the training+hot-path lanes")
     parser.add_argument("--address")
     parser.add_argument("--lane", default="dense")
     parser.add_argument("--mode", default="train",
-                        choices=("train", "ops"))
+                        choices=("train", "ops", "prot", "flood"))
     parser.add_argument("--rank", type=int, default=0)
     parser.add_argument("--workers", type=int, default=N_WORKERS)
     parser.add_argument("--quant", default=None)
@@ -527,8 +834,16 @@ if __name__ == "__main__":
         if args.mode == "ops":
             run_ops_worker(args.address, args.lane, args.rank,
                            args.workers)
+        elif args.mode == "prot":
+            run_prot_worker(args.address, args.lane, args.rank,
+                            args.workers)
+        elif args.mode == "flood":
+            run_flood_worker(args.address, args.lane, args.rank,
+                             args.workers)
         else:
             run_worker(args.address, args.lane, args.rank,
                        args.workers, args.quant)
+    elif args.flood:
+        flood_main()
     else:
         main()

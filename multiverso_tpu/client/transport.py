@@ -33,6 +33,20 @@ unacked mutation; the server dedups by (client id, request id).
 :class:`~multiverso_tpu.ft.chaos.ChaosCrash` is a BaseException and is
 NEVER retried — a simulated process kill stays a kill.
 
+Overload is distinct from failure. A server shedding load replies
+``{ok:false, shed:true, retry_after_ms}`` (see
+``server/admission.py``); the client honors the contract instead of
+escalating: sleep the hint, resend the IDENTICAL bytes (same rid, same
+already-quantized arrays — the dedup cache keeps exactly-once effect),
+and treat the shed as *progress* in the reconnect retry loop (a
+shedding server is an alive server: no reconnect, no attempt-budget
+burn). Cumulative retry-after waits without a single ack are bounded
+by the retry policy's deadline. Requests can carry a client-stamped
+``deadline`` (``MVTPU_WIRE_DEADLINE_S`` or ``deadline_s=``, epoch
+seconds on the wire) that the server checks at dispatch dequeue —
+expired requests come back ``{ok:false, expired:true}`` as a
+:class:`RemoteError`, never silently dropped.
+
 The client talks to a transport-agnostic **Channel**
 (:func:`multiverso_tpu.server.wire.dial_channel`): ``unix:``/``tcp:``
 addresses get socket frames, ``shm://`` addresses negotiate the
@@ -61,6 +75,7 @@ import collections
 import os
 import sys
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -176,13 +191,19 @@ class WireClient:
     def __init__(self, address: str, *, client: Optional[str] = None,
                  quant: Optional[str] = "env",
                  seed: Optional[int] = None,
-                 retry_policy=None) -> None:
+                 retry_policy=None,
+                 deadline_s="env") -> None:
         self.address = address
         self.client_id = client or f"pid{os.getpid()}"
         self.quant = wire.quant_mode_from_env() if quant == "env" \
             else quant
         self.block = wire.wire_block()
         self.residuals = wire.ResidualStore()
+        if deadline_s == "env":
+            raw = os.environ.get(wire.DEADLINE_ENV, "").strip()
+            self.deadline_s = float(raw) if raw else None
+        else:
+            self.deadline_s = float(deadline_s) if deadline_s else None
         self._rng = np.random.default_rng(seed)
         self._policy = retry_policy if retry_policy is not None \
             else wire_retry_policy()
@@ -191,9 +212,12 @@ class WireClient:
         self._rid = 0
         self._pending: "collections.deque[_Pending]" = collections.deque()
         self._acked_rid = 0
+        self._max_ack = 0
         self.tx_bytes = 0
         self.rx_bytes = 0
         self.reconnects = 0
+        self.sheds = 0              # shed replies honored (bench reads)
+        self._shed_wait_s = 0.0     # retry-after slept since last ack
         self._closed = False
         self._retry_loop(self._ensure_connected)
 
@@ -203,12 +227,18 @@ class WireClient:
         under a wire storm each reconnect drains part of the pending
         window before dying, and steady progress must not exhaust a
         fixed attempt count — while a genuinely dead server (no
-        progress) still fails loudly after ``max_attempts``."""
+        progress) still fails loudly after ``max_attempts``.
+
+        A shed reply counts as progress too: a server shedding load is
+        an ALIVE server telling this client to back off — escalating
+        that to the reconnect budget would tear down the very pipeline
+        the shed was protecting."""
         import time as _time
         policy = self._policy
         t0 = _time.monotonic()
         attempt = 0
         last_acked = self._acked_rid
+        last_sheds = self.sheds
         while True:
             try:
                 return fn()
@@ -217,8 +247,10 @@ class WireClient:
             except (ConnectionError, OSError) as exc:
                 self._mark_dead()
                 self._count("retry.attempts", policy=policy.name)
-                if self._acked_rid > last_acked:
+                if self._acked_rid > last_acked \
+                        or self.sheds > last_sheds:
                     last_acked = self._acked_rid
+                    last_sheds = self.sheds
                     attempt = 0
                 attempt += 1
                 elapsed = _time.monotonic() - t0
@@ -292,8 +324,9 @@ class WireClient:
         # budget whenever the acked rid advances)
         while self._pending:
             p = self._pending[0]
-            self._tx(chan, p.header, p.arrays)
-            p.sent = True
+            if not p.sent:      # a shed mid-replay already resent it
+                self._tx(chan, p.header, p.arrays)
+                p.sent = True
             header, _, nbytes = chan.recv()
             self.rx_bytes += nbytes
             self._consume_ack(header)
@@ -322,22 +355,91 @@ class WireClient:
         return header, arrays
 
     def _consume_ack(self, header: Dict[str, Any]) -> None:
-        """Match an in-order reply against the pending window."""
+        """Match a reply against the pending window. Without shedding
+        acks arrive in rid order, but admission breaks that: when r1 is
+        shed and r2 admitted (a token accrued or a queue slot freed in
+        between), r2's dispatch ack reaches us while the window head is
+        still the resent r1. So BOTH shed replies and acks scan the
+        whole window; ``_acked_rid`` only advances past rids with no
+        pending mutation left at or below them."""
         rid = header.get("rid")
-        if self._pending and self._pending[0].rid == rid:
-            self._pending.popleft()
-            self._acked_rid = rid
+        if header.get("shed"):
+            self._honor_shed(rid, header)
+            return
+        for i, p in enumerate(self._pending):
+            if p.rid != rid:
+                continue
+            del self._pending[i]
+            self._max_ack = max(self._max_ack, rid)
+            if self._pending:
+                self._acked_rid = max(
+                    self._acked_rid,
+                    min(self._max_ack, self._pending[0].rid - 1))
+            else:
+                self._acked_rid = max(self._acked_rid, self._max_ack)
+            self._shed_wait_s = 0.0     # an ack = shed-wait progress
             if not header.get("ok"):
                 raise RemoteError(
                     f"remote add rid={rid} failed: "
                     f"{header.get('error')}")
+            return
 
-    def _recv_until(self, rid: int
+    def _honor_shed(self, rid, header: Dict[str, Any]) -> None:
+        """A shed reply is neither a failure nor a dead server: the
+        request was never applied (and never entered the dedup cache).
+        Honor the retry-after hint, then resend the IDENTICAL bytes —
+        same rid, same already-quantized arrays — so the server's
+        dedup keeps the exactly-once effect if both copies land."""
+        target = None
+        for p in self._pending:
+            if p.rid == rid:
+                target = p
+                break
+        if target is None:
+            return      # a sync call's shed: _recv_until resends it
+        target.sent = False
+        self._shed_backoff(header)
+        if self._chan is not None:
+            self._tx(self._chan, target.header, target.arrays)
+            target.sent = True
+
+    def _shed_backoff(self, header: Dict[str, Any]) -> None:
+        """Sleep the server's retry-after hint. Cumulative shed waits
+        without a single ack are bounded by the retry policy deadline —
+        a server that sheds forever still fails loudly, it just never
+        triggers a reconnect (it is alive)."""
+        self.sheds += 1
+        self._count("wire.client.sheds")
+        delay = max(float(header.get("retry_after_ms") or 0.0),
+                    0.0) / 1000.0
+        self._shed_wait_s += max(delay, 1e-4)
+        policy = self._policy
+        if policy.deadline_s > 0 \
+                and self._shed_wait_s > policy.deadline_s:
+            raise _retry.RetryError(
+                f"server shed {self.sheds} requests; cumulative "
+                f"retry-after wait {self._shed_wait_s:.2f}s exceeds "
+                f"the retry deadline {policy.deadline_s}s without an "
+                "ack")
+        if delay > 0:
+            time.sleep(delay)
+
+    def _recv_until(self, rid: int, resend=None
                     ) -> Tuple[Dict[str, Any], List[np.ndarray]]:
         while True:
             header, arrays = self._recv_reply()
             got = header.get("rid")
             if got == rid:
+                if header.get("shed"):
+                    if any(p.rid == rid for p in self._pending):
+                        self._consume_ack(header)   # pipelined target
+                    else:
+                        # sync request shed: back off, resend the same
+                        # bytes, keep waiting for the same rid
+                        self._shed_backoff(header)
+                        if resend is not None:
+                            resend()
+                    continue
                 # the target itself may also be a pending mutation
                 self._consume_ack(header)
                 if not header.get("ok"):
@@ -356,13 +458,20 @@ class WireClient:
             req = dict(header or {})
             req["op"] = op
             req["rid"] = self._next_rid()
+            if self.deadline_s:
+                # stamped ONCE: shed/reconnect resends keep the
+                # original expiry (a deadline is end-to-end)
+                wire.stamp_deadline(req, self.deadline_s)
             arrays = [np.ascontiguousarray(a) for a in arrays]
 
             def attempt():
                 try:
                     self._ensure_connected()
                     self._tx(self._chan, req, arrays)
-                    return self._recv_until(req["rid"])
+                    return self._recv_until(
+                        req["rid"],
+                        resend=lambda: self._tx(self._chan, req,
+                                                arrays))
                 except (ConnectionError, OSError):
                     self._mark_dead()
                     raise
@@ -376,6 +485,8 @@ class WireClient:
             rid = self._next_rid()
             req = dict(header)
             req["rid"] = rid
+            if self.deadline_s:
+                wire.stamp_deadline(req, self.deadline_s)
             p = _Pending(rid, req,
                          [np.ascontiguousarray(a) for a in arrays])
             self._pending.append(p)
@@ -655,7 +766,10 @@ class DeltaBatcher:
 
 def connect(address: str, *, client: Optional[str] = None,
             quant: Optional[str] = "env",
-            seed: Optional[int] = None) -> WireClient:
-    """Dial a table server; ``quant="env"`` reads ``MVTPU_WIRE_QUANT``
-    (pass ``None``/"1bit"/"int8" to override)."""
-    return WireClient(address, client=client, quant=quant, seed=seed)
+            seed: Optional[int] = None,
+            deadline_s="env") -> WireClient:
+    """Dial a table server; ``quant="env"`` reads ``MVTPU_WIRE_QUANT``,
+    ``deadline_s="env"`` reads ``MVTPU_WIRE_DEADLINE_S`` (pass a float
+    to stamp every request with that deadline, ``None`` for none)."""
+    return WireClient(address, client=client, quant=quant, seed=seed,
+                      deadline_s=deadline_s)

@@ -98,6 +98,21 @@ Fault points in the codebase (grep ``chaos_point(`` for ground truth):
                       exercises the per-frame fallback: affected
                       requests re-run individually, the dispatch
                       thread never dies
+``server.flood``      frame intake on a server reader thread
+                      (`server/table_server.py`) — an ``error``/
+                      ``drop`` firing injects a burst of 32 synthetic
+                      ``noop`` frames from client ``chaos-flood``
+                      AHEAD of the real frame, driving the admission
+                      layer (token buckets, fair queue, bounded-queue
+                      shedding) exactly like a real flooder; the real
+                      frame is never lost
+``server.dequeue``    one dispatch-cycle dequeue
+                      (`server/table_server.py`) — ``latency`` stalls
+                      the single dispatch thread (the overload the
+                      admission layer must absorb); ``error``/``drop``
+                      are contained (logged, the cycle proceeds) —
+                      the dispatch thread never dies; ``crash`` still
+                      models process death
 ====================  =====================================================
 
 The injector is process-global and OFF unless installed: fault points

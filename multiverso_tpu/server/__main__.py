@@ -17,6 +17,14 @@ Flags:
 ``--fuse K``
     drain + fuse up to K queued frames per dispatch cycle (default:
     ``MVTPU_SERVER_FUSE`` env, else 1 = off).
+``--qos SPEC``
+    admission QoS classes (default: ``MVTPU_SERVER_QOS`` env, else
+    none — every client in one unlimited class). See
+    ``server/admission.py`` for the grammar.
+``--queue N``
+    bound on admitted-but-undispatched frames; excess load is shed
+    with a retry-after reply (default: ``MVTPU_SERVER_QUEUE`` env,
+    else 0 = unbounded).
 ``--ready-file PATH``
     after binding, atomically write the RESOLVED dialable address list
     here (comma-separated, same order as ``--address``). The launcher
@@ -40,6 +48,8 @@ def main(argv=None) -> int:
     parser.add_argument("--address", default="unix:/tmp/mvtpu.sock")
     parser.add_argument("--name", default="tables")
     parser.add_argument("--fuse", type=int, default=None)
+    parser.add_argument("--qos", default=None)
+    parser.add_argument("--queue", type=int, default=None)
     parser.add_argument("--ready-file", default=None)
     args = parser.parse_args(argv)
 
@@ -47,7 +57,8 @@ def main(argv=None) -> int:
     from multiverso_tpu.server.table_server import TableServer
 
     core.init()
-    server = TableServer(args.address, name=args.name, fuse=args.fuse)
+    server = TableServer(args.address, name=args.name, fuse=args.fuse,
+                         qos=args.qos, queue_bound=args.queue)
     bound = server.start()
 
     if args.ready_file:

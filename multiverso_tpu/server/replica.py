@@ -74,6 +74,8 @@ class TableReplica:
                                          server=server)
         self._c_misses = telemetry.counter("server.replica.misses",
                                            server=server)
+        self._c_degraded = telemetry.counter(
+            "server.replica.degraded_hits", server=server)
 
     # -- dispatch-thread half ----------------------------------------------
 
@@ -159,12 +161,19 @@ class TableReplica:
 
     # -- reader-thread half ------------------------------------------------
 
-    def serve(self, header: Dict[str, Any], arrays: List[np.ndarray]
-              ) -> Optional[tuple]:
+    def serve(self, header: Dict[str, Any], arrays: List[np.ndarray],
+              relax: bool = False) -> Optional[tuple]:
         """Serve one staleness-tolerant read on a READER thread, or
         return ``None`` (miss — the frame takes the dispatch queue and
         its handler calls :meth:`arm`/:meth:`refresh`). Never touches
-        jax."""
+        jax.
+
+        ``relax=True`` is degraded-mode routing (the admission layer is
+        shedding writes): a snapshot PAST the requested bound is served
+        anyway rather than queueing the read behind the very overload
+        being shed — the reply carries the real ``staleness`` plus a
+        ``degraded`` marker so the client can see the bound was
+        relaxed. No snapshot at all is still a miss."""
         try:
             bound = max(int(header.get("staleness")), 0)
         except (TypeError, ValueError):
@@ -175,13 +184,19 @@ class TableReplica:
             self._c_misses.inc()
             return None
         lag = max(self.table.generation - gen, 0)   # plain int reads
+        degraded = False
         if lag > bound:
-            self._c_misses.inc()
-            return None
+            if not relax:
+                self._c_misses.inc()
+                return None
+            degraded = True
+            self._c_degraded.inc()
         self._c_hits.inc()
         self._g_stale.set(float(lag))
         head = {"ok": True, "gen": gen, "replica": True,
                 "staleness": lag}
+        if degraded:
+            head["degraded"] = True
         if self.kind == "array":
             return (head, [value])
         keys = np.ascontiguousarray(arrays[0]).astype(np.uint64,

@@ -13,11 +13,29 @@ Thread topology per server::
 
     accept thread ──► per-conn reader ──┬─(staleness get: replica hit,
                       per-conn reader ──┤  answered right here)
-                      per-conn reader ──┼──► dispatch queue ─► ONE
-                                        │    dispatch thread (table ops,
-                                        │    FUSED up to MVTPU_SERVER_FUSE
-                                        │    frames per cycle)
+                      per-conn reader ──┼─► ADMISSION ─► fair dispatch
+                                        │   (classify,     queue ─► ONE
+                                        │    bucket,        dispatch
+                                        │    bound —        thread (table
+                                        │    shed replies   ops, FUSED up
+                                        │    answered       to MVTPU_
+                                        │    right here)    SERVER_FUSE)
                       per-conn writer ◄─┴──── replies (per-conn queues)
+
+Overload is a first-class state, not a failure (see
+:mod:`multiverso_tpu.server.admission`): reader threads run every data
+frame through the admission controller — per-client token buckets and
+a bounded queue shed excess load with a structured
+``{ok:false, shed:true, retry_after_ms}`` reply the client transport
+honors (sleep, resend identical bytes, dedup keeps it exactly-once) —
+and the dispatch queue itself is weighted-fair across QoS classes
+(``MVTPU_SERVER_QOS``), so one flooding client saturates its own lane
+while well-behaved classes keep their share of the dispatch thread.
+Client-stamped ``deadline`` headers are checked at dequeue: an expired
+request is answered ``{ok:false, expired:true}`` instead of executed.
+While mutations are being shed the server runs *degraded*:
+bounded-staleness reads divert to the replica path even past their
+bound (stale beats shed).
 
 The hot path is batched like the reference's server loop processes its
 message queue: each dispatch cycle drains up to ``MVTPU_SERVER_FUSE``
@@ -68,6 +86,7 @@ import numpy as np
 from multiverso_tpu import core
 from multiverso_tpu.ft import chaos as _chaos
 from multiverso_tpu.io import wiresock
+from multiverso_tpu.server import admission as _admission_mod
 from multiverso_tpu.server import wire
 from multiverso_tpu.server.replica import TableReplica
 from multiverso_tpu.telemetry import metrics as telemetry
@@ -103,6 +122,21 @@ _PRESUM_UPDATERS = ("default", "sgd")
 
 #: frames-per-cycle histogram bounds (server.fuse.batch)
 _FUSE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+
+#: synthetic frames one ``server.flood`` chaos firing injects ahead of
+#: the real frame (each is a ``noop`` from client ``chaos-flood``, so a
+#: QoS class can target and shed them like any real flooder)
+_FLOOD_BURST = 32
+_FLOOD_CLIENT = "chaos-flood"
+
+
+class _FloodConn:
+    """Stand-in connection for chaos-injected synthetic frames: never
+    alive, so replies (and shed replies) to the phantom are skipped."""
+
+    conn_id = 0
+    client_id = _FLOOD_CLIENT
+    alive = False
 
 #: live servers in this process, for the /statusz transport section
 _SERVERS: List["TableServer"] = []
@@ -185,7 +219,9 @@ class TableServer:
     """
 
     def __init__(self, address: str, *, name: str = "tables",
-                 fuse: Optional[int] = None) -> None:
+                 fuse: Optional[int] = None,
+                 qos: Optional[str] = None,
+                 queue_bound: Optional[int] = None) -> None:
         self.name = name
         self._addresses = [a.strip() for a in str(address).split(",")
                            if a.strip()]
@@ -195,7 +231,13 @@ class TableServer:
         self._listeners: List[socket.socket] = []
         self._conns: Dict[int, _Conn] = {}
         self._conns_lock = threading.Lock()
-        self._dispatchq: "queue.Queue" = queue.Queue()
+        # the dispatch queue IS the admission controller: per-class
+        # weighted-fair lanes + token buckets + the MVTPU_SERVER_QUEUE
+        # bound, with the plain-Queue surface the dispatch loop drains
+        self._admission = _admission_mod.AdmissionController(
+            qos=qos, queue_bound=queue_bound, server=name)
+        self._dispatchq = self._admission
+        self._flood_conn = _FloodConn()
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
         self._tables: Dict[int, Any] = {}
@@ -297,6 +339,7 @@ class TableServer:
                 "connections": n_conns, "tables": len(self._tables),
                 "ops": self._ops, "fuse": self._fuse,
                 "queued": self._dispatchq.qsize(),
+                "admission": self._admission.status(),
                 "replicas": [rep.status()
                              for rep in self._replicas.values()]}
 
@@ -377,7 +420,12 @@ class TableServer:
             if header.get("staleness") is not None \
                     and header.get("op") in ("get", "kv_get"):
                 try:
-                    reply = self._serve_replica(header, arrays)
+                    # degraded-mode routing: while writes are being
+                    # shed, serve from the replica even past the
+                    # requested bound — a stale read beats a shed one
+                    reply = self._serve_replica(
+                        header, arrays,
+                        relax=self._admission.degraded())
                 except Exception:   # noqa: BLE001 — containment: a
                     reply = None    # replica bug degrades to dispatch
                 if reply is not None:
@@ -385,16 +433,41 @@ class TableServer:
                     rheader.setdefault("rid", header.get("rid"))
                     conn.sendq.put((rheader, rarrays))
                     continue
-            self._dispatchq.put((conn, header, arrays,
-                                 time.monotonic()))
+            self._intake(conn, header, arrays)
         self._drop_conn(conn)
 
+    def _intake(self, conn: _Conn, header: Dict[str, Any],
+                arrays: List[np.ndarray]) -> None:
+        """Admission front-end for one frame (reader thread): chaos
+        flood injection, then classify → bucket → bound. Admitted
+        frames enter the fair queue; shed frames are answered right
+        here with the structured retry-after reply — the dispatch
+        thread never sees them."""
+        try:
+            _chaos.chaos_point("server.flood")
+        except _chaos.ChaosError as exc:
+            log.warn("server.flood chaos: %d synthetic frames ahead "
+                     "of conn %d: %s", _FLOOD_BURST, conn.conn_id, exc)
+            for _ in range(_FLOOD_BURST):
+                fh = {"op": "noop", "flood": True}
+                self._admission.offer(
+                    _FLOOD_CLIENT, fh,
+                    (self._flood_conn, fh, [], time.monotonic()))
+        shed = self._admission.offer(
+            conn.client_id, header,
+            (conn, header, arrays, time.monotonic()))
+        if shed is not None:
+            shed["rid"] = header.get("rid")
+            if conn.alive:
+                conn.sendq.put((shed, []))
+
     def _serve_replica(self, header: Dict[str, Any],
-                       arrays: List[np.ndarray]) -> Optional[tuple]:
+                       arrays: List[np.ndarray],
+                       relax: bool = False) -> Optional[tuple]:
         rep = self._replicas.get(int(header.get("table", -1)))
         if rep is None:
             return None
-        return rep.serve(header, arrays)
+        return rep.serve(header, arrays, relax=relax)
 
     def _write_loop(self, conn: _Conn) -> None:
         while True:
@@ -421,6 +494,14 @@ class TableServer:
             item = self._dispatchq.get()
             if item is None:
                 return
+            try:
+                # latency here models a slow dispatch thread (the
+                # overload the admission layer absorbs); error/drop
+                # are contained — a chaos fault at dequeue must never
+                # kill the one dispatch thread
+                _chaos.chaos_point("server.dequeue")
+            except _chaos.ChaosError as exc:
+                log.warn("server.dequeue chaos contained: %s", exc)
             batch = [item]
             stop_after = False
             while len(batch) < self._fuse:
@@ -437,6 +518,9 @@ class TableServer:
             now = time.monotonic()
             for _, _, _, enq_ts in batch:
                 self._h_age.observe(max(now - enq_ts, 0.0))
+            # client-stamped deadlines check at DEQUEUE: an expired
+            # request is dead work — answer it, don't execute it
+            batch = [it for it in batch if not self._drop_expired(it)]
             if len(batch) == 1:
                 conn, header, arrays, _ = batch[0]
                 op = str(header.get("op", "?"))
@@ -444,10 +528,28 @@ class TableServer:
                 reply = self._safe_execute(conn, op, header, arrays)
                 self._finish(conn, op, header.get("rid"), reply, t0,
                              h_dispatch)
-            else:
+            elif batch:
                 self._run_fused_batch(batch, h_dispatch)
             if stop_after:
                 return
+
+    def _drop_expired(self, item: tuple) -> bool:
+        """Drop one already-expired frame at dequeue: reply a
+        structured expired error (never applied, never cached — a
+        resend with a fresh deadline would be a NEW request to the
+        dedup layer only if the client re-rids it; the transport does
+        not resend expired requests at all)."""
+        conn, header, _arrays, _ts = item
+        if not wire.deadline_expired(header):
+            return False
+        self._admission.note_expired()
+        if conn.alive:
+            conn.sendq.put(({"ok": False, "expired": True,
+                             "rid": header.get("rid"),
+                             "error": "deadline exceeded before "
+                                      "dispatch (op "
+                                      f"{header.get('op')!r})"}, []))
+        return True
 
     def _safe_execute(self, conn: _Conn, op: str,
                       header: Dict[str, Any], arrays: List[np.ndarray],
@@ -696,6 +798,10 @@ class TableServer:
                      "server": self.name,
                      "quant": wire.quant_mode_from_env()}, [])
         if op == "ping":
+            return ({"ok": True}, [])
+        if op == "noop":
+            # admission-controlled no-op: what the server.flood chaos
+            # point injects (a control op would jump the fair queue)
             return ({"ok": True}, [])
         if op == "stats":
             return ({"ok": True, "status": self.status()}, [])

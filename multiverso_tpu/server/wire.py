@@ -18,6 +18,12 @@ Frame layout (little-endian)::
   stored: both ends derive them from the same rule (each payload
   8-byte aligned, in header order), which keeps the header free of a
   circular offsets-change-header-length dependency.
+- An optional ``deadline`` header field carries a client-stamped
+  absolute expiry in **epoch seconds** (``time.time()`` — wall-clock,
+  the only base comparable across processes; monotonic clocks are
+  per-process). The server drops already-expired requests at dispatch
+  dequeue instead of doing dead work (:func:`stamp_deadline` /
+  :func:`deadline_expired` are the shared convention).
 - Payloads are raw array bytes. **Encoding** gather-writes the header
   and each array's buffer straight to the socket (``sendmsg`` — no
   join copy); **decoding** reads the body into ONE buffer and returns
@@ -57,6 +63,7 @@ import os
 import struct
 import sys
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -133,6 +140,42 @@ def wire_block() -> int:
     except ValueError:
         block = 512
     return max(8, (block // 8) * 8)
+
+
+# -- deadline propagation --------------------------------------------------
+# Client-stamped request expiry in the frame header. Epoch seconds on
+# purpose: a deadline must compare across processes (client stamps,
+# server checks), and time.monotonic() bases differ per process. Clock
+# skew between same-host processes is microseconds — far below any
+# useful request deadline.
+
+DEADLINE_KEY = "deadline"
+DEADLINE_ENV = "MVTPU_WIRE_DEADLINE_S"
+
+
+def stamp_deadline(header: Dict[str, Any], timeout_s: float,
+                   now: Optional[float] = None) -> Dict[str, Any]:
+    """Stamp an absolute expiry ``timeout_s`` from now into ``header``
+    (no-op if the caller already stamped one — a resend must keep its
+    original bytes)."""
+    if DEADLINE_KEY not in header:
+        header[DEADLINE_KEY] = (time.time() if now is None else now) \
+            + float(timeout_s)
+    return header
+
+
+def deadline_expired(header: Dict[str, Any],
+                     now: Optional[float] = None) -> bool:
+    """True when the header carries a deadline that has passed.
+    Unparseable deadlines count as absent (a malformed field must not
+    turn into silent request drops)."""
+    raw = header.get(DEADLINE_KEY)
+    if raw is None:
+        return False
+    try:
+        return (time.time() if now is None else now) > float(raw)
+    except (TypeError, ValueError):
+        return False
 
 
 # -- frame codec -----------------------------------------------------------

@@ -101,7 +101,12 @@ DEFAULT_WATCH_LOWER = ("serving_p99_ms",
                        # same-host shm-ring round trip (serving_mp's
                        # staleness-read probe) — a rise means the ring
                        # transport lost its edge over tcp loopback
-                       "shm_rtt_us")
+                       "shm_rtt_us",
+                       # flood lane (serving_mp --flood): protected-
+                       # class p999 under a deliberate flooder — a rise
+                       # means admission control stopped insulating
+                       # well-behaved clients from the flood
+                       "serving_protected_p999_ms")
 
 
 def _flatten(prefix: str, obj, out: Dict[str, float]) -> None:
@@ -419,6 +424,27 @@ def selftest() -> int:
         hp_doc3["tcp_rtt_us"] = 900.0                   # unwatched rise
         assert main([hp_old, put("hp_fast.json", hp_doc3)]) == 0, \
             "a faster shm ring passes; tcp baseline rides unwatched"
+        # flood lane lines: the protected-class p999 under a deliberate
+        # flood is LOWER-is-better — admission control losing its grip
+        # shows up as a tail rise, while the shed rate rides unwatched
+        fl_old = put("fl_old.json", {
+            "metric": "serving_protected_slo_margin", "value": 6.2,
+            "unit": "x", "serving_protected_slo_margin": 6.2,
+            "serving_protected_p999_ms": 40.0,
+            "server_shed_per_sec": 900.0, "slo_violations": 0.0})
+        fl_doc = json.loads(json.dumps(json.load(open(fl_old))))
+        fl_doc["serving_protected_p999_ms"] = 160.0     # 4x slower
+        fl_doc["serving_protected_slo_margin"] = 1.6
+        fl_doc["value"] = 1.6
+        assert main([fl_old, put("fl_slow.json", fl_doc)]) == 1, \
+            "protected p999 rise under flood must fail (lower is better)"
+        fl_doc2 = json.loads(json.dumps(json.load(open(fl_old))))
+        fl_doc2["serving_protected_p999_ms"] = 10.0     # faster
+        fl_doc2["serving_protected_slo_margin"] = 25.0
+        fl_doc2["value"] = 25.0
+        fl_doc2["server_shed_per_sec"] = 100.0          # unwatched drop
+        assert main([fl_old, put("fl_fast.json", fl_doc2)]) == 0, \
+            "a faster protected tail passes; shed rate rides unwatched"
         # unusable inputs exit 2, not a traceback
         hung = put("hung.json", {"rc": 124, "tail": "...", "parsed": None})
         assert main([hung, raw_ok]) == 2, "no parsed line -> exit 2"
