@@ -59,6 +59,15 @@
 #                   final tables stay bit-exact (no shed-resent add
 #                   double-applies); emits serving_mp_flood.json —
 #                   a partial line on every give-up path
+#   make fleet-smoke - sharded-fleet smoke: 2 partitioned server
+#                   processes behind the scatter-gather router vs one
+#                   server, jax-free workers on the range-read serving
+#                   lane; asserts fleet >= 1.5x single aggregate ops/s,
+#                   both finals bit-exact, /statusz?fleet=1 aggregates
+#                   both partitions, and SIGKILLing one member leaves
+#                   the surviving shard serving; emits
+#                   serving_mp_fleet.json — a partial line on every
+#                   give-up path
 #   make chaos    - the chaos lane: fault-injection test subset
 #                   (ft subsystem + overwrite crash-window fuzz) plus a
 #                   CLI checkpoint/resume smoke under an active
@@ -72,7 +81,8 @@ NEW ?= BENCH_r05.json
 
 .PHONY: test dryrun bench bench-dryrun bench-diff bench-diff-selftest \
 	client-bench ckpt-bench kernel-bench tier-bench serve-smoke \
-	mp-smoke flood-smoke health-smoke chaos fuzz lint native ci
+	mp-smoke flood-smoke fleet-smoke health-smoke chaos fuzz lint \
+	native ci
 
 fuzz:
 	$(PY) tests/deep_fuzz.py
@@ -113,6 +123,9 @@ mp-smoke:
 flood-smoke:
 	MVTPU_SERVING_MP_TINY=1 $(PY) benchmarks/serving_mp.py --flood
 
+fleet-smoke:
+	MVTPU_SERVING_MP_TINY=1 $(PY) benchmarks/serving_mp.py --servers 2
+
 health-smoke:
 	$(PY) tools/health_smoke.py
 
@@ -151,4 +164,4 @@ native:
 
 ci: lint bench-diff-selftest native test dryrun bench-dryrun \
 	client-bench ckpt-bench kernel-bench tier-bench serve-smoke \
-	mp-smoke flood-smoke health-smoke chaos
+	mp-smoke flood-smoke fleet-smoke health-smoke chaos

@@ -72,6 +72,26 @@ hang, failed gate) still emits a *partial* flood JSON line with
 ``"partial": true`` and the fields measured so far — the chip-probe
 contract (ROADMAP item 6): a lane that dies mid-run must leave a
 parseable artifact, never a null capture.
+
+``--servers N`` runs the SHARDED FLEET lane instead (``make
+fleet-smoke``): the same workload against ``--fleet N`` (N server
+processes, each owning 1/N of every table, reached through the
+scatter-gather ``FleetClient``) and against ``--fleet 1``, with
+jax-free fleet workers. The untimed phase scatters integer-grid dense
+adds and routed KV adds (the bit-exact basis); the timed serving
+window is staleness-bounded RANGE reads (``get_range``) of each
+worker's assigned half — a single server's wire ``get`` is a
+whole-table snapshot, so the range read ships every element there,
+while a fleet shard IS a range and ships 1/N of the bytes end to end
+(on multi-core hosts the per-server dispatch threads add real
+parallelism on top). Gates: fleet/single aggregate read throughput ≥
+``MVTPU_FLEET_RATIO`` (default 1.5), BOTH configs' final tables
+bit-exact against the integer-grid expectation and each other,
+``/statusz?fleet=1`` aggregation sane, and a SIGKILLed member costs
+only its own partition — the surviving shard still serves
+bit-exactly. Emits ``serving_fleet_ops_per_sec`` and
+``fleet_scaling_efficiency`` with the same partial-JSON give-up
+contract as the flood lane.
 """
 
 from __future__ import annotations
@@ -79,10 +99,13 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
 import tempfile
+import threading
 import time
+import urllib.request
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -146,6 +169,29 @@ FLOOD_QOS = (f"prot:match=prot-*,weight=8;"
 # monitor reads)
 FLOOD_RULE_DEFAULT = "serving.protected.p999<250ms"
 
+# fleet lane geometry: the dense table is sized so a range read's
+# payload bytes dominate per-frame fixed costs (the 1/N byte cut is
+# the measured effect); reads are spread over `read_threads` fleet
+# clients per worker so round-trip handoff latency overlaps and the
+# aggregate rate is work-bound, not wake-latency-bound
+FLEET = ({"size": 3 << 20, "adds": 4, "kv_adds": 3, "reads": 24,
+          "read_threads": 3, "kv_capacity": 4096, "kv_keys": 192,
+          "kv_dim": 4}
+         if TINY else
+         {"size": 1 << 22, "adds": 6, "kv_adds": 3, "reads": 40,
+          "read_threads": 3, "kv_capacity": 8192, "kv_keys": 384,
+          "kv_dim": 4})
+FLEET_WORKERS = int(os.environ.get("MVTPU_SERVING_MP_FLEET_WORKERS", "")
+                    or (2 if TINY else 4))
+FLEET_RATIO = float(os.environ.get("MVTPU_FLEET_RATIO", "") or 1.5)
+# the timed reads tolerate ANY staleness (like the RTT probe): workers
+# aren't phase-synchronized, so a tight bound would flip reads that
+# overlap a peer's add phase onto the slow dispatch path and bimodal
+# the measurement; the serving claim is throughput of replica-served
+# bounded-staleness reads, and correctness is gated on the final
+# fresh get() instead
+FLEET_STALENESS = 1 << 20
+
 
 def _load_transport():
     import importlib.util
@@ -155,6 +201,22 @@ def _load_transport():
         return mod
     spec = importlib.util.spec_from_file_location(
         modname, os.path.join(PKG, "client", "transport.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[modname] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _load_router():
+    """File-path-load the fleet router (which pulls transport +
+    partition through the same ``_dep`` machinery), jax-free."""
+    import importlib.util
+    modname = "multiverso_tpu.client.router"
+    mod = sys.modules.get(modname)
+    if mod is not None:
+        return mod
+    spec = importlib.util.spec_from_file_location(
+        modname, os.path.join(PKG, "client", "router.py"))
     mod = importlib.util.module_from_spec(spec)
     sys.modules[modname] = mod
     spec.loader.exec_module(mod)
@@ -302,6 +364,107 @@ def run_ops_worker(address: str, lane: str, rank: int,
            "add_wall_s": wall, "tx_bytes": client.tx_bytes,
            "transport": client.transport}
     client.close()
+    print(json.dumps(out), flush=True)
+
+
+def fleet_delta(rank: int) -> np.ndarray:
+    """Integer-grid dense delta for the fleet lane (values in [1+rank,
+    7+rank]): fp32 sums stay exact, so the single-server and fleet
+    finals must match to the BYTE whatever shard/fuse order applied
+    them."""
+    size = FLEET["size"]
+    return ((np.arange(size) % 7) + 1 + rank).astype(np.float32)
+
+
+def fleet_kv_keys(rank: int) -> np.ndarray:
+    """Each worker's disjoint KV key block (no in-batch duplicates;
+    the cross-worker union is deterministic for the exact
+    expectation). Keys still hash-scatter across shards."""
+    k = FLEET["kv_keys"]
+    base = 1 + rank * k
+    return np.arange(base, base + k, dtype=np.uint64)
+
+
+def fleet_kv_delta(keys: np.ndarray) -> np.ndarray:
+    """Integer-grid KV delta derived from the key itself, so the
+    expectation needs only the key multiset."""
+    vals = (keys % np.uint64(5)).astype(np.float32) + 1.0
+    cols = np.arange(FLEET["kv_dim"], dtype=np.float32)
+    return vals[:, None] + cols[None, :]
+
+
+def run_fleet_worker(fleet_file: str, lane: str, rank: int,
+                     workers: int) -> None:
+    """One jax-free fleet worker. Untimed: scatter dense adds + routed
+    KV adds (the bit-exact basis). Timed: staleness-bounded range
+    reads of this worker's assigned half from ``read_threads``
+    concurrent fleet clients. Reports the read window under the
+    ops-lane keys (``adds``/``add_wall_s``) so ``_run_lane``
+    aggregates it unchanged."""
+    router = _load_router()
+    assert "jax" not in sys.modules, \
+        "worker process imported jax — the jax-free contract is broken"
+    router.transport._chaos.chaos_from_env()
+
+    fc = router.connect_fleet_file(fleet_file, client=f"{lane}-w{rank}",
+                                   quant=None, seed=7000 + rank)
+    table = fc.create_array("w_fleet", FLEET["size"], updater="default")
+    kv = fc.create_kv("kv_fleet", FLEET["kv_capacity"],
+                      value_dim=FLEET["kv_dim"], updater="default")
+    delta = fleet_delta(rank)
+    for _ in range(FLEET["adds"]):
+        table.add(delta)
+    keys = fleet_kv_keys(rank)
+    kvd = fleet_kv_delta(keys)
+    for _ in range(FLEET["kv_adds"]):
+        kv.add(keys, kvd)
+    fc.drain()
+
+    # rendezvous through the fleet itself: a one-hot mark on a tiny
+    # barrier table, then poll until every worker's mark landed — the
+    # timed windows fully overlap, so the aggregate rate measures
+    # contended serving in BOTH configs instead of whatever process
+    # startup skew happened to serialize
+    bar = fc.create_array("fleet_barrier", max(workers, fc.n),
+                          updater="default")
+    mark = np.zeros(max(workers, fc.n), np.float32)
+    mark[rank] = 1.0
+    bar.add(mark, sync=True)
+    while not (bar.get()[:workers] > 0).all():
+        time.sleep(0.005)
+
+    half = FLEET["size"] // 2
+    lo, hi = (0, half) if rank % 2 == 0 else (half, FLEET["size"])
+    n_threads = FLEET["read_threads"]
+
+    def read_lane(i: int) -> None:
+        c = router.connect_fleet_file(
+            fleet_file, client=f"{lane}-w{rank}-r{i}", quant=None)
+        t = c.create_array("w_fleet", FLEET["size"], updater="default")
+        got = None
+        for _ in range(2):      # warm: arm replicas + connections
+            got = t.get_range(lo, hi, staleness=FLEET_STALENESS)
+        for _ in range(FLEET["reads"]):
+            got = t.get_range(lo, hi, staleness=FLEET_STALENESS)
+        assert got is not None and got.shape == (hi - lo,), \
+            f"range read returned shape {None if got is None else got.shape}"
+        c.close()
+
+    lanes = [threading.Thread(target=read_lane, args=(i,))
+             for i in range(n_threads)]
+    t0 = time.perf_counter()
+    for th in lanes:
+        th.start()
+    for th in lanes:
+        th.join()
+    window = time.perf_counter() - t0
+    reads = FLEET["reads"] * n_threads
+    out = {"rank": rank, "lane": lane, "adds": reads,
+           "add_wall_s": window, "reads": reads,
+           "range": [lo, hi], "servers": fc.n,
+           "tx_bytes": fc.tx_bytes, "rx_bytes": fc.rx_bytes,
+           "transport": fc.clients[0].transport}
+    fc.close()
     print(json.dumps(out), flush=True)
 
 
@@ -673,6 +836,199 @@ def flood_main() -> None:
     _emit_flood(line)
 
 
+# -- fleet lane (sharded scatter-gather scaling) ---------------------------
+
+def _emit_fleet(line: Dict[str, object]) -> None:
+    out = os.environ.get("MVTPU_FLEET_BENCH_JSON",
+                         "serving_mp_fleet.json")
+    with open(out, "w") as f:
+        json.dump(line, f, indent=1)
+    print(json.dumps(line), flush=True)
+
+
+def _start_fleet(tmpdir: str, tag: str, n: int):
+    """Spawn ``python -m multiverso_tpu.server --fleet n`` and wait
+    for its fleet file (written atomically once every member is up).
+    Returns (launcher proc, fleet file path, parsed fleet doc)."""
+    fleet_file = os.path.join(tmpdir, f"fleet-{tag}.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    cmd = [sys.executable, "-m", "multiverso_tpu.server",
+           "--fleet", str(n),
+           "--address",
+           "unix:" + os.path.join(tmpdir, f"fl-{tag}.sock"),
+           "--name", f"fleet-{tag}", "--fleet-file", fleet_file,
+           "--fuse", str(FUSE_K)]
+    proc = subprocess.Popen(cmd, env=env, cwd=REPO)
+    deadline = time.monotonic() + STARTUP_S * max(n, 1)
+    while time.monotonic() < deadline:
+        doc = None
+        if os.path.exists(fleet_file):
+            try:
+                with open(fleet_file) as f:
+                    doc = json.load(f)
+            except ValueError:
+                doc = None
+        if doc and len(doc.get("members", ())) == n:
+            return proc, fleet_file, doc
+        if proc.poll() is not None:
+            raise SystemExit(
+                f"serving_mp: fleet launcher ({tag}) died "
+                f"rc={proc.returncode} before the fleet came up")
+        time.sleep(0.05)
+    _stop_server(proc)
+    raise SystemExit(f"serving_mp: fleet ({tag}) startup timed out")
+
+
+def _probe_fleet_statusz(doc: dict, n: int) -> dict:
+    """Scrape ``/statusz?fleet=1`` off member 0 and sanity-check the
+    aggregation: one partition row per member, each with its table
+    ranges (the satellite's introspection contract)."""
+    port = int(doc["members"][0].get("statusz_port") or 0)
+    assert port, "fleet members came up without statusz ports"
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/statusz?fleet=1",
+            timeout=10) as resp:
+        agg = json.load(resp)
+    assert agg.get("kind") == "mvtpu.statusz.fleet.v1", agg.get("kind")
+    parts = agg.get("partitions", [])
+    assert len(parts) == n, \
+        f"fleet statusz shows {len(parts)} partitions, want {n}"
+    for row in parts:
+        assert "error" not in row, f"fleet statusz peer error: {row}"
+        for srv in row.get("partitions", []):
+            assert srv.get("rank") == row.get("rank"), (row, srv)
+            names = {t["name"] for t in srv.get("tables", [])}
+            assert {"w_fleet", "kv_fleet"} <= names, names
+    return agg
+
+
+def _fleet_config(line: Dict[str, object], router, tag: str,
+                  n: int) -> Dict[str, object]:
+    """One end-to-end config (``--fleet n``): worker lane, scored
+    finals, and — for n >= 2 — the statusz aggregation probe plus the
+    SIGKILL-survivor gate. Returns rate + final table bytes."""
+    with tempfile.TemporaryDirectory(
+            prefix=f"mvtpu_fleet_{tag}_") as tmpdir:
+        line["fleet_stage"] = f"{tag}-start"
+        proc, fleet_file, doc = _start_fleet(tmpdir, tag, n)
+        try:
+            line["fleet_stage"] = f"{tag}-lane"
+            lane = _run_lane(fleet_file, f"fleet-{tag}", None,
+                             mode="fleet", workers=FLEET_WORKERS)
+            line["fleet_stage"] = f"{tag}-score"
+            fc = router.connect_fleet_file(
+                fleet_file, client=f"scorer-{tag}", quant=None)
+            table = fc.create_array("w_fleet", FLEET["size"],
+                                    updater="default")
+            kv = fc.create_kv("kv_fleet", FLEET["kv_capacity"],
+                              value_dim=FLEET["kv_dim"],
+                              updater="default")
+            final = table.get()
+            all_keys = np.concatenate(
+                [fleet_kv_keys(r) for r in range(FLEET_WORKERS)])
+            kv_vals, kv_found = kv.get(all_keys)
+            assert kv_found.all(), \
+                f"{int((~kv_found).sum())} routed KV keys missing"
+            if n >= 2:
+                line["fleet_stage"] = f"{tag}-statusz"
+                _probe_fleet_statusz(doc, n)
+                # SIGKILL one member: ONLY its partition goes dark —
+                # the router keeps serving the surviving shard, and
+                # serves it bit-exactly
+                line["fleet_stage"] = f"{tag}-sigkill"
+                os.kill(int(doc["members"][0]["pid"]), signal.SIGKILL)
+                time.sleep(0.3)
+                bounds = fc.pmap.dense_bounds(FLEET["size"])
+                surv = table.get_shard(n - 1).get()
+                assert surv.tobytes() == \
+                    final[bounds[n - 1]:bounds[n]].tobytes(), \
+                    "surviving shard stopped serving (or served " \
+                    "corrupt bytes) after a peer SIGKILL"
+            try:
+                fc.close()
+            except Exception:
+                pass            # the killed member's socket may object
+            return {"rate": float(lane["ops_per_sec"]),
+                    "final": final.tobytes(),
+                    "kv_vals": kv_vals.tobytes(),
+                    "lane": lane}
+        finally:
+            _stop_server(proc)
+
+
+def _fleet_run(line: Dict[str, object], n_servers: int) -> None:
+    """The fleet scenario body; fills ``line`` incrementally so a
+    give-up at any stage still has every field measured so far."""
+    router = _load_router()
+    single = _fleet_config(line, router, "single", 1)
+    fleet = _fleet_config(line, router, "fleet", n_servers)
+
+    ratio = fleet["rate"] / max(single["rate"], 1e-9)
+    line.update({
+        "value": round(fleet["rate"], 1),
+        "serving_fleet_ops_per_sec": round(fleet["rate"], 1),
+        "serving_fleet_single_ops_per_sec": round(single["rate"], 1),
+        "fleet_speedup": round(ratio, 3),
+        "fleet_scaling_efficiency": round(ratio / n_servers, 3),
+        "fleet_servers": n_servers,
+        "fleet_workers": FLEET_WORKERS,
+        "fleet_read_threads": FLEET["read_threads"],
+        "fleet_table_mb": round(FLEET["size"] * 4 / 2**20, 1),
+    })
+
+    # -- the acceptance gates ---------------------------------------------
+    expected = np.zeros(FLEET["size"], np.float32)
+    for rank in range(FLEET_WORKERS):
+        expected += FLEET["adds"] * fleet_delta(rank)
+    assert single["final"] == expected.tobytes(), \
+        "single-server final != exact integer-grid expectation"
+    assert fleet["final"] == expected.tobytes(), \
+        "fleet final != exact integer-grid expectation — scatter " \
+        "routing lost or double-applied a slice"
+    assert single["final"] == fleet["final"], \
+        "single-server and fleet finals differ"
+    kv_expected = np.concatenate(
+        [FLEET["kv_adds"] * fleet_kv_delta(fleet_kv_keys(r))
+         for r in range(FLEET_WORKERS)]).astype(np.float32)
+    assert single["kv_vals"] == kv_expected.tobytes(), \
+        "single-server KV values != exact expectation"
+    assert fleet["kv_vals"] == kv_expected.tobytes(), \
+        "fleet KV values != exact expectation — bucket routing lost " \
+        "or double-applied a row"
+    assert ratio >= FLEET_RATIO, \
+        f"fleet of {n_servers} served {fleet['rate']:.1f} reads/s vs " \
+        f"{single['rate']:.1f} single — {ratio:.2f}x, below the " \
+        f"{FLEET_RATIO:g}x gate (MVTPU_FLEET_RATIO overrides)"
+
+
+def fleet_main(n_servers: int) -> None:
+    """``--servers N``: the sharded-fleet scaling lane. Same
+    partial-JSON contract as the flood lane — any exception still
+    emits the line before the nonzero exit."""
+    if n_servers < 2:
+        raise SystemExit("serving_mp: --servers needs N >= 2 "
+                         "(the single-server baseline runs implicitly)")
+    line: Dict[str, object] = {
+        "metric": "serving_fleet_ops_per_sec",
+        "value": -1.0,          # -1 = not measured (partial give-up)
+        "unit": "ops/s",
+        "tiny": TINY,
+        "partial": True,
+        "fleet_ratio_gate": FLEET_RATIO,
+    }
+    try:
+        _fleet_run(line, n_servers)
+    except BaseException as e:
+        line["giveup"] = f"{type(e).__name__}: {e}"
+        _emit_fleet(line)
+        raise
+    line["partial"] = False
+    line.pop("fleet_stage", None)
+    _emit_fleet(line)
+
+
 def main() -> None:
     x, y = make_dataset()
     transport = _load_transport()
@@ -822,10 +1178,15 @@ if __name__ == "__main__":
     parser.add_argument("--flood", action="store_true",
                         help="run the overload/admission lane instead "
                              "of the training+hot-path lanes")
+    parser.add_argument("--servers", type=int, default=0,
+                        help="run the sharded-fleet scaling lane: N "
+                             "partitioned servers vs the implicit "
+                             "single-server baseline")
     parser.add_argument("--address")
     parser.add_argument("--lane", default="dense")
     parser.add_argument("--mode", default="train",
-                        choices=("train", "ops", "prot", "flood"))
+                        choices=("train", "ops", "prot", "flood",
+                                 "fleet"))
     parser.add_argument("--rank", type=int, default=0)
     parser.add_argument("--workers", type=int, default=N_WORKERS)
     parser.add_argument("--quant", default=None)
@@ -840,10 +1201,16 @@ if __name__ == "__main__":
         elif args.mode == "flood":
             run_flood_worker(args.address, args.lane, args.rank,
                              args.workers)
+        elif args.mode == "fleet":
+            # --address carries the fleet FILE, not a dial string
+            run_fleet_worker(args.address, args.lane, args.rank,
+                             args.workers)
         else:
             run_worker(args.address, args.lane, args.rank,
                        args.workers, args.quant)
     elif args.flood:
         flood_main()
+    elif args.servers:
+        fleet_main(args.servers)
     else:
         main()

@@ -45,6 +45,12 @@ _TRANSPORT_NAMES = ("WireClient", "RemoteArrayTable", "RemoteKVTable",
                     "RemoteHandle", "DeltaBatcher", "RemoteError",
                     "connect", "wire_retry_policy")
 
+#: scatter-gather fleet names, lazily re-exported from .router (same
+#: rationale as the transport names: only wire code loads the wire)
+_ROUTER_NAMES = ("FleetClient", "FleetArrayTable", "FleetKVTable",
+                 "FleetHandle", "connect_fleet", "connect_fleet_file",
+                 "fleet_addresses")
+
 
 def __getattr__(name: str):
     if name in _TRANSPORT_NAMES or name == "transport":
@@ -56,6 +62,11 @@ def __getattr__(name: str):
             "multiverso_tpu.client.transport")
         return transport if name == "transport" \
             else getattr(transport, name)
+    if name in _ROUTER_NAMES or name == "router":
+        import importlib
+        router = importlib.import_module(
+            "multiverso_tpu.client.router")
+        return router if name == "router" else getattr(router, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")
 
@@ -105,5 +116,5 @@ __all__ = [
     "CachedView", "CoalescingBuffer", "KVStagingWriter", "PendingHandle",
     "COALESCE_ENV", "STALENESS_ENV", "coalesce_from_env",
     "maybe_cached_view", "maybe_coalescing", "staleness_from_env",
-    "stage_kv_adds", *_TRANSPORT_NAMES,
+    "stage_kv_adds", *_TRANSPORT_NAMES, *_ROUTER_NAMES,
 ]

@@ -142,11 +142,21 @@ class CachedView:
         snapshot."""
         return self._table.generation - self._gen
 
-    def get(self) -> np.ndarray:
+    def get(self, max_staleness: Optional[int] = None) -> np.ndarray:
         """The cached host value, guaranteed within ``max_staleness``
         generations of the table. Non-blocking on the hit path; a read
         past the bound blocks on the in-flight refresh (or snapshots
-        synchronously)."""
+        synchronously).
+
+        The bound defaults to the view owner's ``max_staleness`` (set
+        at construction — the per-client bound); pass ``max_staleness=``
+        to override for THIS read only (``0`` forces freshness, a
+        larger value lets a tolerant reader skip the wait a strict
+        default would impose)."""
+        bound = self.max_staleness if max_staleness is None \
+            else int(max_staleness)
+        if bound < 0:
+            raise ValueError("max_staleness must be >= 0")
         t0 = time.monotonic()
         try:
             with tracing.request("client.get", table=self._lbl), \
@@ -158,7 +168,7 @@ class CachedView:
                         self._absorb(snap)
                 stale = cur - self._gen
                 self._m_staleness.set(max(stale, 0))
-                if stale <= self.max_staleness:
+                if stale <= bound:
                     self._m_hits.inc()
                     return self._val
                 self._m_misses.inc()
@@ -166,7 +176,7 @@ class CachedView:
                     with tracing.span("client.d2h_wait",
                                       table=self._lbl):
                         self._absorb(self._buf.get())  # blocking wait
-                if cur - self._gen > self.max_staleness:
+                if cur - self._gen > bound:
                     # in-flight refresh was older than needed (or none
                     # was running): snapshot here, on the reading
                     # thread — for single-dispatcher apps this IS the

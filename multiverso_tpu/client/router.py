@@ -1,0 +1,475 @@
+"""Client-side scatter-gather router over a sharded server fleet.
+
+One :class:`~multiverso_tpu.client.transport.WireClient` talks to ONE
+table server. A fleet (``python -m multiverso_tpu.server --fleet N``)
+is N such servers, each owning a contiguous partition of every table
+(:mod:`multiverso_tpu.server.partition`). :class:`FleetClient` makes
+the fleet look like one server: it wraps N ``WireClient``\\ s and the
+fleet tables split every get/add HOST-side by ownership, pipeline the
+per-server sub-requests concurrently, and reassemble replies by the
+inverse index — the client half of the reference's multi-server
+``ProcessGet``/``ProcessAdd`` partitioning (`src/server.cpp` routes by
+row hash; we route by the PartitionMap's contiguous blocks).
+
+Why throughput scales with N: each sub-request rides its OWN
+connection, so the existing ≤``MAX_PIPELINE``-unacked windows run in
+parallel across servers, and each server runs its own dispatch thread,
+fusion cycle, replica publisher, and admission controller over a table
+1/N the size.
+
+Layering is deliberate: :class:`FleetArrayTable` / :class:`FleetKVTable`
+are thin routers over per-server ``RemoteArrayTable`` /
+``RemoteKVTable`` subtables, so everything the transport already does
+— pipelined windows, at-least-once resend + server dedup
+(exactly-once), shed honoring, quantize-once-at-submit — applies
+per shard unchanged. Each per-server ``WireClient`` owns its own
+``ResidualStore``, so 1-bit error feedback stays correct *per
+connection* (a shared residual across servers would leak one shard's
+quantization error into another's stream). KV duplicates are pre-summed
+per shard before submit (``np.unique`` + ``np.add.at``, the same
+associativity CoalescingBuffer leans on), so a key appearing twice in
+one batch costs one wire row and applies once.
+
+The fleet tables present the same duck-typed surface as the remote
+tables (``table_id``/``name``/``dtype``/``num_cols``/
+``_attach_coalescer``/``add``/``get``/``wait``), so
+``client/coalesce.py``'s CoalescingBuffer and the transport's
+``DeltaBatcher`` stack on top unchanged.
+
+Partial failure is partial: a SIGKILLed member costs ONLY its
+partition. Ops routed to surviving shards keep completing (their
+connections never notice); ops touching the dead shard block in that
+one client's standard reconnect/replay loop and resume exactly-once
+when the member returns. ``get_shard(rank)`` exposes the per-rank
+subtable for exactly that kind of surviving-partition work.
+
+jax-free and file-path loadable (:func:`load_router`) like the
+transport — this is worker-process code.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _dep(modname: str, *relpath: str):
+    mod = sys.modules.get(modname)
+    if mod is not None:
+        return mod
+    if "multiverso_tpu" in sys.modules:
+        import importlib
+        return importlib.import_module(modname)
+    import importlib.util
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, *relpath)
+    spec = importlib.util.spec_from_file_location(modname, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[modname] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        sys.modules.pop(modname, None)
+        raise
+    return mod
+
+
+transport = _dep("multiverso_tpu.client.transport",
+                 "client", "transport.py")
+partition = _dep("multiverso_tpu.server.partition",
+                 "server", "partition.py")
+
+
+def load_router(package_dir: str):
+    """File-path load this module (canonical name, no package import)
+    from a bare worker script. ``package_dir`` is the
+    ``multiverso_tpu`` directory."""
+    modname = "multiverso_tpu.client.router"
+    mod = sys.modules.get(modname)
+    if mod is not None:
+        return mod
+    import importlib.util
+    path = os.path.join(package_dir, "client", "router.py")
+    spec = importlib.util.spec_from_file_location(modname, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[modname] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class FleetHandle:
+    """Handle-compatible future over the per-shard handles of one
+    logical mutation. ``done()``/``wait()`` quantify over every shard
+    the op actually touched."""
+
+    def __init__(self, handles: Sequence[Any]) -> None:
+        self._handles = list(handles)
+
+    def done(self) -> bool:
+        return all(h.done() for h in self._handles)
+
+    def wait(self) -> None:
+        for h in self._handles:
+            h.wait()
+
+    def result(self) -> None:
+        return self.wait()
+
+
+class _FleetTable:
+    """Shared router surface (the CoalescingBuffer duck type, same as
+    ``transport._RemoteTable``)."""
+
+    def __init__(self, fleet: "FleetClient", subs: Sequence[Any]) -> None:
+        self.fleet = fleet
+        self.subs = list(subs)          # rank-ordered per-server tables
+        head = self.subs[0]
+        self.table_id = head.table_id   # names the table in coalescers
+        self.name = head.name
+        self.kind = head.kind
+        self.dtype = head.dtype
+        self._coalescers: List[Any] = []
+
+    @property
+    def pmap(self) -> "partition.PartitionMap":
+        return self.fleet.pmap
+
+    def get_shard(self, rank: int):
+        """The per-rank remote subtable — the surface that keeps
+        serving a surviving partition while another member is down."""
+        return self.subs[rank]
+
+    def _attach_coalescer(self, buf: Any) -> None:
+        self._coalescers.append(buf)
+
+    def flush_coalesced(self) -> None:
+        for buf in self._coalescers:
+            buf.flush()
+
+    def wait(self) -> None:
+        for sub in self.subs:
+            sub.wait()
+
+
+class FleetArrayTable(_FleetTable):
+    """Dense 1-D table scattered across the fleet by contiguous
+    element ranges (rank r serves global elements [bounds[r],
+    bounds[r+1]) as ITS local rows 0..len)."""
+
+    def __init__(self, fleet: "FleetClient", subs: Sequence[Any],
+                 size: int) -> None:
+        super().__init__(fleet, subs)
+        self.size = int(size)
+        self.num_cols = 1
+        self._bounds = fleet.pmap.dense_bounds(self.size)
+
+    def get(self, staleness: Optional[int] = None) -> np.ndarray:
+        """Whole-table scatter-gather: each server returns its shard
+        concurrently; concat in rank order is the inverse map (the
+        zero-index-math payoff of contiguous ownership)."""
+        parts = self.fleet._fanout(
+            [lambda s=s: s.get(staleness=staleness) for s in self.subs])
+        return np.concatenate(parts)
+
+    def get_range(self, lo: int, hi: int,
+                  staleness: Optional[int] = None) -> np.ndarray:
+        """Elements [lo, hi) — fetched ONLY from the shards whose
+        ranges overlap it. This is the partitioning payoff a single
+        server cannot offer: its wire ``get`` is a whole-table
+        snapshot, so a range read there ships every element; here a
+        shard-aligned range ships 1/N of the bytes end to end."""
+        lo, hi = int(lo), int(hi)
+        if not 0 <= lo < hi <= self.size:
+            raise ValueError(
+                f"range [{lo}, {hi}) out of bounds for size {self.size}")
+        b = self._bounds
+        ranks = [r for r in range(self.pmap.n)
+                 if b[r] < hi and b[r + 1] > lo]
+        parts = self.fleet._fanout(
+            [lambda s=self.subs[r]: s.get(staleness=staleness)
+             for r in ranks])
+        if len(parts) == 1:
+            r = ranks[0]
+            return parts[0][lo - b[r]:hi - b[r]]
+        first = ranks[0]
+        return np.concatenate(parts)[lo - b[first]:hi - b[first]]
+
+    def add(self, delta, option=None, sync: bool = False) -> FleetHandle:
+        """Split the global delta by ownership; each slice is submitted
+        on its own pipelined connection (quantized there, against that
+        connection's residual store)."""
+        delta = np.asarray(delta, self.dtype)
+        if delta.shape != (self.size,):
+            raise ValueError(
+                f"fleet add to {self.name!r} expects shape "
+                f"({self.size},), got {delta.shape}")
+        b = self._bounds
+        handles = [sub.add(delta[b[r]:b[r + 1]], option)
+                   for r, sub in enumerate(self.subs)]
+        handle = FleetHandle(handles)
+        if sync:
+            handle.wait()
+        return handle
+
+    add_async = add
+
+
+class FleetKVTable(_FleetTable):
+    """Hashed KV table scattered by contiguous logical-bucket blocks:
+    a key's splitmix64 bucket picks its owning rank, forever (until a
+    map-version bump)."""
+
+    def __init__(self, fleet: "FleetClient", subs: Sequence[Any]) -> None:
+        super().__init__(fleet, subs)
+        head = self.subs[0]
+        self.value_dim = head.value_dim
+        self.num_cols = head.num_cols
+
+    def _route(self, keys: np.ndarray
+               ) -> List[Tuple[int, np.ndarray]]:
+        """(rank, positions-into-keys) per rank that owns >= 1 key."""
+        owner = self.pmap.kv_owner(keys)
+        out = []
+        for r in range(self.pmap.n):
+            idx = np.nonzero(owner == r)[0]
+            if idx.size:
+                out.append((r, idx))
+        return out
+
+    def get(self, keys, staleness: Optional[int] = None
+            ) -> Tuple[np.ndarray, np.ndarray]:
+        """Batch lookup fanned out by ownership, reassembled into the
+        caller's key order via the inverse index."""
+        keys = np.ascontiguousarray(np.asarray(keys, np.uint64))
+        n = keys.shape[0]
+        shape = (n, self.value_dim) if self.value_dim else (n,)
+        values = np.zeros(shape, self.dtype)
+        found = np.zeros(n, bool)
+        routed = self._route(keys)
+        replies = self.fleet._fanout(
+            [lambda r=r, idx=idx: self.subs[r].get(
+                keys[idx], staleness=staleness)
+             for r, idx in routed])
+        for (r, idx), (vals, fnd) in zip(routed, replies):
+            values[idx] = vals
+            found[idx] = fnd
+        return values, found
+
+    def add(self, keys, deltas, option=None,
+            sync: bool = False) -> FleetHandle:
+        """Scatter an add by ownership, pre-summing duplicate keys per
+        shard first — one wire row per distinct key, one apply per
+        distinct key, same associative-sum contract the server's own
+        fused batches use."""
+        keys = np.ascontiguousarray(np.asarray(keys, np.uint64))
+        deltas = np.asarray(deltas, self.dtype)
+        handles = []
+        for r, idx in self._route(keys):
+            sub_keys = keys[idx]
+            sub_deltas = deltas[idx]
+            uniq, inv = np.unique(sub_keys, return_inverse=True)
+            if uniq.shape[0] != sub_keys.shape[0]:
+                acc = np.zeros((uniq.shape[0],) + sub_deltas.shape[1:],
+                               sub_deltas.dtype)
+                np.add.at(acc, inv, sub_deltas)
+                sub_keys, sub_deltas = uniq, acc
+            handles.append(self.subs[r].add(sub_keys, sub_deltas,
+                                            option))
+        handle = FleetHandle(handles)
+        if sync:
+            handle.wait()
+        return handle
+
+    add_async = add
+
+
+class FleetClient:
+    """N ``WireClient``\\ s + one :class:`PartitionMap` = one logical
+    parameter server (see module docstring)."""
+
+    def __init__(self, addresses: Sequence[str], *,
+                 pmap: Optional["partition.PartitionMap"] = None,
+                 version: int = 1,
+                 kv_buckets: Optional[int] = None,
+                 client: Optional[str] = None,
+                 quant: Optional[str] = "env",
+                 seed: Optional[int] = None,
+                 deadline_s="env") -> None:
+        addresses = list(addresses)
+        if not addresses:
+            raise ValueError("fleet needs at least one server address")
+        if pmap is None:
+            pmap = partition.PartitionMap(
+                len(addresses), version=version, kv_buckets=kv_buckets)
+        if pmap.n != len(addresses):
+            raise ValueError(
+                f"partition map is for {pmap.n} servers, got "
+                f"{len(addresses)} addresses")
+        self.pmap = pmap
+        self.client_id = client or f"pid{os.getpid()}"
+        claim = pmap.to_wire()
+        # one client per member: its OWN pipeline window, dedup stream,
+        # residual store, and reconnect/replay loop — shard isolation
+        # on the client side mirrors process isolation on the server's
+        self.clients = [
+            transport.WireClient(
+                addr, client=self.client_id, quant=quant,
+                seed=None if seed is None else int(seed) + rank,
+                deadline_s=deadline_s, partition=claim)
+            for rank, addr in enumerate(addresses)]
+        self._pool = ThreadPoolExecutor(
+            max_workers=pmap.n, thread_name_prefix="mvtpu-fleet")
+
+    def _fanout(self, thunks: Sequence[Any]) -> List[Any]:
+        """Run per-server sub-requests concurrently; surface the first
+        failure (a dead member fails ITS sub-request after its client's
+        retry budget — other shards' results are already home)."""
+        if len(thunks) <= 1:
+            return [t() for t in thunks]
+        futures = [self._pool.submit(t) for t in thunks]
+        return [f.result() for f in futures]
+
+    # -- table surface -----------------------------------------------------
+
+    def create_array(self, name: str, size: int, *,
+                     dtype: str = "float32",
+                     updater: Optional[str] = None,
+                     init_value: float = 0) -> FleetArrayTable:
+        """Create the GLOBAL table on every member; each instantiates
+        only its local slice (rank r holds bounds[r+1]-bounds[r]
+        elements) from the same spec."""
+        self.pmap.dense_bounds(size)    # validate split up front
+        subs = self._fanout(
+            [lambda c=c: c.create_array(name, size, dtype=dtype,
+                                        updater=updater,
+                                        init_value=init_value)
+             for c in self.clients])
+        return FleetArrayTable(self, subs, size)
+
+    def create_kv(self, name: str, capacity: int, *, value_dim: int = 0,
+                  dtype: str = "float32",
+                  updater: Optional[str] = None,
+                  tiered: bool = False) -> FleetKVTable:
+        subs = self._fanout(
+            [lambda c=c: c.create_kv(name, capacity,
+                                     value_dim=value_dim, dtype=dtype,
+                                     updater=updater, tiered=tiered)
+             for c in self.clients])
+        return FleetKVTable(self, subs)
+
+    # -- fleet plumbing ----------------------------------------------------
+
+    @property
+    def n(self) -> int:
+        return self.pmap.n
+
+    def client_for(self, rank: int) -> Any:
+        return self.clients[rank]
+
+    def ping(self) -> bool:
+        return all(self._fanout([c.ping for c in self.clients]))
+
+    def server_status(self) -> List[Dict[str, Any]]:
+        return self._fanout([c.server_status for c in self.clients])
+
+    def drain(self) -> None:
+        for c in self.clients:
+            c.drain()
+
+    @property
+    def tx_bytes(self) -> int:
+        return sum(c.tx_bytes for c in self.clients)
+
+    @property
+    def rx_bytes(self) -> int:
+        return sum(c.rx_bytes for c in self.clients)
+
+    @property
+    def sheds(self) -> int:
+        return sum(c.sheds for c in self.clients)
+
+    @property
+    def reconnects(self) -> int:
+        return sum(c.reconnects for c in self.clients)
+
+    def close(self) -> None:
+        errors = []
+        for c in self.clients:
+            try:
+                c.close()
+            except Exception as exc:    # noqa: BLE001 — close them all
+                errors.append(exc)
+        self._pool.shutdown(wait=False)
+        if errors:
+            raise errors[0]
+
+    def __enter__(self) -> "FleetClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def connect_fleet(addresses: Sequence[str], *,
+                  version: int = 1,
+                  kv_buckets: Optional[int] = None,
+                  client: Optional[str] = None,
+                  quant: Optional[str] = "env",
+                  seed: Optional[int] = None,
+                  deadline_s="env") -> FleetClient:
+    """Dial every member of a fleet. ``addresses`` is rank-ordered;
+    the map claimed at each hello is ``PartitionMap(len(addresses),
+    version, kv_buckets)`` — member ranks refuse a mismatch."""
+    return FleetClient(addresses, version=version,
+                       kv_buckets=kv_buckets, client=client,
+                       quant=quant, seed=seed, deadline_s=deadline_s)
+
+
+def fleet_addresses(fleet_file: str,
+                    scheme: Optional[str] = None) -> List[str]:
+    """Rank-ordered member addresses out of a launcher fleet file;
+    ``scheme`` picks a transport ("unix"/"tcp"/"shm") when members
+    listen on several, else each member's first address wins."""
+    doc = partition.read_fleet_file(fleet_file)
+    if doc is None:
+        raise FileNotFoundError(
+            f"fleet file {fleet_file!r} missing or malformed")
+    members = sorted(doc.get("members", []),
+                     key=lambda m: int(m.get("rank", 0)))
+    out = []
+    for m in members:
+        addrs = list(m.get("addresses") or [])
+        if not addrs:
+            raise ValueError(f"fleet member {m.get('rank')} has no "
+                             "addresses")
+        picked = addrs[0]
+        if scheme:
+            for a in addrs:
+                if a.split(":", 1)[0].rstrip("/") == scheme \
+                        or a.startswith(scheme + "://"):
+                    picked = a
+                    break
+        out.append(picked)
+    return out
+
+
+def connect_fleet_file(fleet_file: str, *,
+                       scheme: Optional[str] = None,
+                       client: Optional[str] = None,
+                       quant: Optional[str] = "env",
+                       seed: Optional[int] = None,
+                       deadline_s="env") -> FleetClient:
+    """Dial a fleet straight from its launcher fleet file (addresses
+    AND the authoritative map come from the file)."""
+    doc = partition.read_fleet_file(fleet_file)
+    if doc is None:
+        raise FileNotFoundError(
+            f"fleet file {fleet_file!r} missing or malformed")
+    pmap = partition.PartitionMap.from_wire(doc["map"])
+    return FleetClient(fleet_addresses(fleet_file, scheme),
+                       pmap=pmap, client=client, quant=quant,
+                       seed=seed, deadline_s=deadline_s)

@@ -192,9 +192,13 @@ class WireClient:
                  quant: Optional[str] = "env",
                  seed: Optional[int] = None,
                  retry_policy=None,
-                 deadline_s="env") -> None:
+                 deadline_s="env",
+                 partition: Optional[Dict[str, Any]] = None) -> None:
         self.address = address
         self.client_id = client or f"pid{os.getpid()}"
+        # partition-map claim (PartitionMap.to_wire()); sent in every
+        # hello so a fleet member refuses a stale map BEFORE data flows
+        self.partition = dict(partition) if partition else None
         self.quant = wire.quant_mode_from_env() if quant == "env" \
             else quant
         self.block = wire.wire_block()
@@ -298,11 +302,17 @@ class WireClient:
         try:
             self._rid += 1
             hello_rid = self._rid
-            self._tx(chan, {"op": "hello", "rid": hello_rid,
-                            "client": self.client_id}, [])
+            hello: Dict[str, Any] = {"op": "hello", "rid": hello_rid,
+                                     "client": self.client_id}
+            if self.partition is not None:
+                hello["partition"] = self.partition
+            self._tx(chan, hello, [])
             header, _, nbytes = chan.recv()
             self.rx_bytes += nbytes
             if not header.get("ok") or header.get("rid") != hello_rid:
+                # includes a fleet member refusing a partition-map
+                # mismatch: WireProtocolError is not in the retryable
+                # set, so the refusal propagates loudly, unretried
                 raise wire.WireProtocolError(
                     f"bad hello reply: {header}")
         except BaseException:
@@ -767,9 +777,12 @@ class DeltaBatcher:
 def connect(address: str, *, client: Optional[str] = None,
             quant: Optional[str] = "env",
             seed: Optional[int] = None,
-            deadline_s="env") -> WireClient:
+            deadline_s="env",
+            partition: Optional[Dict[str, Any]] = None) -> WireClient:
     """Dial a table server; ``quant="env"`` reads ``MVTPU_WIRE_QUANT``,
     ``deadline_s="env"`` reads ``MVTPU_WIRE_DEADLINE_S`` (pass a float
-    to stamp every request with that deadline, ``None`` for none)."""
+    to stamp every request with that deadline, ``None`` for none).
+    ``partition`` is a PartitionMap wire dict claimed at hello when
+    dialing one member of a sharded fleet (see ``client/router.py``)."""
     return WireClient(address, client=client, quant=quant, seed=seed,
-                      deadline_s=deadline_s)
+                      deadline_s=deadline_s, partition=partition)

@@ -1,8 +1,16 @@
-"""``python -m multiverso_tpu.server``: run one table-server process.
+"""``python -m multiverso_tpu.server``: run one table-server process —
+or launch a sharded fleet of N of them.
 
 The process half of the reference's ``multiverso server`` role: init
 the runtime (mesh, chaos-from-env, statusz), serve the wire address
-until SIGTERM/SIGINT, then drain.
+until SIGTERM/SIGINT, then drain. With ``--fleet N`` this process
+becomes a LAUNCHER instead: it spawns N member processes (rank r
+listens on rank-derived addresses, owns partition r of every table per
+``server/partition.py``), waits for every member's ready file, then
+writes one fleet file naming the whole fleet — addresses, statusz
+ports, pids, and the authoritative partition map — which
+``client/router.py``'s ``connect_fleet_file`` and the
+``/statusz?fleet=1`` aggregator both consume.
 
 Flags:
 
@@ -30,7 +38,30 @@ Flags:
     here (comma-separated, same order as ``--address``). The launcher
     (``benchmarks/serving_mp.py``, ``make mp-smoke``) polls this file
     instead of racing the bind — and it is how an ephemeral tcp port
-    gets back to the workers.
+    gets back to the workers. Under ``--fleet`` the launcher's ready
+    file is the fleet file itself (JSON, ``mvtpu.fleet.v1``).
+
+Fleet flags:
+
+``--fleet N``
+    launcher mode: spawn N member processes. Rank r's addresses derive
+    from ``--address`` (unix/shm paths gain a ``.r`` suffix; an
+    explicit tcp port becomes port+r, an ephemeral ``:0`` stays
+    ephemeral). Members get statusz armed (ephemeral) unless
+    ``MVTPU_STATUSZ_PORT`` is already set, so ``?fleet=1`` aggregation
+    works out of the box. SIGTERM/SIGINT forward to every member; one
+    member dying does NOT take the rest down (a partition outage is
+    partial by design — the launcher keeps the survivors).
+``--fleet-file PATH``
+    where the fleet file lands (default: ``--ready-file``, else
+    ``<first unix/shm path>.fleet.json``).
+``--fleet-version V``
+    partition-map version claimed by every member (default 1).
+``--kv-buckets B``
+    logical KV bucket space (default 8192, rounded up to a multiple
+    of N).
+``--fleet-rank R`` / ``--fleet-n N``
+    internal: member mode (set by the launcher).
 """
 
 from __future__ import annotations
@@ -38,34 +69,54 @@ from __future__ import annotations
 import argparse
 import os
 import signal
+import subprocess
 import sys
+import time
 
 
-def main(argv=None) -> int:
-    parser = argparse.ArgumentParser(
-        prog="python -m multiverso_tpu.server",
-        description="multiverso_tpu table-server process")
-    parser.add_argument("--address", default="unix:/tmp/mvtpu.sock")
-    parser.add_argument("--name", default="tables")
-    parser.add_argument("--fuse", type=int, default=None)
-    parser.add_argument("--qos", default=None)
-    parser.add_argument("--queue", type=int, default=None)
-    parser.add_argument("--ready-file", default=None)
-    args = parser.parse_args(argv)
+def _rank_address(addr: str, rank: int) -> str:
+    """Rank-derive one listen address (see module docstring)."""
+    addr = addr.strip()
+    if addr.startswith("tcp:"):
+        host, _, port = addr[4:].rpartition(":")
+        p = int(port or 0)
+        return f"tcp:{host}:{p + rank if p else 0}"
+    return f"{addr}.{rank}"
 
+
+def _write_ready(path: str, content: str) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(content)
+    os.replace(tmp, path)
+
+
+def _member_main(args, server_cls, partition) -> int:
+    """One fleet member (or a plain standalone server when no
+    partition flags are set)."""
     from multiverso_tpu import core
-    from multiverso_tpu.server.table_server import TableServer
 
+    member = None
+    if args.fleet_n:
+        pmap = partition.PartitionMap(args.fleet_n,
+                                      version=args.fleet_version,
+                                      kv_buckets=args.kv_buckets)
+        member = partition.PartitionMember(pmap, args.fleet_rank)
     core.init()
-    server = TableServer(args.address, name=args.name, fuse=args.fuse,
-                         qos=args.qos, queue_bound=args.queue)
+    server = server_cls(args.address, name=args.name, fuse=args.fuse,
+                        qos=args.qos, queue_bound=args.queue,
+                        partition=member, fleet_file=args.fleet_file)
     bound = server.start()
 
     if args.ready_file:
-        tmp = args.ready_file + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(bound)
-        os.replace(tmp, args.ready_file)
+        ready = bound
+        from multiverso_tpu.telemetry import statusz
+        http = statusz.server()
+        if http is not None:
+            # the launcher lifts this into the fleet file; ?fleet=1
+            # scrapes peers through it
+            ready += f",statusz:{http.port}"
+        _write_ready(args.ready_file, ready)
 
     def _stop(signum, frame):
         server.stop()
@@ -78,6 +129,136 @@ def main(argv=None) -> int:
         server.stop()
         core.shutdown()
     return 0
+
+
+def _fleet_main(args, partition) -> int:
+    """Launcher: N member processes + one fleet file."""
+    n = int(args.fleet)
+    pmap = partition.PartitionMap(n, version=args.fleet_version,
+                                  kv_buckets=args.kv_buckets)
+    addresses = [a.strip() for a in str(args.address).split(",")
+                 if a.strip()]
+    fleet_file = args.fleet_file or args.ready_file
+    if not fleet_file:
+        stem = next((a.split(":", 1)[1].lstrip("/") for a in addresses
+                     if a.startswith(("unix:", "shm:"))), None)
+        fleet_file = ("/" + stem if stem else "/tmp/mvtpu") \
+            + ".fleet.json"
+
+    env = dict(os.environ)
+    env.setdefault("MVTPU_STATUSZ_PORT", "0")
+    procs, ready_files = [], []
+    for rank in range(n):
+        ready = f"{fleet_file}.r{rank}.ready"
+        try:
+            os.unlink(ready)
+        except OSError:
+            pass
+        ready_files.append(ready)
+        cmd = [sys.executable, "-m", "multiverso_tpu.server",
+               "--address", ",".join(_rank_address(a, rank)
+                                     for a in addresses),
+               "--name", f"{args.name}-{rank}",
+               "--ready-file", ready,
+               "--fleet-rank", str(rank), "--fleet-n", str(n),
+               "--fleet-version", str(args.fleet_version),
+               "--fleet-file", fleet_file]
+        if args.kv_buckets:
+            cmd += ["--kv-buckets", str(args.kv_buckets)]
+        if args.fuse is not None:
+            cmd += ["--fuse", str(args.fuse)]
+        if args.qos is not None:
+            cmd += ["--qos", args.qos]
+        if args.queue is not None:
+            cmd += ["--queue", str(args.queue)]
+        procs.append(subprocess.Popen(cmd, env=env))
+
+    def _kill_all(sig=signal.SIGTERM):
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(sig)
+                except OSError:
+                    pass
+
+    # every member ready (or one dead before ready = startup failure)
+    members = []
+    deadline = time.monotonic() + float(
+        os.environ.get("MVTPU_FLEET_STARTUP_S", "") or 60.0)
+    for rank, ready in enumerate(ready_files):
+        while not os.path.exists(ready):
+            rc = procs[rank].poll()
+            if rc is not None:
+                print(f"fleet member {rank} exited rc={rc} before "
+                      "ready", file=sys.stderr)
+                _kill_all()
+                return 1
+            if time.monotonic() > deadline:
+                print(f"fleet member {rank} not ready in time",
+                      file=sys.stderr)
+                _kill_all()
+                return 1
+            time.sleep(0.02)
+        with open(ready) as f:
+            parts = [p for p in f.read().strip().split(",") if p]
+        statusz_port = next(
+            (int(p.split(":", 1)[1]) for p in parts
+             if p.startswith("statusz:")), None)
+        members.append({
+            "rank": rank, "name": f"{args.name}-{rank}",
+            "addresses": [p for p in parts
+                          if not p.startswith("statusz:")],
+            "statusz_port": statusz_port, "pid": procs[rank].pid})
+
+    partition.write_fleet_file(fleet_file, pmap, members)
+    if args.ready_file and args.ready_file != fleet_file:
+        with open(fleet_file) as f:
+            _write_ready(args.ready_file, f.read())
+    print(f"fleet of {n} up; fleet file {fleet_file}", flush=True)
+
+    stopping = []
+
+    def _stop(signum, frame):
+        stopping.append(signum)
+        _kill_all()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    # a member dying alone is a PARTIAL outage, not fleet shutdown:
+    # keep waiting on the rest (the bench SIGKILLs rank 0 and asserts
+    # rank 1 still serves through exactly this launcher)
+    rcs = [p.wait() for p in procs]
+    if stopping:
+        return 0
+    return 0 if all(rc == 0 for rc in rcs) else 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m multiverso_tpu.server",
+        description="multiverso_tpu table-server process / fleet "
+                    "launcher")
+    parser.add_argument("--address", default="unix:/tmp/mvtpu.sock")
+    parser.add_argument("--name", default="tables")
+    parser.add_argument("--fuse", type=int, default=None)
+    parser.add_argument("--qos", default=None)
+    parser.add_argument("--queue", type=int, default=None)
+    parser.add_argument("--ready-file", default=None)
+    parser.add_argument("--fleet", type=int, default=None)
+    parser.add_argument("--fleet-file", default=None)
+    parser.add_argument("--fleet-version", type=int, default=1)
+    parser.add_argument("--kv-buckets", type=int, default=None)
+    parser.add_argument("--fleet-rank", type=int, default=0)
+    parser.add_argument("--fleet-n", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    from multiverso_tpu.server import partition
+
+    if args.fleet:
+        return _fleet_main(args, partition)
+
+    from multiverso_tpu.server.table_server import TableServer
+    return _member_main(args, TableServer, partition)
 
 
 if __name__ == "__main__":

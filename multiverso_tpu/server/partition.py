@@ -1,0 +1,316 @@
+"""PartitionMap: which server process owns which slice of every table.
+
+The reference framework's defining scale shape is a *fleet* of server
+processes, each owning a partition of every table, with workers
+scattering requests by ownership (`src/server.cpp`: rank r serves the
+rows `ProcessGet`/`ProcessAdd` hash to it). This module is that
+ownership function for the wire stack: a versioned
+:class:`PartitionMap` shared by the launcher, every
+:class:`~multiverso_tpu.server.table_server.TableServer` in the fleet,
+and the client-side router (:mod:`multiverso_tpu.client.router`).
+
+Ownership is **contiguous blocks**, the same invariant
+``tables/hashing.shard_lane_slices`` exploits on-device:
+
+- a dense table of ``size`` elements splits into N contiguous element
+  ranges — rank r owns ``[r*size//n, (r+1)*size//n)`` — so a scatter
+  is a plain slice and a gather a plain concat, both zero-index-math;
+- a KV key hashes (splitmix64, the table layer's own mix) into a
+  fleet-wide **logical bucket space** of ``kv_buckets`` buckets (a
+  multiple of n, fixed at map creation), and rank r owns the
+  contiguous block ``[r*bps, (r+1)*bps)`` — the bucket→shard rule
+  ``KVTable`` already uses for its model-axis shards, lifted one
+  level up to processes.
+
+Contiguity is not an aesthetic: it is the substrate ROADMAP item 3's
+live resharding assumes — moving ownership is "reassign a range, bump
+``version``", and the version handshake below is what makes a stale
+map refuse loudly instead of silently mis-routing. Every server
+process checks the client's claimed ``(n, version, kv_buckets)`` at
+``hello`` and refuses a mismatch before any data op flows.
+
+jax-free BY DESIGN (stdlib + numpy + the numpy-only hashing module):
+the client router runs in bare worker processes, and the fleet-statusz
+scraper runs on the statusz HTTP thread of a possibly-wedged process.
+File-path loadable like ``server/wire.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def _dep(modname: str, *relpath: str):
+    mod = sys.modules.get(modname)
+    if mod is not None:
+        return mod
+    if "multiverso_tpu" in sys.modules:
+        import importlib
+        return importlib.import_module(modname)
+    import importlib.util
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, *relpath)
+    spec = importlib.util.spec_from_file_location(modname, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[modname] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        sys.modules.pop(modname, None)
+        raise
+    return mod
+
+
+hashing = _dep("multiverso_tpu.tables.hashing", "tables", "hashing.py")
+
+#: logical KV bucket space floor; the map rounds it UP to a multiple of
+#: ``n`` so every rank owns an equal contiguous block. Plenty of
+#: granularity for item 3's range moves without bloating the map.
+DEFAULT_KV_BUCKETS = 8192
+
+#: hello/statusz wire fields of a partition claim
+_WIRE_FIELDS = ("n", "version", "kv_buckets")
+
+
+class PartitionMap:
+    """The fleet-wide ownership function (see module docstring).
+
+    Immutable; equality and the ``hello`` handshake compare the full
+    ``(n, version, kv_buckets)`` triple — any change to the geometry
+    must bump ``version`` (item 3's reshard contract)."""
+
+    __slots__ = ("n", "version", "kv_buckets")
+
+    def __init__(self, n: int, *, version: int = 1,
+                 kv_buckets: Optional[int] = None) -> None:
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"partition map needs n >= 1, got {n}")
+        base = int(kv_buckets) if kv_buckets else DEFAULT_KV_BUCKETS
+        if base < n:
+            base = n
+        self.n = n
+        self.version = int(version)
+        # round UP to a multiple of n: equal contiguous blocks per rank
+        self.kv_buckets = -(-base // n) * n
+
+    # -- dense ownership ---------------------------------------------------
+
+    def dense_bounds(self, size: int) -> List[int]:
+        """N+1 offsets: rank r owns elements [bounds[r], bounds[r+1])
+        of a dense table with ``size`` elements. Balanced to within one
+        element, covering, disjoint."""
+        size = int(size)
+        if size < self.n:
+            raise ValueError(
+                f"dense table of {size} elements cannot split across "
+                f"{self.n} servers (every rank must own >= 1 element)")
+        return [r * size // self.n for r in range(self.n + 1)]
+
+    def dense_range(self, size: int, rank: int) -> Tuple[int, int]:
+        b = self.dense_bounds(size)
+        return b[rank], b[rank + 1]
+
+    # -- KV ownership ------------------------------------------------------
+
+    @property
+    def buckets_per_rank(self) -> int:
+        return self.kv_buckets // self.n
+
+    def kv_bucket(self, keys: np.ndarray) -> np.ndarray:
+        """Logical fleet bucket per key (splitmix64 mod kv_buckets) —
+        the one hash every router and server must agree on."""
+        keys = np.asarray(keys, np.uint64)
+        return (hashing._hash_u64(keys)
+                % np.uint64(self.kv_buckets)).astype(np.int64)
+
+    def kv_owner(self, keys: np.ndarray) -> np.ndarray:
+        """Owning rank per key: contiguous equal bucket blocks (rank r
+        owns [r*bps, (r+1)*bps) of the logical bucket space)."""
+        return self.kv_bucket(keys) // self.buckets_per_rank
+
+    def bucket_range(self, rank: int) -> Tuple[int, int]:
+        bps = self.buckets_per_rank
+        return rank * bps, (rank + 1) * bps
+
+    # -- wire form ---------------------------------------------------------
+
+    def to_wire(self) -> Dict[str, int]:
+        return {"n": self.n, "version": self.version,
+                "kv_buckets": self.kv_buckets}
+
+    @classmethod
+    def from_wire(cls, doc: Dict[str, Any]) -> "PartitionMap":
+        return cls(int(doc["n"]), version=int(doc.get("version", 1)),
+                   kv_buckets=int(doc["kv_buckets"]))
+
+    def mismatch(self, claim: Optional[Dict[str, Any]]) -> Optional[str]:
+        """None when ``claim`` (a to_wire dict off the hello header)
+        names this exact map, else the human-readable refusal."""
+        if not isinstance(claim, dict):
+            return f"partition claim is not a map: {claim!r}"
+        theirs = tuple(claim.get(k) for k in _WIRE_FIELDS)
+        ours = tuple(getattr(self, k) for k in _WIRE_FIELDS)
+        if theirs != ours:
+            return ("partition map mismatch: server has "
+                    f"{dict(zip(_WIRE_FIELDS, ours))}, client claims "
+                    f"{dict(zip(_WIRE_FIELDS, theirs))}")
+        return None
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, PartitionMap) \
+            and other.to_wire() == self.to_wire()
+
+    def __repr__(self) -> str:
+        return (f"PartitionMap(n={self.n}, version={self.version}, "
+                f"kv_buckets={self.kv_buckets})")
+
+
+class PartitionMember:
+    """One rank's view of the map: what THIS server process owns."""
+
+    __slots__ = ("map", "rank")
+
+    def __init__(self, pmap: PartitionMap, rank: int) -> None:
+        rank = int(rank)
+        if not 0 <= rank < pmap.n:
+            raise ValueError(f"rank {rank} outside fleet of {pmap.n}")
+        self.map = pmap
+        self.rank = rank
+
+    def dense_range(self, size: int) -> Tuple[int, int]:
+        return self.map.dense_range(size, self.rank)
+
+    def local_dense_size(self, size: int) -> int:
+        lo, hi = self.dense_range(size)
+        return hi - lo
+
+    def bucket_range(self) -> Tuple[int, int]:
+        return self.map.bucket_range(self.rank)
+
+    def local_kv_capacity(self, capacity: int) -> int:
+        """This rank's slot budget: the global capacity split evenly
+        (ceil — a shard must never hold fewer slots than its share of
+        keys; KVTable rounds its bucket count up anyway)."""
+        return max(-(-int(capacity) // self.map.n), 1)
+
+    def describe(self) -> Dict[str, Any]:
+        lo, hi = self.bucket_range()
+        return {"rank": self.rank, "buckets": [lo, hi],
+                **self.map.to_wire()}
+
+    def __repr__(self) -> str:
+        return f"PartitionMember(rank={self.rank}, map={self.map!r})"
+
+
+# -- fleet file ------------------------------------------------------------
+#
+# The launcher (``python -m multiverso_tpu.server --fleet N``) writes
+# one JSON document after every member reports ready; members read it
+# LAZILY (first /statusz?fleet=1 scrape) so startup has no ordering
+# cycle. Shape:
+#
+#   {"kind": "mvtpu.fleet.v1", "map": {n, version, kv_buckets},
+#    "members": [{"rank", "name", "addresses": [...],
+#                 "statusz_port": int|null, "pid": int}, ...]}
+
+FLEET_FILE_KIND = "mvtpu.fleet.v1"
+
+
+def write_fleet_file(path: str, pmap: PartitionMap,
+                     members: List[Dict[str, Any]]) -> None:
+    doc = {"kind": FLEET_FILE_KIND, "map": pmap.to_wire(),
+           "members": members}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+
+
+def read_fleet_file(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if doc.get("kind") != FLEET_FILE_KIND:
+        return None
+    return doc
+
+
+# -- fleet-aggregated introspection ----------------------------------------
+
+def member_summary(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Per-partition digest of one member's /statusz document: the
+    owned row/bucket ranges, queue depth, and fuse/admission counters
+    — the fields an operator triages a lopsided fleet with."""
+    out = []
+    transport = doc.get("transport") or {}
+    for row in transport.get("servers") or []:
+        part = row.get("partition")
+        if not part:
+            continue
+        adm = row.get("admission") or {}
+        queue = adm.get("queue") or {}
+        out.append({
+            "server": row.get("name"),
+            "address": row.get("address"),
+            "rank": part.get("rank"),
+            "map": {k: part.get(k) for k in _WIRE_FIELDS},
+            "tables": part.get("tables"),
+            "ops": row.get("ops"),
+            "queued": row.get("queued"),
+            "queue_bound": queue.get("bound"),
+            "fused": row.get("fused"),
+            "admission": {"shed": adm.get("shed"),
+                          "expired": adm.get("expired"),
+                          "degraded": adm.get("degraded")},
+        })
+    return out
+
+
+def fleet_status(fleet_file: str, *, self_rank: Optional[int] = None,
+                 self_doc: Optional[Dict[str, Any]] = None,
+                 timeout: float = 2.0) -> Dict[str, Any]:
+    """Aggregate the whole fleet's partition state by scraping each
+    member's statusz port (``/statusz?fleet=1`` serves this). A dead
+    or portless peer degrades to an ``error`` entry — introspecting a
+    half-up fleet is exactly when this matters."""
+    import urllib.request
+    doc = read_fleet_file(fleet_file)
+    if doc is None:
+        return {"kind": "mvtpu.statusz.fleet.v1", "error":
+                f"fleet file {fleet_file!r} missing or malformed",
+                "partitions": []}
+    partitions: List[Dict[str, Any]] = []
+    for member in doc.get("members", []):
+        rank = member.get("rank")
+        entry: Dict[str, Any] = {"rank": rank,
+                                 "name": member.get("name"),
+                                 "pid": member.get("pid")}
+        if self_rank is not None and rank == self_rank \
+                and self_doc is not None:
+            entry["partitions"] = member_summary(self_doc)
+            partitions.append(entry)
+            continue
+        port = member.get("statusz_port")
+        if not port:
+            entry["error"] = "member has no statusz port"
+            partitions.append(entry)
+            continue
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/statusz",
+                    timeout=timeout) as r:
+                peer = json.loads(r.read())
+            entry["partitions"] = member_summary(peer)
+        except Exception as exc:    # noqa: BLE001 — a dead peer is data
+            entry["error"] = f"{type(exc).__name__}: {exc}"
+        partitions.append(entry)
+    return {"kind": "mvtpu.statusz.fleet.v1", "map": doc.get("map"),
+            "fleet_file": fleet_file, "partitions": partitions}

@@ -147,6 +147,16 @@ def status_all() -> List[Dict[str, Any]]:
     return [s.status() for s in list(_SERVERS)]
 
 
+def fleet_info() -> Optional[Tuple[str, int]]:
+    """(fleet_file, rank) of the first live fleet-member server in this
+    process — the ``/statusz?fleet=1`` aggregator's anchor. None when
+    no server here belongs to a fleet."""
+    for s in list(_SERVERS):
+        if s._fleet_file and s._partition is not None:
+            return s._fleet_file, s._partition.rank
+    return None
+
+
 def _env_int(name: str, default: int) -> int:
     try:
         return int(os.environ.get(name, "") or default)
@@ -221,8 +231,18 @@ class TableServer:
     def __init__(self, address: str, *, name: str = "tables",
                  fuse: Optional[int] = None,
                  qos: Optional[str] = None,
-                 queue_bound: Optional[int] = None) -> None:
+                 queue_bound: Optional[int] = None,
+                 partition: Optional[Any] = None,
+                 fleet_file: Optional[str] = None) -> None:
         self.name = name
+        # fleet membership: a server/partition.PartitionMember makes
+        # this process rank r of an N-server fleet — every create
+        # instantiates only the local shard, and hello refuses clients
+        # claiming a different map (see _execute). None = the whole
+        # table lives here (every pre-fleet deployment).
+        self._partition = partition
+        self._fleet_file = fleet_file
+        self._table_parts: Dict[int, Dict[str, Any]] = {}
         self._addresses = [a.strip() for a in str(address).split(",")
                            if a.strip()]
         if not self._addresses:
@@ -335,10 +355,17 @@ class TableServer:
     def status(self) -> Dict[str, Any]:
         with self._conns_lock:
             n_conns = len(self._conns)
+        part = None
+        if self._partition is not None:
+            part = self._partition.describe()
+            part["tables"] = list(self._table_parts.values())
         return {"name": self.name, "address": self.address,
                 "connections": n_conns, "tables": len(self._tables),
                 "ops": self._ops, "fuse": self._fuse,
+                "fused": {"groups": int(self._c_fuse_groups.value),
+                          "frames": int(self._c_fuse_frames.value)},
                 "queued": self._dispatchq.qsize(),
+                "partition": part,
                 "admission": self._admission.status(),
                 "replicas": [rep.status()
                              for rep in self._replicas.values()]}
@@ -792,11 +819,30 @@ class TableServer:
                  ) -> Optional[Tuple[Dict[str, Any], list]]:
         if op == "hello":
             requested = str(header.get("client") or conn.client_id)
+            claim = header.get("partition")
+            if self._partition is not None and claim is not None:
+                # fleet handshake: a client claiming a DIFFERENT map
+                # would silently route rows to the wrong owner — refuse
+                # before any data op flows. (A claimless client is
+                # operator tooling — stats, smoke probes — and may
+                # talk to the shard directly.)
+                err = self._partition.map.mismatch(claim)
+                if err is not None:
+                    telemetry.counter("wire.hello.refused",
+                                      server=self.name).inc()
+                    log.warn("server %r refused hello from %r: %s",
+                             self.name, requested, err)
+                    return ({"ok": False, "error": err,
+                             "partition":
+                                 self._partition.map.to_wire()}, [])
             conn.client_id = requested
             self._dedup_cache(requested)
-            return ({"ok": True, "client_id": requested,
+            reply = {"ok": True, "client_id": requested,
                      "server": self.name,
-                     "quant": wire.quant_mode_from_env()}, [])
+                     "quant": wire.quant_mode_from_env()}
+            if self._partition is not None:
+                reply["partition"] = self._partition.describe()
+            return (reply, [])
         if op == "ping":
             return ({"ok": True}, [])
         if op == "noop":
@@ -891,6 +937,9 @@ class TableServer:
             self._next_table += 1
             self._tables[tid] = table
             self._by_name[name] = tid
+            if self._partition is not None:
+                self._table_parts[tid] = self._part_info(name, kind,
+                                                         spec)
             if kind in ("array", "kv"):
                 # dormant until the first staleness-tolerant read;
                 # tiered tables excluded (device arrays are one tier,
@@ -910,26 +959,59 @@ class TableServer:
         return (meta, [])
 
     def _build_table(self, name: str, kind: str, spec: Dict[str, Any]):
+        """Instantiate a table from its GLOBAL create spec. A fleet
+        member builds only its local shard: the contiguous element
+        range of a dense table, or ceil(capacity/n) KV slots (the
+        router never sends this rank a key it doesn't own, so local
+        bucket identity is free to differ from the fleet's logical
+        bucket space)."""
         common = {"name": name}
         for key in ("dtype", "updater"):
             if key in spec:
                 common[key] = spec[key]
+        member = self._partition
         if kind == "array":
             from multiverso_tpu.tables.array_table import ArrayTable
-            return ArrayTable(int(spec["size"]),
+            size = int(spec["size"])
+            if member is not None:
+                size = member.local_dense_size(size)
+            return ArrayTable(size,
                               init_value=spec.get("init_value", 0),
                               **common)
         if kind == "kv":
             from multiverso_tpu.tables.kv_table import KVTable
-            return KVTable(int(spec["capacity"]),
+            capacity = int(spec["capacity"])
+            if member is not None:
+                capacity = member.local_kv_capacity(capacity)
+            return KVTable(capacity,
                            int(spec.get("value_dim", 0)), **common)
         if kind == "tiered_kv":
             from multiverso_tpu.storage.tiered_kv import TieredKVTable
-            return TieredKVTable(int(spec["capacity"]),
+            capacity = int(spec["capacity"])
+            if member is not None:
+                capacity = member.local_kv_capacity(capacity)
+            return TieredKVTable(capacity,
                                  int(spec.get("value_dim", 0)),
                                  **common)
         raise ValueError(f"unknown table kind {kind!r} "
                          "(array | kv | tiered_kv)")
+
+    def _part_info(self, name: str, kind: str,
+                   spec: Dict[str, Any]) -> Dict[str, Any]:
+        """Per-table ownership row for /statusz (what THIS rank holds
+        of the global table)."""
+        member = self._partition
+        info: Dict[str, Any] = {"name": name, "kind": kind}
+        if kind == "array":
+            size = int(spec["size"])
+            lo, hi = member.dense_range(size)
+            info.update(size=size, range=[lo, hi], local=hi - lo)
+        else:
+            capacity = int(spec["capacity"])
+            lo, hi = member.bucket_range()
+            info.update(capacity=capacity, buckets=[lo, hi],
+                        local=member.local_kv_capacity(capacity))
+        return info
 
     @staticmethod
     def _option(header: Dict[str, Any]) -> Optional[AddOption]:

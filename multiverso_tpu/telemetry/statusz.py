@@ -196,6 +196,33 @@ def _transport_status(counters: dict, gauges: dict,
             "histograms": wire_hists, "servers": servers}
 
 
+def _fleet_statusz() -> dict:
+    """``/statusz?fleet=1``: every fleet member's partition digest —
+    owned row/bucket ranges, queue depth, fuse/admission counters —
+    aggregated by scraping peer statusz ports from the launcher's
+    fleet file. Answerable on ANY member; this process's own row comes
+    from its live status (no self-scrape)."""
+    from multiverso_tpu.server import partition  # jax-free, cheap
+    ts = sys.modules.get("multiverso_tpu.server.table_server")
+    info = None
+    if ts is not None:
+        try:
+            info = ts.fleet_info()
+        except Exception:
+            info = None
+    if info is None:
+        # not a fleet member: still useful — digest the local servers
+        return {"kind": "mvtpu.statusz.fleet.v1",
+                "error": "no fleet member in this process",
+                "partitions": [{
+                    "rank": None,
+                    "partitions":
+                        partition.member_summary(_statusz_doc())}]}
+    fleet_file, rank = info
+    return partition.fleet_status(fleet_file, self_rank=rank,
+                                  self_doc=_statusz_doc())
+
+
 def _storage_status() -> Optional[list]:
     """Per-table tier residency from the tiered-storage managers, via
     sys.modules like the lookups above (statusz must not pull in the
@@ -233,8 +260,12 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             if path in ("/", "/statusz"):
                 if path == "/":
                     body = ("mvtpu statusz — endpoints: /metrics "
-                            "(?fleet=1), /healthz, /statusz, /trace\n")
+                            "(?fleet=1), /healthz, /statusz "
+                            "(?fleet=1), /trace\n")
                     self._reply(200, body.encode(), "text/plain")
+                    return
+                if "fleet=1" in query.split("&"):
+                    self._reply_json(200, _fleet_statusz())
                     return
                 self._reply_json(200, _statusz_doc())
             elif path == "/metrics":

@@ -235,6 +235,30 @@ class TestCachedView:
         view.close()
         view.close()
 
+    def test_per_get_staleness_override(self, mesh8):
+        """One view, two readers: ``get(max_staleness=...)`` overrides
+        the constructor bound for THAT read only — a tolerant read hits
+        cache where the default would refresh, and ``0`` forces
+        freshness on a view whose default would tolerate the lag."""
+        t = ArrayTable(8, "float32", name="cl_view7")
+        view = client.CachedView(t, max_staleness=0, background=False)
+        view.get()                          # prime the snapshot
+        c0 = _calls("table.snapshot.cl_view7")
+        t.add(np.ones(8, np.float32))
+        # tolerant read: 1 generation behind is fine HERE, despite the
+        # strict default — no snapshot, stale value served
+        got = view.get(max_staleness=5)
+        assert _calls("table.snapshot.cl_view7") - c0 == 0
+        np.testing.assert_allclose(got, 0.0)
+        # strict read on the same view: must refresh
+        np.testing.assert_allclose(view.get(max_staleness=0), 1.0)
+        assert _calls("table.snapshot.cl_view7") - c0 >= 1
+        # the default bound is untouched by the overrides
+        t.add(np.ones(8, np.float32))
+        np.testing.assert_allclose(view.get(), 2.0)
+        with pytest.raises(ValueError):
+            view.get(max_staleness=-1)
+
 
 class TestStaging:
     def test_staged_equals_direct(self, mesh8):

@@ -84,7 +84,15 @@ DEFAULT_WATCH = ("value", "e2e_words_per_sec", "lda_doc_tokens_per_sec",
                  # second with dispatch-cycle request fusion ON — a
                  # regression here means the fusion drain stopped
                  # batching the dispatch hot path
-                 "serving_mp_ops_per_sec")
+                 "serving_mp_ops_per_sec",
+                 # fleet lane (serving_mp --servers N): aggregate
+                 # range-read rate against the sharded fleet, and the
+                 # per-server scaling efficiency (speedup / N) — a drop
+                 # in either means the scatter-gather router or the
+                 # partitioned servers stopped turning N processes into
+                 # served throughput
+                 "serving_fleet_ops_per_sec",
+                 "fleet_scaling_efficiency")
 
 # LOWER-is-better watches: a rise past the threshold regresses
 DEFAULT_WATCH_LOWER = ("serving_p99_ms",
@@ -445,6 +453,30 @@ def selftest() -> int:
         fl_doc2["server_shed_per_sec"] = 100.0          # unwatched drop
         assert main([fl_old, put("fl_fast.json", fl_doc2)]) == 0, \
             "a faster protected tail passes; shed rate rides unwatched"
+        # fleet lane lines: the sharded-fleet aggregate read rate and
+        # the scaling efficiency are both higher-is-better — either
+        # collapsing means the partitioned serving path regressed,
+        # while the single-server baseline rate rides unwatched
+        fe_old = put("fe_old.json", {
+            "metric": "serving_fleet_ops_per_sec", "value": 400.0,
+            "unit": "ops/s", "serving_fleet_ops_per_sec": 400.0,
+            "serving_fleet_single_ops_per_sec": 200.0,
+            "fleet_speedup": 2.0, "fleet_scaling_efficiency": 1.0,
+            "fleet_servers": 2.0})
+        fe_doc = json.loads(json.dumps(json.load(open(fe_old))))
+        fe_doc["serving_fleet_ops_per_sec"] = 120.0     # -70%
+        fe_doc["value"] = 120.0
+        assert main([fe_old, put("fe_slow.json", fe_doc)]) == 1, \
+            "fleet aggregate read-rate drop must fail"
+        fe_doc2 = json.loads(json.dumps(json.load(open(fe_old))))
+        fe_doc2["fleet_scaling_efficiency"] = 0.4       # -60%
+        fe_doc2["fleet_speedup"] = 0.8
+        assert main([fe_old, put("fe_eff.json", fe_doc2)]) == 1, \
+            "fleet scaling-efficiency collapse must fail"
+        fe_doc3 = json.loads(json.dumps(json.load(open(fe_old))))
+        fe_doc3["serving_fleet_single_ops_per_sec"] = 60.0  # unwatched
+        assert main([fe_old, put("fe_base.json", fe_doc3)]) == 0, \
+            "the single-server baseline rides along unwatched"
         # unusable inputs exit 2, not a traceback
         hung = put("hung.json", {"rc": 124, "tail": "...", "parsed": None})
         assert main([hung, raw_ok]) == 2, "no parsed line -> exit 2"

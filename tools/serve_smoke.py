@@ -13,7 +13,10 @@ over real HTTP and assert the whole loop closed:
 - ``/statusz`` shows the armed SLO rule, the serving tables, and the
   kernel-engine selections,
 - ``/trace`` serves span JSONL whose request ids stitch client spans
-  to their dispatch/flush children.
+  to their dispatch/flush children,
+- a real 2-member sharded fleet (``--fleet 2`` launcher subprocesses)
+  answers ``/statusz?fleet=1`` with every partition's owned ranges,
+  queue depth, and admission counters.
 
 Exit code 0 = the serving story works; any assertion prints a reason
 and exits 1. Stdlib only (urllib against our own stdlib server).
@@ -23,8 +26,10 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import tempfile
+import time
 import urllib.request
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
@@ -53,6 +58,86 @@ def fetch(port: int, path: str) -> tuple:
     with urllib.request.urlopen(
             f"http://127.0.0.1:{port}{path}", timeout=30) as r:
         return r.status, r.read()
+
+
+def fleet_smoke() -> None:
+    """Spawn a real 2-member sharded fleet (separate launcher process
+    per `python -m multiverso_tpu.server --fleet 2`), put one table on
+    it through the scatter-gather router, then scrape a MEMBER's
+    ``/statusz?fleet=1`` and assert the aggregated partition digest:
+    both ranks present, owned ranges, queue/admission fields."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fleet_file = os.path.join(_TMP, "fleet.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo,
+               MVTPU_STATUSZ_PORT="0")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "multiverso_tpu.server", "--fleet", "2",
+         "--address", "unix:" + os.path.join(_TMP, "fleet.sock"),
+         "--name", "smoke-fleet", "--fleet-file", fleet_file],
+        env=env, cwd=repo)
+    try:
+        doc = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if os.path.exists(fleet_file):
+                try:
+                    with open(fleet_file) as f:
+                        doc = json.load(f)
+                except ValueError:
+                    doc = None
+                if doc and len(doc.get("members", [])) == 2:
+                    break
+            if proc.poll() is not None:
+                check(False, f"fleet launcher stayed up "
+                             f"(rc={proc.returncode})")
+                return
+            time.sleep(0.1)
+        check(doc is not None and len(doc.get("members", [])) == 2,
+              "fleet launcher published a 2-member fleet file")
+        if not doc or len(doc.get("members", [])) != 2:
+            return
+
+        from multiverso_tpu.client import router
+        import numpy as np
+        fc = router.connect_fleet_file(fleet_file, client="smoke",
+                                       quant=None)
+        t = fc.create_array("smoke_fleet_w", 64)
+        t.add(np.ones(64, np.float32), sync=True)
+        got = t.get()
+        check(got.tobytes() == np.ones(64, np.float32).tobytes(),
+              "scatter-gather get over the fleet is bit-exact")
+
+        sport = doc["members"][0]["statusz_port"]
+        code, body = fetch(sport, "/statusz?fleet=1")
+        fdoc = json.loads(body)
+        check(code == 200
+              and fdoc.get("kind") == "mvtpu.statusz.fleet.v1",
+              "/statusz?fleet=1 serves the fleet document")
+        parts = fdoc.get("partitions", [])
+        check(len(parts) == 2 and not any("error" in p for p in parts),
+              f"fleet document aggregates both members without errors "
+              f"({[p.get('error') for p in parts if 'error' in p]})")
+        for p in parts:
+            rows = p.get("partitions") or []
+            check(any(r.get("rank") == p.get("rank") for r in rows),
+                  f"member rank {p.get('rank')} reports its own rank")
+            check(any(r.get("queued") is not None
+                      and "queue_bound" in r      # None = unbounded
+                      and "shed" in (r.get("admission") or {})
+                      for r in rows),
+                  f"member rank {p.get('rank')} digest carries queue + "
+                  f"admission fields")
+            check(any(r.get("tables") for r in rows),
+                  f"member rank {p.get('rank')} lists its table shard "
+                  f"ranges")
+        fc.close()
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
 
 
 def main() -> int:
@@ -128,6 +213,8 @@ def main() -> int:
         check(False, "unknown path returns 404")
     except urllib.error.HTTPError as e:
         check(e.code == 404, f"unknown path returns 404 ({e.code})")
+
+    fleet_smoke()
 
     if FAILURES:
         print(f"serve-smoke: FAILED ({len(FAILURES)}): {FAILURES}",
