@@ -68,6 +68,14 @@
 #                   the surviving shard serving; emits
 #                   serving_mp_fleet.json — a partial line on every
 #                   give-up path
+#   make trace-smoke - distributed-tracing smoke: a real 2-member
+#                   fleet + a traced client fleet get, then a
+#                   telemetry.report --fleet scrape-merge; asserts one
+#                   request id reconstructs as ONE parent-linked tree
+#                   across all 3 processes (client root, rparent-
+#                   stitched server spans, chrome flow arrows),
+#                   non-null clock offsets against both members, and a
+#                   merged mvtpu.metrics.v1 fleet snapshot
 #   make chaos    - the chaos lane: fault-injection test subset
 #                   (ft subsystem + overwrite crash-window fuzz) plus a
 #                   CLI checkpoint/resume smoke under an active
@@ -81,8 +89,8 @@ NEW ?= BENCH_r05.json
 
 .PHONY: test dryrun bench bench-dryrun bench-diff bench-diff-selftest \
 	client-bench ckpt-bench kernel-bench tier-bench serve-smoke \
-	mp-smoke flood-smoke fleet-smoke health-smoke chaos fuzz lint \
-	native ci
+	mp-smoke flood-smoke fleet-smoke trace-smoke health-smoke chaos \
+	fuzz lint native ci
 
 fuzz:
 	$(PY) tests/deep_fuzz.py
@@ -126,6 +134,9 @@ flood-smoke:
 fleet-smoke:
 	MVTPU_SERVING_MP_TINY=1 $(PY) benchmarks/serving_mp.py --servers 2
 
+trace-smoke:
+	$(PY) tools/trace_smoke.py
+
 health-smoke:
 	$(PY) tools/health_smoke.py
 
@@ -164,4 +175,4 @@ native:
 
 ci: lint bench-diff-selftest native test dryrun bench-dryrun \
 	client-bench ckpt-bench kernel-bench tier-bench serve-smoke \
-	mp-smoke flood-smoke fleet-smoke health-smoke chaos
+	mp-smoke flood-smoke fleet-smoke trace-smoke health-smoke chaos

@@ -46,6 +46,12 @@ Emits (stdout JSON + ``serving_mp_bench.json``):
 - ``serving_mp_ops_per_sec`` — fused-lane add throughput (watched
   higher-is-better), plus ``serving_mp_ops_per_sec_unfused`` and
   ``serving_mp_fuse_ratio``;
+- ``serving_mp_traced_ops_per_sec`` — add throughput with the wire
+  trace context stamped on every frame (``MVTPU_WIRE_TRACE=1``),
+  gated within ``TRACE_OVERHEAD`` of the same lane run with
+  ``MVTPU_WIRE_TRACE=0`` (``serving_mp_untraced_ops_per_sec``,
+  ratio in ``serving_mp_trace_ratio``): distributed tracing must be
+  cheap enough to leave on;
 - ``shm_rtt_us`` — median ``shm://`` get() round trip (watched
   lower-is-better), plus ``tcp_rtt_us`` for the loopback baseline.
 
@@ -137,6 +143,11 @@ MIN_BYTES_RATIO = 4.0    # dense add-path tx ≥ this × quant tx
 FUSE_RATIO = float(os.environ.get("MVTPU_SERVING_MP_FUSE_RATIO", "")
                    or (1.1 if TINY else 2.0))
 FUSE_K = 16
+# traced ops/sec ≥ this × untraced: the ~100-byte trace context per
+# frame (and the server's retroactive span emission) must stay under
+# a 5% throughput tax, or tracing can't default on
+TRACE_OVERHEAD = float(os.environ.get("MVTPU_SERVING_MP_TRACE_OVERHEAD",
+                                      "") or 0.95)
 # RTT probe: pipelined staleness reads of a 512 KiB table — big
 # replies + a drained pipeline make the TRANSPORT the variable
 # (kernel copies + flow control vs ring memcpys), not the scheduler
@@ -351,8 +362,11 @@ def run_ops_worker(address: str, lane: str, rank: int,
 
     client = transport.connect(address, client=f"{lane}-w{rank}",
                                quant=None, seed=4321 + rank)
-    table = client.create_array("w_ops", OPS["size"],
-                                updater="default")
+    # the trace-overhead lanes point this at a dedicated table so the
+    # fused-vs-unfused bit-exactness compare on w_ops stays untouched
+    table = client.create_array(
+        os.environ.get("MVTPU_OPS_TABLE", "w_ops"), OPS["size"],
+        updater="default")
     delta = ops_delta(rank)
     table.get()     # warm the table + connection outside the window
     t0 = time.perf_counter()
@@ -589,7 +603,8 @@ def _stop_server(proc) -> None:
 
 
 def _spawn_workers(address: str, lane: str, mode: str, n: int,
-                   quant: Optional[str] = None) -> list:
+                   quant: Optional[str] = None,
+                   env: Optional[dict] = None) -> list:
     procs = []
     for rank in range(n):
         cmd = [sys.executable, os.path.abspath(__file__), "--worker",
@@ -598,7 +613,7 @@ def _spawn_workers(address: str, lane: str, mode: str, n: int,
         if quant:
             cmd += ["--quant", quant]
         procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                                      text=True))
+                                      text=True, env=env))
     return procs
 
 
@@ -620,10 +635,11 @@ def _collect(procs: list, lane: str) -> List[dict]:
 
 def _run_lane(address: str, lane: str, quant: Optional[str],
               *, mode: str = "train",
-              workers: Optional[int] = None) -> Dict[str, object]:
+              workers: Optional[int] = None,
+              env: Optional[dict] = None) -> Dict[str, object]:
     n = workers if workers is not None else N_WORKERS
     t0 = time.perf_counter()
-    procs = _spawn_workers(address, lane, mode, n, quant)
+    procs = _spawn_workers(address, lane, mode, n, quant, env)
     results = _collect(procs, lane)
     wall_s = time.perf_counter() - t0
     agg = {"lane": lane, "wall_s": wall_s, "workers": results,
@@ -1053,6 +1069,28 @@ def main() -> None:
                                     mode="ops", workers=OPS_WORKERS)
             ops_fused = _run_lane(addrs_b["unix"], "ops_fused", None,
                                   mode="ops", workers=OPS_WORKERS)
+
+            # tracing-overhead pair: same fused server, a dedicated
+            # table, wire trace context ON vs OFF. No trace sink in
+            # either lane — the gated cost is the stamped header
+            # bytes + the server's span bookkeeping, not disk writes.
+            def _trace_lane(flag: str, lane: str) -> Dict[str, object]:
+                env = dict(os.environ, JAX_PLATFORMS="cpu",
+                           MVTPU_WIRE_TRACE=flag,
+                           MVTPU_OPS_TABLE="w_traced")
+                env.pop("MVTPU_TRACE_JSONL", None)
+                env.pop("MVTPU_TRACE_DIR", None)
+                return _run_lane(addrs_b["unix"], lane, None,
+                                 mode="ops", workers=OPS_WORKERS,
+                                 env=env)
+            ops_untraced = _trace_lane("0", "ops_untraced")
+            ops_traced = _trace_lane("1", "ops_traced")
+            if (ops_traced["ops_per_sec"]
+                    < TRACE_OVERHEAD * ops_untraced["ops_per_sec"]):
+                # one retry: co-tenant noise on a small host dwarfs
+                # the ~100 header bytes being gated here
+                ops_untraced = _trace_lane("0", "ops_untraced")
+                ops_traced = _trace_lane("1", "ops_traced")
             tcp_rtt_us, shm_rtt_us = _rtt_pair(addrs_a["tcp"],
                                                addrs_a["shm"])
             # final params come off the SERVERS (whatever the workers'
@@ -1130,6 +1168,14 @@ def main() -> None:
         f"shm rtt {shm_rtt_us:.1f}us not better than tcp loopback " \
         f"{tcp_rtt_us:.1f}us"
 
+    trace_ratio = (ops_traced["ops_per_sec"]
+                   / max(ops_untraced["ops_per_sec"], 1e-9))
+    assert trace_ratio >= TRACE_OVERHEAD, \
+        f"wire tracing costs too much: traced " \
+        f"{ops_traced['ops_per_sec']:.0f} adds/s vs untraced " \
+        f"{ops_untraced['ops_per_sec']:.0f} " \
+        f"(ratio {trace_ratio:.3f} < {TRACE_OVERHEAD})"
+
     all_lat = np.asarray(dense["lat_ms"] + quant["lat_ms"])
     total_bytes = sum(l["tx_bytes"] + l["rx_bytes"]
                       for l in (dense, quant))
@@ -1152,6 +1198,11 @@ def main() -> None:
         "serving_mp_ops_per_sec_unfused": round(
             ops_unfused["ops_per_sec"], 1),
         "serving_mp_fuse_ratio": round(fuse_ratio, 2),
+        "serving_mp_traced_ops_per_sec": round(
+            ops_traced["ops_per_sec"], 1),
+        "serving_mp_untraced_ops_per_sec": round(
+            ops_untraced["ops_per_sec"], 1),
+        "serving_mp_trace_ratio": round(trace_ratio, 3),
         "serving_mp_ops_workers": OPS_WORKERS,
         "shm_rtt_us": round(shm_rtt_us, 1),
         "tcp_rtt_us": round(tcp_rtt_us, 1),

@@ -82,6 +82,8 @@ transport = _dep("multiverso_tpu.client.transport",
                  "client", "transport.py")
 partition = _dep("multiverso_tpu.server.partition",
                  "server", "partition.py")
+_trace = _dep("multiverso_tpu.telemetry.trace", "telemetry",
+              "trace.py")
 
 
 def load_router(package_dir: str):
@@ -171,9 +173,11 @@ class FleetArrayTable(_FleetTable):
         """Whole-table scatter-gather: each server returns its shard
         concurrently; concat in rank order is the inverse map (the
         zero-index-math payoff of contiguous ownership)."""
-        parts = self.fleet._fanout(
-            [lambda s=s: s.get(staleness=staleness) for s in self.subs])
-        return np.concatenate(parts)
+        with _trace.request("fleet.get", table=self.name):
+            parts = self.fleet._fanout(
+                [lambda s=s: s.get(staleness=staleness)
+                 for s in self.subs])
+            return np.concatenate(parts)
 
     def get_range(self, lo: int, hi: int,
                   staleness: Optional[int] = None) -> np.ndarray:
@@ -189,9 +193,11 @@ class FleetArrayTable(_FleetTable):
         b = self._bounds
         ranks = [r for r in range(self.pmap.n)
                  if b[r] < hi and b[r + 1] > lo]
-        parts = self.fleet._fanout(
-            [lambda s=self.subs[r]: s.get(staleness=staleness)
-             for r in ranks])
+        with _trace.request("fleet.get_range", table=self.name,
+                            lo=lo, hi=hi):
+            parts = self.fleet._fanout(
+                [lambda s=self.subs[r]: s.get(staleness=staleness)
+                 for r in ranks])
         if len(parts) == 1:
             r = ranks[0]
             return parts[0][lo - b[r]:hi - b[r]]
@@ -208,8 +214,9 @@ class FleetArrayTable(_FleetTable):
                 f"fleet add to {self.name!r} expects shape "
                 f"({self.size},), got {delta.shape}")
         b = self._bounds
-        handles = [sub.add(delta[b[r]:b[r + 1]], option)
-                   for r, sub in enumerate(self.subs)]
+        with _trace.request("fleet.add", table=self.name):
+            handles = [sub.add(delta[b[r]:b[r + 1]], option)
+                       for r, sub in enumerate(self.subs)]
         handle = FleetHandle(handles)
         if sync:
             handle.wait()
@@ -250,10 +257,11 @@ class FleetKVTable(_FleetTable):
         values = np.zeros(shape, self.dtype)
         found = np.zeros(n, bool)
         routed = self._route(keys)
-        replies = self.fleet._fanout(
-            [lambda r=r, idx=idx: self.subs[r].get(
-                keys[idx], staleness=staleness)
-             for r, idx in routed])
+        with _trace.request("fleet.kv_get", table=self.name):
+            replies = self.fleet._fanout(
+                [lambda r=r, idx=idx: self.subs[r].get(
+                    keys[idx], staleness=staleness)
+                 for r, idx in routed])
         for (r, idx), (vals, fnd) in zip(routed, replies):
             values[idx] = vals
             found[idx] = fnd
@@ -268,17 +276,19 @@ class FleetKVTable(_FleetTable):
         keys = np.ascontiguousarray(np.asarray(keys, np.uint64))
         deltas = np.asarray(deltas, self.dtype)
         handles = []
-        for r, idx in self._route(keys):
-            sub_keys = keys[idx]
-            sub_deltas = deltas[idx]
-            uniq, inv = np.unique(sub_keys, return_inverse=True)
-            if uniq.shape[0] != sub_keys.shape[0]:
-                acc = np.zeros((uniq.shape[0],) + sub_deltas.shape[1:],
-                               sub_deltas.dtype)
-                np.add.at(acc, inv, sub_deltas)
-                sub_keys, sub_deltas = uniq, acc
-            handles.append(self.subs[r].add(sub_keys, sub_deltas,
-                                            option))
+        with _trace.request("fleet.kv_add", table=self.name):
+            for r, idx in self._route(keys):
+                sub_keys = keys[idx]
+                sub_deltas = deltas[idx]
+                uniq, inv = np.unique(sub_keys, return_inverse=True)
+                if uniq.shape[0] != sub_keys.shape[0]:
+                    acc = np.zeros(
+                        (uniq.shape[0],) + sub_deltas.shape[1:],
+                        sub_deltas.dtype)
+                    np.add.at(acc, inv, sub_deltas)
+                    sub_keys, sub_deltas = uniq, acc
+                handles.append(self.subs[r].add(sub_keys, sub_deltas,
+                                                option))
         handle = FleetHandle(handles)
         if sync:
             handle.wait()
@@ -327,10 +337,23 @@ class FleetClient:
     def _fanout(self, thunks: Sequence[Any]) -> List[Any]:
         """Run per-server sub-requests concurrently; surface the first
         failure (a dead member fails ITS sub-request after its client's
-        retry budget — other shards' results are already home)."""
+        retry budget — other shards' results are already home).
+
+        Trace linkage: the caller's request scope is captured on THIS
+        thread and adopted inside every pooled thunk, so each shard's
+        ``wire.client.*`` span — and through the wire context, each
+        member server's spans — parent under the ONE fleet request
+        (one fleet get = one tree spanning N+1 processes)."""
         if len(thunks) <= 1:
             return [t() for t in thunks]
-        futures = [self._pool.submit(t) for t in thunks]
+        token = _trace.link()
+
+        def run(t, shard):
+            with _trace.adopt(token), \
+                    _trace.span("fleet.fanout", shard=shard):
+                return t()
+        futures = [self._pool.submit(run, t, shard)
+                   for shard, t in enumerate(thunks)]
         return [f.result() for f in futures]
 
     # -- table surface -----------------------------------------------------

@@ -106,6 +106,7 @@ wire = _dep("multiverso_tpu.server.wire", "server", "wire.py")
 wiresock = _dep("multiverso_tpu.io.wiresock", "io", "wiresock.py")
 _chaos = _dep("multiverso_tpu.ft.chaos", "ft", "chaos.py")
 _retry = _dep("multiverso_tpu.ft.retry", "ft", "retry.py")
+_trace = _dep("multiverso_tpu.telemetry.trace", "telemetry", "trace.py")
 
 
 def load_transport(package_dir: str):
@@ -128,6 +129,11 @@ def load_transport(package_dir: str):
 #: max adds on the wire unacked; MUST stay below the server's dedup
 #: cache depth (256) or a resend could outrun the replay window
 MAX_PIPELINE = 64
+
+#: per-connection clock-offset re-sample period (seconds). The ping
+#: RTT-midpoint estimate drifts with the hosts' clocks; re-sampling
+#: keeps merged fleet timelines honest without a ping per request.
+CLOCK_RESAMPLE_S = 30.0
 
 _OPTION_FIELDS = ("learning_rate", "momentum", "rho", "lam")
 
@@ -222,6 +228,14 @@ class WireClient:
         self.reconnects = 0
         self.sheds = 0              # shed replies honored (bench reads)
         self._shed_wait_s = 0.0     # retry-after slept since last ack
+        # ping-based clock alignment vs this server (RTT midpoint):
+        # offset_us = server wall clock minus ours; the fleet report
+        # shifts the server's spans by it when merging timelines
+        self.clock_offset_us: Optional[float] = None
+        self.clock_rtt_us: Optional[float] = None
+        self.server_ident: Optional[Dict[str, Any]] = None
+        self._clock_sampled = 0.0
+        self._clock_sampling = False
         self._closed = False
         self._retry_loop(self._ensure_connected)
 
@@ -353,6 +367,58 @@ class WireClient:
             except Exception:
                 pass
 
+    @staticmethod
+    def _gauge(name: str, value: float, **labels) -> None:
+        m = sys.modules.get("multiverso_tpu.telemetry.metrics")
+        if m is not None:
+            try:
+                m.gauge(name, **labels).set(value)
+            except Exception:
+                pass
+
+    # -- clock alignment ----------------------------------------------------
+
+    def _maybe_sample_clock(self) -> None:
+        """Re-estimate this connection's clock offset every
+        :data:`CLOCK_RESAMPLE_S`: ping, take ``t_server`` from the
+        reply, and put the server's clock at the RTT midpoint —
+        ``offset_us = t_server - (t0 + t1)/2``. Published as the
+        ``wire.clock.offset_us`` gauge and a ``clock`` trace record so
+        merged fleet timelines can shift the server's spans honestly.
+        Best-effort: estimation failures never touch the data path."""
+        if self._clock_sampling or self._closed:
+            return
+        now = time.monotonic()
+        if self._clock_sampled \
+                and now - self._clock_sampled < CLOCK_RESAMPLE_S:
+            return
+        self._clock_sampling = True
+        self._clock_sampled = now
+        try:
+            t0 = time.time()
+            header, _ = self.call("ping")
+            t1 = time.time()
+            t_server = header.get("t_server")
+            if t_server is None:
+                return
+            offset_us = (float(t_server) - (t0 + t1) / 2.0) * 1e6
+            rtt_us = max(t1 - t0, 0.0) * 1e6
+            self.clock_offset_us = offset_us
+            self.clock_rtt_us = rtt_us
+            peer = {k: header[k] for k in ("host", "pid")
+                    if header.get(k) is not None}
+            self.server_ident = peer or None
+            self._gauge("wire.clock.offset_us", offset_us,
+                        addr=self.address)
+            try:
+                _trace.clock_record(peer, offset_us, rtt_us)
+            except Exception:
+                pass
+        except (ConnectionError, OSError, _retry.RetryError):
+            pass
+        finally:
+            self._clock_sampling = False
+
     # -- request plumbing --------------------------------------------------
 
     def _next_rid(self) -> int:
@@ -432,7 +498,14 @@ class WireClient:
                 f"the retry deadline {policy.deadline_s}s without an "
                 "ack")
         if delay > 0:
-            time.sleep(delay)
+            # the shed reply echoes who shed what (server name, QoS
+            # class, trace id) — the retry-wait span names them, so a
+            # slow traced request shows WHERE its wait went
+            attrs = {k: header[k]
+                     for k in ("server", "class", "req")
+                     if header.get(k) is not None}
+            with _trace.span("wire.client.shed_wait", **attrs):
+                time.sleep(delay)
 
     def _recv_until(self, rid: int, resend=None
                     ) -> Tuple[Dict[str, Any], List[np.ndarray]]:
@@ -464,7 +537,9 @@ class WireClient:
         """Synchronous request/reply (drains pending acks on the way).
         Reconnects + retries on transport faults; application errors
         (:class:`RemoteError`) and protocol desync are never retried."""
-        with self._lock:
+        with self._lock, \
+                _trace.request(f"wire.client.{op}", op=op,
+                               addr=self.address):
             req = dict(header or {})
             req["op"] = op
             req["rid"] = self._next_rid()
@@ -472,6 +547,10 @@ class WireClient:
                 # stamped ONCE: shed/reconnect resends keep the
                 # original expiry (a deadline is end-to-end)
                 wire.stamp_deadline(req, self.deadline_s)
+            if wire.trace_enabled():
+                # also stamped once: resends ship the identical trace
+                # context, so the server-side tree stays one tree
+                wire.stamp_trace(req, _trace.wire_context())
             arrays = [np.ascontiguousarray(a) for a in arrays]
 
             def attempt():
@@ -485,18 +564,27 @@ class WireClient:
                 except (ConnectionError, OSError):
                     self._mark_dead()
                     raise
-            return self._retry_loop(attempt)
+            result = self._retry_loop(attempt)
+            if op != "shutdown":    # never ping a server we just told
+                self._maybe_sample_clock()  # to drain and exit
+            return result
 
     def submit(self, header: Dict[str, Any],
                arrays: Sequence[np.ndarray]) -> int:
         """Pipelined mutation: send now, ack later. Returns the rid
         (wait for it with :meth:`drain_to`)."""
-        with self._lock:
+        with self._lock, \
+                _trace.request(
+                    f"wire.client.{header.get('op', 'submit')}",
+                    op=str(header.get("op", "submit")),
+                    addr=self.address):
             rid = self._next_rid()
             req = dict(header)
             req["rid"] = rid
             if self.deadline_s:
                 wire.stamp_deadline(req, self.deadline_s)
+            if wire.trace_enabled():
+                wire.stamp_trace(req, _trace.wire_context())
             p = _Pending(rid, req,
                          [np.ascontiguousarray(a) for a in arrays])
             self._pending.append(p)

@@ -275,6 +275,16 @@ class AdmissionController:
                 return c
         return self.classes[-1]     # unreachable: catch-all exists
 
+    def class_name(self, client_id: str,
+                   header: Optional[Dict[str, Any]] = None) -> str:
+        """QoS class label for one request — what the slow-request
+        exemplar rows record (control ops ride the priority lane and
+        report as ``"control"``)."""
+        if header is not None \
+                and str(header.get("op")) in CONTROL_OPS:
+            return "control"
+        return self.classify(client_id).name
+
     def offer(self, client_id: str, header: Dict[str, Any],
               item: tuple) -> Optional[Dict[str, Any]]:
         """Admit ``item`` into the fair queue (returns None) or shed it

@@ -195,6 +195,12 @@ class TableReplica:
         self._g_stale.set(float(lag))
         head = {"ok": True, "gen": gen, "replica": True,
                 "staleness": lag}
+        # trace echo (the wire's TRACE_KEY, read raw — this module
+        # never imports the codec): a replica-served reply names the
+        # request it answered, like shed/expired replies do
+        tr = header.get("trace")
+        if isinstance(tr, dict) and tr.get("req") is not None:
+            head["req"] = tr["req"]
         if degraded:
             head["degraded"] = True
         if self.kind == "array":

@@ -74,6 +74,7 @@ Fault containment is the design center, not an afterthought:
 from __future__ import annotations
 
 import collections
+import heapq
 import os
 import queue
 import socket
@@ -90,6 +91,7 @@ from multiverso_tpu.server import admission as _admission_mod
 from multiverso_tpu.server import wire
 from multiverso_tpu.server.replica import TableReplica
 from multiverso_tpu.telemetry import metrics as telemetry
+from multiverso_tpu.telemetry import trace as _trace
 from multiverso_tpu.updaters import AddOption
 from multiverso_tpu.utils import log
 
@@ -100,6 +102,12 @@ _OPTION_FIELDS = ("learning_rate", "momentum", "rho", "lam")
 FUSE_ENV = "MVTPU_SERVER_FUSE"
 DEDUP_ENV = "MVTPU_WIRE_DEDUP"
 DEDUP_CLIENTS_ENV = "MVTPU_WIRE_DEDUP_CLIENTS"
+EXEMPLARS_ENV = "MVTPU_SERVER_EXEMPLARS"
+
+#: default size of the slow-request exemplar ring: the top-N slowest
+#: fully-settled requests (queue + execute), kept per server so a p999
+#: violation names the actual requests and stages behind it
+_EXEMPLARS = 8
 
 #: default replies cached per client for dedup replay
 _DEDUP_CACHE = 256
@@ -287,6 +295,13 @@ class TableServer:
                                                 server=self.name)
         self._c_fuse_frames = telemetry.counter("server.fuse.frames",
                                                 server=self.name)
+        # slow-request exemplars: a min-heap of (total_s, seq, row)
+        # keeps the top-N slowest settled requests with their per-stage
+        # breakdown (surfaced via status() -> /statusz)
+        self._exemplar_cap = max(_env_int(EXEMPLARS_ENV, _EXEMPLARS), 1)
+        self._exemplars: List[tuple] = []
+        self._exemplar_seq = 0
+        self._exemplar_lock = threading.Lock()
         self._ops = 0
 
     # -- lifecycle ---------------------------------------------------------
@@ -368,7 +383,25 @@ class TableServer:
                 "partition": part,
                 "admission": self._admission.status(),
                 "replicas": [rep.status()
-                             for rep in self._replicas.values()]}
+                             for rep in self._replicas.values()],
+                "slow": self.slow_exemplars()}
+
+    def slow_exemplars(self) -> List[Dict[str, Any]]:
+        """The exemplar ring, slowest first: one row per settled
+        request with its per-stage (queue/execute) breakdown."""
+        with self._exemplar_lock:
+            entries = sorted(self._exemplars, key=lambda e: -e[0])
+        return [row for _total, _seq, row in entries]
+
+    def _note_exemplar(self, total_s: float,
+                       row: Dict[str, Any]) -> None:
+        with self._exemplar_lock:
+            self._exemplar_seq += 1
+            entry = (total_s, self._exemplar_seq, row)
+            if len(self._exemplars) < self._exemplar_cap:
+                heapq.heappush(self._exemplars, entry)
+            elif total_s > self._exemplars[0][0]:
+                heapq.heapreplace(self._exemplars, entry)
 
     # -- accept / read / write threads -------------------------------------
 
@@ -446,6 +479,7 @@ class TableServer:
                 break
             if header.get("staleness") is not None \
                     and header.get("op") in ("get", "kv_get"):
+                t_rep = time.time()
                 try:
                     # degraded-mode routing: while writes are being
                     # shed, serve from the replica even past the
@@ -455,6 +489,17 @@ class TableServer:
                         relax=self._admission.degraded())
                 except Exception:   # noqa: BLE001 — containment: a
                     reply = None    # replica bug degrades to dispatch
+                ctx = wire.trace_ctx(header)
+                if ctx is not None and _trace.active():
+                    # reader-thread replica span, parented under the
+                    # originating client request (hit -> answered
+                    # here; miss -> the dispatch spans follow)
+                    with _trace.adopt_remote(ctx):
+                        _trace.emit_span(
+                            "server.replica.get", t_rep,
+                            time.time() - t_rep, server=self.name,
+                            op=str(header.get("op")),
+                            hit=reply is not None)
                 if reply is not None:
                     rheader, rarrays = reply
                     rheader.setdefault("rid", header.get("rid"))
@@ -485,6 +530,13 @@ class TableServer:
             (conn, header, arrays, time.monotonic()))
         if shed is not None:
             shed["rid"] = header.get("rid")
+            # shed replies name the shedder and echo the trace id, so
+            # the client's retry-wait span says which server/class
+            # shed it
+            shed.setdefault("server", self.name)
+            ctx = wire.trace_ctx(header)
+            if ctx is not None and ctx.get("req") is not None:
+                shed.setdefault("req", ctx["req"])
             if conn.alive:
                 conn.sendq.put((shed, []))
 
@@ -549,12 +601,12 @@ class TableServer:
             # request is dead work — answer it, don't execute it
             batch = [it for it in batch if not self._drop_expired(it)]
             if len(batch) == 1:
-                conn, header, arrays, _ = batch[0]
+                conn, header, arrays, enq_ts = batch[0]
                 op = str(header.get("op", "?"))
                 t0 = time.monotonic()
                 reply = self._safe_execute(conn, op, header, arrays)
-                self._finish(conn, op, header.get("rid"), reply, t0,
-                             h_dispatch)
+                self._finish(conn, op, header, reply, t0,
+                             h_dispatch, enq_ts)
             elif batch:
                 self._run_fused_batch(batch, h_dispatch)
             if stop_after:
@@ -571,11 +623,18 @@ class TableServer:
             return False
         self._admission.note_expired()
         if conn.alive:
-            conn.sendq.put(({"ok": False, "expired": True,
-                             "rid": header.get("rid"),
-                             "error": "deadline exceeded before "
-                                      "dispatch (op "
-                                      f"{header.get('op')!r})"}, []))
+            reply = {"ok": False, "expired": True,
+                     "rid": header.get("rid"),
+                     "server": self.name,
+                     "error": "deadline exceeded before "
+                              "dispatch (op "
+                              f"{header.get('op')!r})"}
+            # expired replies echo the trace id like shed replies do:
+            # the client can pin the loss to this server's queue
+            ctx = wire.trace_ctx(header)
+            if ctx is not None and ctx.get("req") is not None:
+                reply["req"] = ctx["req"]
+            conn.sendq.put((reply, []))
         return True
 
     def _safe_execute(self, conn: _Conn, op: str,
@@ -591,13 +650,55 @@ class TableServer:
             return ({"ok": False, "rid": header.get("rid"),
                      "error": f"{type(exc).__name__}: {exc}"}, [])
 
-    def _finish(self, conn: _Conn, op: str, rid,
-                reply: Optional[tuple], t0: float, h_dispatch) -> None:
-        h_dispatch.observe(time.monotonic() - t0)
+    def _finish(self, conn: _Conn, op: str, header: Dict[str, Any],
+                reply: Optional[tuple], t0: float, h_dispatch,
+                enq_ts: Optional[float] = None) -> None:
+        now = time.monotonic()
+        h_dispatch.observe(now - t0)
         self._ops += 1
         telemetry.counter("wire.requests", op=op).inc()
-        if reply is not None and conn.alive:
+        rid = header.get("rid")
+        rheader = rarrays = None
+        if reply is not None:
             rheader, rarrays = reply
+        exec_s = max(now - t0, 0.0)
+        wait_s = max(t0 - enq_ts, 0.0) if enq_ts is not None else 0.0
+        ctx = wire.trace_ctx(header)
+        if ctx is not None and _trace.active():
+            # server-side spans for this settled request, parent-linked
+            # under the originating client request: the queue wait
+            # (measured at dequeue, so emitted retroactively) and the
+            # dispatch/execute stage (fused cycles span the group).
+            # Sink-gated: with nowhere to write, the record assembly
+            # is pure tax on the dispatch thread.
+            fused = (rheader or {}).get("fused")
+            with _trace.adopt_remote(ctx):
+                t_wall = time.time()
+                if enq_ts is not None:
+                    _trace.emit_span("server.queue.wait",
+                                     t_wall - exec_s - wait_s, wait_s,
+                                     server=self.name, op=op)
+                attrs = {"server": self.name, "op": op}
+                if fused:
+                    attrs["fused"] = int(fused)
+                _trace.emit_span(f"server.dispatch.{op}",
+                                 t_wall - exec_s, exec_s, **attrs)
+        if op not in _admission_mod.CONTROL_OPS:
+            row = {"rid": rid, "op": op, "client": conn.client_id,
+                   "class": self._admission.class_name(conn.client_id,
+                                                       header),
+                   "ts": time.time(),
+                   "total_ms": round((wait_s + exec_s) * 1e3, 3),
+                   "stages": {"queue_ms": round(wait_s * 1e3, 3),
+                              "execute_ms": round(exec_s * 1e3, 3)}}
+            if ctx is not None and ctx.get("req") is not None:
+                row["req"] = ctx["req"]
+            if (rheader or {}).get("fused"):
+                row["fused"] = int(rheader["fused"])
+            if rheader is not None and not rheader.get("ok", True):
+                row["error"] = str(rheader.get("error", ""))[:120]
+            self._note_exemplar(wait_s + exec_s, row)
+        if reply is not None and conn.alive:
             rheader.setdefault("rid", rid)
             conn.sendq.put((rheader, rarrays))
 
@@ -619,10 +720,10 @@ class TableServer:
                                                       arrays)
             else:
                 replies.update(self._execute_group(unit))
-        for idx, (conn, header, _arrays, _ts) in enumerate(batch):
+        for idx, (conn, header, _arrays, enq_ts) in enumerate(batch):
             self._finish(conn, str(header.get("op", "?")),
-                         header.get("rid"), replies.get(idx), t0,
-                         h_dispatch)
+                         header, replies.get(idx), t0,
+                         h_dispatch, enq_ts)
 
     def _plan_units(self, batch: List[tuple]) -> List[_Unit]:
         """Group the cycle's frames. A frame may only join a group that
@@ -844,7 +945,12 @@ class TableServer:
                 reply["partition"] = self._partition.describe()
             return (reply, [])
         if op == "ping":
-            return ({"ok": True}, [])
+            # the clock-alignment probe: echo this process's wall
+            # clock + identity; the client puts t_server at the RTT
+            # midpoint to estimate the per-connection offset
+            return ({"ok": True, "t_server": time.time(),
+                     "host": telemetry.host_index(),
+                     "pid": os.getpid()}, [])
         if op == "noop":
             # admission-controlled no-op: what the server.flood chaos
             # point injects (a control op would jump the fair queue)

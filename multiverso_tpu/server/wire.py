@@ -178,6 +178,42 @@ def deadline_expired(header: Dict[str, Any],
         return False
 
 
+# -- trace context propagation ---------------------------------------------
+# Client-stamped trace context in the frame header: request id, parent
+# span id, and the client's (host, pid) identity. The server adopts it
+# (telemetry.trace.adopt_remote) so server-side spans parent-link under
+# the originating client request across the process boundary. Default
+# ON; MVTPU_WIRE_TRACE=0 turns stamping off entirely — the key is then
+# never added, so a disabled wire ships zero extra header bytes.
+
+TRACE_KEY = "trace"
+TRACE_ENV = "MVTPU_WIRE_TRACE"
+
+
+def trace_enabled() -> bool:
+    """``MVTPU_WIRE_TRACE`` knob — default on; "0"/"off"/"false"/"no"
+    disable header trace stamping."""
+    raw = os.environ.get(TRACE_ENV, "").strip().lower()
+    return raw not in ("0", "off", "false", "no")
+
+
+def stamp_trace(header: Dict[str, Any],
+                ctx: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Stamp a trace context into ``header`` (no-op if one is already
+    stamped — a resend must keep its original bytes — or ctx is
+    falsy)."""
+    if ctx and TRACE_KEY not in header:
+        header[TRACE_KEY] = ctx
+    return header
+
+
+def trace_ctx(header: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """The frame's trace context, or None. Malformed values (anything
+    but a dict) count as absent — a bad field must not break serving."""
+    raw = header.get(TRACE_KEY)
+    return raw if isinstance(raw, dict) else None
+
+
 # -- frame codec -----------------------------------------------------------
 
 def encode_frame(header: Dict[str, Any],

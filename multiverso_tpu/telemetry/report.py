@@ -26,6 +26,15 @@ their timestamps — "what was in flight when it died"), or a snapshot's
 N largest counters (hottest tables by bytes/ops) and histograms by
 total time.
 
+``--fleet`` treats PATH as a launcher fleet file and scrapes every
+member's statusz (``/trace`` tail + ``/metrics?json=1``), merges in
+any ``--client-trace`` JSONLs, clock-aligns the timelines from the
+trace's per-connection offset records, and reports the fleet as ONE
+system: a merged ``--chrome-trace`` with a process track per
+(host, pid) and flow arrows stitching each request's cross-process
+tree, plus a fleet-total metrics snapshot (``--snapshot-out``)
+bench_diff can read.
+
 Pure stdlib, never imports jax: it must run against the artifact of a
 HUNG run (the round-5 bench probes wedged with zero diagnostic signal —
 this tool is the post-mortem path) on a host whose accelerator tunnel
@@ -134,6 +143,31 @@ def render_trace(records: List[dict]) -> str:
     return "\n\n".join(out)
 
 
+def clock_offsets(records: List[dict]) -> Dict[tuple, float]:
+    """Per-process timestamp corrections from ``{"kind": "clock"}``
+    records: ``(host, pid) -> seconds to ADD`` to that process's
+    timestamps to land them on the recorder's (the client's) timeline.
+
+    A clock record says ``offset_us = peer_clock - my_clock`` (the
+    RTT-midpoint estimate the transport samples per connection), so the
+    peer's records shift by ``-offset``. A process that recorded clock
+    samples itself IS a reference — it never gets shifted, even when it
+    also appears as someone's peer (the in-process test topology).
+    Latest estimate per peer wins."""
+    offs: Dict[tuple, float] = {}
+    refs = set()
+    for r in records:
+        if r.get("kind") != "clock":
+            continue
+        refs.add((r.get("host", 0), r.get("pid", 0)))
+        peer = r.get("peer") or {}
+        key = (peer.get("host", 0), peer.get("pid", 0))
+        offs[key] = -float(r.get("offset_us", 0.0)) / 1e6
+    for key in refs:
+        offs.pop(key, None)
+    return offs
+
+
 def to_chrome_trace(records: List[dict]) -> dict:
     """Span/step/metric JSONL records → Chrome trace-event JSON
     (Perfetto / chrome://tracing loadable).
@@ -144,19 +178,31 @@ def to_chrome_trace(records: List[dict]) -> dict:
     synthetic ints so two hosts reusing an OS pid can't merge tracks.
     Spans map to "X" complete events (ts/dur in µs; same-thread nesting
     renders as stacked slices), step heartbeats to "i" instants, and
-    metric events to "C" counter series."""
+    metric events to "C" counter series.
+
+    Cross-process: timestamps are clock-aligned per process using the
+    trace's ``clock`` records (see :func:`clock_offsets`), and every
+    span carrying an ``rparent`` (a server-side root serving a remote
+    request) gets a flow arrow ("s"/"f" event pair) from the originating
+    client span — one fleet get renders as one arrow-linked tree
+    spanning N+1 process tracks."""
     events: List[dict] = []
     procs: Dict[tuple, int] = {}
     threads: Dict[tuple, int] = {}
+    offsets = clock_offsets(records)
 
     def track(r: dict) -> tuple:
         host, pid = r.get("host", 0), r.get("pid", 0)
         cpid = procs.get((host, pid))
         if cpid is None:
             cpid = procs[(host, pid)] = len(procs) + 1
+            shift = offsets.get((host, pid))
+            label = f"host{host}/pid{pid}"
+            if shift:
+                label += f" (clock {shift * 1e6:+.0f}us)"
             events.append({"ph": "M", "name": "process_name",
                            "pid": cpid, "tid": 0,
-                           "args": {"name": f"host{host}/pid{pid}"}})
+                           "args": {"name": label}})
         tkey = (host, pid, r.get("tid", 0))
         ctid = threads.get(tkey)
         if ctid is None:
@@ -167,6 +213,14 @@ def to_chrome_trace(records: List[dict]) -> dict:
                            "args": {"name": f"thread-{tkey[2]}"}})
         return cpid, ctid
 
+    def ts_us(r: dict) -> float:
+        shift = offsets.get((r.get("host", 0), r.get("pid", 0)), 0.0)
+        return (float(r.get("ts", 0)) + shift) * 1e6
+
+    # (host, pid, span_id) -> (cpid, ctid, ts_us, dur_us): the flow
+    # stitcher resolves rparent references against this index
+    span_pos: Dict[tuple, tuple] = {}
+    links: List[tuple] = []
     for r in records:
         kind = r.get("kind")
         if kind == "span":
@@ -177,9 +231,18 @@ def to_chrome_trace(records: List[dict]) -> dict:
                 args["parent"] = r["parent"]
             if r.get("req") is not None:
                 args["req"] = r["req"]
+            ts = ts_us(r)
+            dur = max(float(r.get("dur_s", 0)), 0) * 1e6
+            span_pos[(r.get("host", 0), r.get("pid", 0),
+                      r.get("id"))] = (cpid, ctid, ts, dur)
+            rp = r.get("rparent")
+            if isinstance(rp, dict):
+                args["rparent"] = (f"h{rp.get('host', 0)}:"
+                                   f"p{rp.get('pid', 0)}:"
+                                   f"s{rp.get('span')}")
+                links.append(((cpid, ctid, ts, dur), rp))
             events.append({"name": r["name"], "ph": "X", "cat": "span",
-                           "ts": float(r["ts"]) * 1e6,
-                           "dur": max(float(r.get("dur_s", 0)), 0) * 1e6,
+                           "ts": ts, "dur": dur,
                            "pid": cpid, "tid": ctid, "args": args})
         elif kind == "step":
             cpid, ctid = track(r)
@@ -188,14 +251,29 @@ def to_chrome_trace(records: List[dict]) -> dict:
                                  "parent")}
             events.append({"name": f"{r['name']} step {r['step']}",
                            "ph": "i", "cat": "step", "s": "t",
-                           "ts": float(r["ts"]) * 1e6,
+                           "ts": ts_us(r),
                            "pid": cpid, "tid": ctid, "args": args})
         elif "metric" in r:
             cpid, _ = track(r)
             events.append({"name": r["metric"], "ph": "C",
-                           "ts": float(r.get("ts", 0)) * 1e6,
-                           "pid": cpid,
+                           "ts": ts_us(r), "pid": cpid,
                            "args": {"value": r.get("value", 0)}})
+    # flow arrows: remote parent span -> server-side root span. The
+    # "s" binds inside the parent slice, the "f" inside the child.
+    flow = 0
+    for (cpid, ctid, ts, dur), rp in links:
+        parent = span_pos.get((rp.get("host", 0), rp.get("pid", 0),
+                               rp.get("span")))
+        if parent is None:
+            continue
+        flow += 1
+        ppid, ptid, pts, pdur = parent
+        events.append({"ph": "s", "id": flow, "name": "req",
+                       "cat": "req", "ts": pts + pdur / 2,
+                       "pid": ppid, "tid": ptid})
+        events.append({"ph": "f", "bp": "e", "id": flow, "name": "req",
+                       "cat": "req", "ts": ts + dur / 2,
+                       "pid": cpid, "tid": ctid})
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
@@ -267,6 +345,62 @@ def render_metric_events(records: List[dict]) -> str:
             + _table(rows, ["metric", "value", "unit", "ts"]))
 
 
+# -- fleet scrape ----------------------------------------------------------
+
+def _http_get(port: int, path: str, timeout: float = 10.0) -> bytes:
+    import urllib.request
+    url = f"http://127.0.0.1:{port}{path}"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def scrape_fleet(fleet_file: str, client_traces=(),
+                 timeout: float = 10.0):
+    """Scrape every fleet member's statusz (``/trace`` tail +
+    ``/metrics?json=1`` registry snapshot), merge with any local client
+    trace JSONLs, and return ``(records, snapshot, errors)``:
+    time-sorted trace records ready for :func:`to_chrome_trace` (whose
+    clock records align the timelines), one fleet-total
+    ``mvtpu.metrics.v1`` snapshot (None when nothing scraped), and
+    human-readable per-member scrape failures — a partial fleet still
+    yields a partial report."""
+    from multiverso_tpu.server import partition   # jax-free, cheap
+    from multiverso_tpu.telemetry import aggregate
+    doc = partition.read_fleet_file(fleet_file)
+    if doc is None:
+        raise ValueError(f"not a fleet file: {fleet_file}")
+    records: List[dict] = []
+    snaps: List[dict] = []
+    errors: List[str] = []
+    for m in doc.get("members", []):
+        port, rank = m.get("statusz_port"), m.get("rank")
+        if not port:
+            errors.append(f"member rank={rank}: no statusz_port "
+                          "(launch with MVTPU_STATUSZ_PORT)")
+            continue
+        try:
+            tail = _http_get(port, "/trace", timeout)
+            for line in tail.decode("utf-8", "replace").splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except ValueError:
+                    continue
+            snap = json.loads(_http_get(port, "/metrics?json=1",
+                                        timeout))
+            if snap.get("kind") == _metrics.SNAPSHOT_KIND:
+                snaps.append(snap)
+        except (OSError, ValueError) as e:
+            errors.append(f"member rank={rank} port={port}: {e!r}")
+    for path in client_traces:
+        records.extend(_trace.read_trace(path))
+    snap = aggregate.merge_snapshots(snaps) if snaps else None
+    records.sort(key=lambda r: float(r.get("ts", 0)))
+    return records, snap, errors
+
+
 def _load(path: str):
     """Autodetect artifact type → ("snapshot"|"trace"|"events", data)."""
     with open(path) as f:
@@ -306,14 +440,23 @@ def main(argv=None) -> int:
                    help="summarize the training-health metrics of a "
                         "snapshot (health.* stats, violations, "
                         "rollbacks, chaos firings)")
+    p.add_argument("--fleet", action="store_true",
+                   help="treat PATH as a launcher fleet file: scrape "
+                        "/trace + /metrics from every member's statusz "
+                        "port, merge with --client-trace JSONLs, and "
+                        "report the fleet as one system")
+    p.add_argument("--client-trace", action="append", default=[],
+                   metavar="JSONL",
+                   help="local (client-side) trace JSONL to merge into "
+                        "a --fleet report; repeatable")
+    p.add_argument("--snapshot-out", default=None, metavar="OUT",
+                   help="with --fleet: also write the merged "
+                        "fleet-total metrics snapshot (mvtpu.metrics.v1"
+                        " JSON — bench_diff readable) to OUT")
     args = p.parse_args(argv)
-    kind, data = _load(args.path)
-    if args.chrome_trace is not None:
-        if kind == "snapshot":
-            print("--chrome-trace requires a trace or metric-event "
-                  "JSONL, not a snapshot", file=sys.stderr)
-            return 2
-        doc = to_chrome_trace(data)
+
+    def write_chrome(records: List[dict]) -> None:
+        doc = to_chrome_trace(records)
         if args.chrome_trace == "-":
             json.dump(doc, sys.stdout)
             print()
@@ -323,6 +466,39 @@ def main(argv=None) -> int:
             print(f"wrote {len(doc['traceEvents'])} events to "
                   f"{args.chrome_trace} (load at ui.perfetto.dev or "
                   "chrome://tracing)", file=sys.stderr)
+
+    if args.fleet:
+        records, snap, errors = scrape_fleet(args.path,
+                                             args.client_trace)
+        for err in errors:
+            print(f"fleet scrape: {err}", file=sys.stderr)
+        if args.snapshot_out:
+            if snap is None:
+                print("no member snapshot scraped; --snapshot-out "
+                      "skipped", file=sys.stderr)
+            else:
+                with open(args.snapshot_out, "w") as f:
+                    json.dump(snap, f)
+                print(f"wrote fleet metrics snapshot to "
+                      f"{args.snapshot_out}", file=sys.stderr)
+        if args.chrome_trace is not None:
+            write_chrome(records)
+        elif args.top:
+            print(render_top("trace", records, args.top))
+        else:
+            out = [render_trace(records)]
+            if snap is not None:
+                out.append(render_snapshot(snap))
+            print("\n\n".join(out))
+        return 0
+
+    kind, data = _load(args.path)
+    if args.chrome_trace is not None:
+        if kind == "snapshot":
+            print("--chrome-trace requires a trace or metric-event "
+                  "JSONL, not a snapshot", file=sys.stderr)
+            return 2
+        write_chrome(data)
         return 0
     if args.health:
         if kind != "snapshot":

@@ -7,7 +7,9 @@ discipline as the rest of the flight recorder), armed by
 the bound port back via :func:`server`). Endpoints:
 
 - ``/metrics``  — Prometheus text exposition of the local registry
-  (the existing exporter, now scrape-able live). ``/metrics?fleet=1``
+  (the existing exporter, now scrape-able live); ``?json=1`` serves
+  the same registry as a merge-ready JSON snapshot (the fleet report's
+  scrape format). ``/metrics?fleet=1``
   serves the fleet view: computed live on single-process runs, or the
   last snapshot a collective :func:`publish_fleet` call installed on a
   multi-host run — the HTTP thread must NEVER run ``gather_metrics``
@@ -269,13 +271,20 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     return
                 self._reply_json(200, _statusz_doc())
             elif path == "/metrics":
-                if "fleet=1" in query.split("&"):
+                params = query.split("&")
+                if "fleet=1" in params:
                     snap, err = self.server.owner.fleet_view()
                     if snap is None:
                         self._reply(503, (err + "\n").encode(),
                                     "text/plain")
                         return
                     body = _metrics.snapshot_to_prometheus(snap)
+                elif "json=1" in params:
+                    # registry snapshot as JSON — the fleet report
+                    # scrapes this (merge-ready; Prometheus text would
+                    # need a parser the repo doesn't carry)
+                    self._reply_json(200, _metrics.snapshot())
+                    return
                 else:
                     body = _metrics.registry().to_prometheus()
                 self._reply(200, body.encode(), "text/plain")

@@ -86,6 +86,14 @@ def trace_path() -> Optional[str]:
     return _PATH
 
 
+def active() -> bool:
+    """True when a trace sink is configured. Hot paths that BUILD
+    records retroactively (the server's post-dispatch span emission)
+    check this first — with no sink, :func:`_emit` would discard the
+    record anyway, and the dict assembly is the entire cost."""
+    return _FILE is not None
+
+
 def _emit(rec: dict) -> None:
     # identity stamps: host/pid pick the Perfetto process track (and
     # correlate with snapshots, log lines, and watchdog dumps); tid
@@ -141,9 +149,36 @@ def span(name: str, **attrs) -> Iterator[int]:
         rid = getattr(_TLS, "request", None)
         if rid is not None:
             rec["req"] = rid
+        if parent is None:
+            rparent = getattr(_TLS, "rparent", None)
+            if rparent is not None:
+                rec["rparent"] = rparent
         if attrs:
             rec["attrs"] = attrs
         _emit(rec)
+
+
+def emit_span(name: str, ts: float, dur_s: float, **attrs) -> int:
+    """Record an ALREADY-MEASURED interval as a span (retroactive
+    emission — e.g. a queue wait only known at dequeue). Same record
+    shape, parenting, and request stamping as :func:`span`; returns
+    the span id."""
+    sid = next(_IDS)
+    st = _stack()
+    parent = st[-1] if st else None
+    rec = {"kind": "span", "name": name, "id": sid,
+           "parent": parent, "ts": float(ts), "dur_s": float(dur_s)}
+    rid = getattr(_TLS, "request", None)
+    if rid is not None:
+        rec["req"] = rid
+    if parent is None:
+        rparent = getattr(_TLS, "rparent", None)
+        if rparent is not None:
+            rec["rparent"] = rparent
+    if attrs:
+        rec["attrs"] = attrs
+    _emit(rec)
+    return sid
 
 
 # -- request scoping -------------------------------------------------------
@@ -216,6 +251,69 @@ def adopt(token: Optional[LinkToken]) -> Iterator[None]:
         if sid is not None:
             st.pop()
         _TLS.request = prev
+
+
+# -- cross-process propagation (the wire's trace context) ------------------
+# Span ids are process-monotonic ints, so a parent link cannot cross a
+# process boundary by id alone. The wire convention: the client ships
+# ``{"req", "span", "host", "pid"}`` in the frame header
+# (:func:`wire_context`), the server serves the request inside
+# :func:`adopt_remote`, and every server-side ROOT span then carries an
+# ``rparent`` field naming the foreign (host, pid, span) — enough for
+# the chrome exporter to stitch one tree across N+1 processes.
+
+def wire_context() -> dict:
+    """Trace context to stamp into a wire frame header: the current
+    request id (minted fresh when no request scope is open — the server
+    side still gets a groupable tree), the innermost span id as the
+    cross-process parent, and this process's (host, pid) identity."""
+    from multiverso_tpu.telemetry.metrics import host_index
+    rid = getattr(_TLS, "request", None)
+    if rid is None:
+        rid = new_request_id()
+    ctx = {"req": rid, "host": host_index(), "pid": os.getpid()}
+    st = _stack()
+    if st:
+        ctx["span"] = st[-1]
+    return ctx
+
+
+@contextlib.contextmanager
+def adopt_remote(ctx: Optional[dict]) -> Iterator[None]:
+    """Serve a request under a foreign :func:`wire_context`: spans
+    opened inside the block carry the originating request id, and root
+    spans (no local parent) carry an ``rparent`` record naming the
+    remote (host, pid, span) they chain under. Tolerant of missing or
+    malformed contexts — an untraced frame serves exactly as before."""
+    if not isinstance(ctx, dict) or not ctx.get("req"):
+        yield
+        return
+    prev_req = getattr(_TLS, "request", None)
+    prev_rp = getattr(_TLS, "rparent", None)
+    _TLS.request = str(ctx["req"])
+    rparent = {}
+    for key in ("host", "pid", "span"):
+        val = ctx.get(key)
+        if isinstance(val, (int, str)):
+            rparent[key] = val
+    _TLS.rparent = rparent or None
+    try:
+        yield
+    finally:
+        _TLS.request = prev_req
+        _TLS.rparent = prev_rp
+
+
+def clock_record(peer: dict, offset_us: float, rtt_us: float) -> dict:
+    """Record a per-connection clock-offset estimate: ``offset_us`` is
+    the peer's wall clock minus ours (RTT-midpoint method), ``rtt_us``
+    the ping round trip that produced it. The fleet report uses these
+    to shift the peer's spans onto one honest timeline."""
+    rec = {"kind": "clock", "ts": time.time(),
+           "peer": {k: peer[k] for k in ("host", "pid") if k in peer},
+           "offset_us": float(offset_us), "rtt_us": float(rtt_us)}
+    _emit(rec)
+    return rec
 
 
 def step_timeline(name: str, step: int, **fields) -> dict:

@@ -338,6 +338,18 @@ class Watchdog:
                 health_status = health.status()
             except Exception:
                 pass
+        # slowest settled wire requests with their per-stage breakdown
+        # (the in-process table servers' exemplar rings) — names WHICH
+        # requests were pathological, not just that a tail existed
+        slow_requests = []
+        ts_mod = sys.modules.get("multiverso_tpu.server.table_server")
+        if ts_mod is not None:
+            try:
+                slow_requests = [
+                    {"server": s.get("name"), "slow": s.get("slow", [])}
+                    for s in ts_mod.status_all()]
+            except Exception:
+                pass
         with open(os.path.join(path, "watchdog.json"), "w") as f:
             json.dump({
                 "kind": DUMP_KIND, "name": self.name,
@@ -350,6 +362,7 @@ class Watchdog:
                 "queues": queues,
                 "slo_violations": violations,
                 "health": health_status,
+                "slow_requests": slow_requests,
             }, f, indent=1)
         # keep-K retention AFTER the new dump lands: the artifact being
         # written right now must never be the one pruned away

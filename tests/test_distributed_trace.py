@@ -1,0 +1,394 @@
+"""Distributed tracing across the wire: one client request = one
+parent-linked span tree spanning client and server processes. Covers
+the header knob (``MVTPU_WIRE_TRACE=0`` ships zero extra bytes), the
+single-server tree (dispatch/queue-wait/replica children under the
+client root), request-id stability across a chaos reconnect-resend,
+shed replies echoing the trace id, fleet fan-out under one root across
+both members, a REAL server subprocess merged with the local client
+trace (clock samples included), and the report-side stitching math
+(clock offsets + chrome flow arrows)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from multiverso_tpu import client as mv_client
+from multiverso_tpu import core
+from multiverso_tpu.client import router
+from multiverso_tpu.ft import chaos
+from multiverso_tpu.server import partition
+from multiverso_tpu.server import wire
+from multiverso_tpu.server.table_server import TableServer
+from multiverso_tpu.tables import reset_tables
+from multiverso_tpu.telemetry import report
+from multiverso_tpu.telemetry import trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def server(tmp_path):
+    s = TableServer(f"unix:{tmp_path}/wire.sock", name="ttrace")
+    addr = s.start()
+    try:
+        yield s, addr
+    finally:
+        chaos.uninstall_chaos()
+        s.stop()
+        reset_tables()
+        core.shutdown()
+
+
+@pytest.fixture()
+def sink(tmp_path):
+    """Arm the process-wide trace sink for one test; ALWAYS disarm in
+    teardown so the sink never leaks into unrelated tests."""
+    path = tmp_path / "trace.jsonl"
+    trace.set_trace_file(str(path))
+    try:
+        yield path
+    finally:
+        trace.set_trace_file(None)
+
+
+def _connect(addr, **kw):
+    kw.setdefault("quant", None)
+    return mv_client.connect(addr, **kw)
+
+
+def _spans(path, name=None):
+    recs = [r for r in trace.read_trace(str(path))
+            if r.get("kind") == "span"]
+    if name is not None:
+        recs = [r for r in recs if r.get("name") == name]
+    return recs
+
+
+class TestWireKnob:
+    def test_off_ships_zero_extra_bytes(self, monkeypatch):
+        """The call-site contract: knob off -> stamp_trace is never
+        invoked, so the encoded frame is byte-identical to an untraced
+        one; knob on -> the header carries ``trace`` and nothing
+        else changes. Stamp-once: restamping never grows the frame."""
+        def encoded_len(header):
+            _bufs, total = wire.encode_frame(dict(header), [])
+            return total
+
+        base = {"op": "get", "table": 3, "rid": 7}
+        baseline = encoded_len(base)
+
+        monkeypatch.setenv(wire.TRACE_ENV, "0")
+        assert not wire.trace_enabled()
+        off = dict(base)
+        if wire.trace_enabled():            # the transport's call site
+            wire.stamp_trace(off, trace.wire_context())
+        assert wire.TRACE_KEY not in off
+        assert encoded_len(off) == baseline     # zero added bytes
+
+        monkeypatch.delenv(wire.TRACE_ENV, raising=False)
+        assert wire.trace_enabled()             # default ON
+        on = dict(base)
+        wire.stamp_trace(on, trace.wire_context())
+        assert wire.TRACE_KEY in on
+        ctx = on[wire.TRACE_KEY]
+        assert ctx["req"] and "host" in ctx and "pid" in ctx
+        traced = encoded_len(on)
+        assert traced > baseline
+        # resends ship the identical bytes: a second stamp is a no-op
+        wire.stamp_trace(on, trace.wire_context())
+        assert encoded_len(on) == traced
+
+    def test_off_server_emits_no_spans(self, server, sink, monkeypatch):
+        monkeypatch.setenv(wire.TRACE_ENV, "0")
+        _s, addr = server
+        with _connect(addr, client="w-off") as c:
+            t = c.create_array("tr_off", 32)
+            t.add(np.ones(32, np.float32), sync=True)
+            t.get()
+        recs = _spans(sink)
+        # client-local spans still time and nest, but no frame carried
+        # a context, so the server side stays silent and unstitched
+        assert any(r["name"] == "wire.client.get" for r in recs)
+        assert not any(r["name"].startswith("server.") for r in recs)
+        assert not any(r.get("rparent") for r in recs)
+
+
+class TestSingleServerTree:
+    def test_one_get_one_parent_linked_tree(self, server, sink):
+        _s, addr = server
+        with _connect(addr, client="w0") as c:
+            t = c.create_array("tr_w", 64)
+            t.add(np.ones(64, np.float32), sync=True)
+            t.get()
+        roots = [r for r in _spans(sink, "wire.client.get")
+                 if r.get("parent") is None and not r.get("rparent")]
+        assert len(roots) == 1
+        root = roots[0]
+        req = root["req"]
+        dispatch = [r for r in _spans(sink, "server.dispatch.get")
+                    if r.get("req") == req]
+        assert dispatch, "server dispatch span must join the client req"
+        waits = [r for r in _spans(sink, "server.queue.wait")
+                 if r.get("req") == req]
+        assert waits, "queue wait span must join the client req"
+        for r in dispatch + waits:
+            assert r["attrs"]["server"] == "ttrace"
+            rp = r.get("rparent")
+            assert rp is not None, "server root must name its rparent"
+            assert rp["pid"] == os.getpid()
+            assert rp["span"] == root["id"]
+
+    def test_replica_read_span_joins_request(self, server, sink):
+        _s, addr = server
+        with _connect(addr, client="w0") as c:
+            t = c.create_array("tr_rep", 64)
+            t.add(np.ones(64, np.float32), sync=True)
+            t.get(staleness=10)
+        reps = _spans(sink, "server.replica.get")
+        assert reps, "a bounded-staleness read emits a replica span"
+        reqs = {r["req"] for r in _spans(sink, "wire.client.get")}
+        for r in reps:
+            assert r.get("req") in reqs
+            assert isinstance(r["attrs"]["hit"], bool)
+
+    def test_slow_exemplars_carry_request_ids(self, server, sink):
+        s, addr = server
+        with _connect(addr, client="w0") as c:
+            t = c.create_array("tr_ex", 64)
+            t.add(np.ones(64, np.float32), sync=True)
+            t.get()
+        slow = s.status()["slow"]
+        assert slow, "settled requests populate the exemplar ring"
+        for row in slow:
+            assert row["op"] in ("create", "add", "get")
+            assert row["req"].startswith("r")
+            assert row["total_ms"] >= 0
+            assert set(row["stages"]) == {"queue_ms", "execute_ms"}
+
+
+class TestReconnectResend:
+    def test_resend_keeps_original_request_id(self, server, sink):
+        """A chaos storm forces reconnect + resend; the resent frame
+        ships its ORIGINAL stamped bytes, so the server-side spans land
+        under the request id minted at first send — never a fresh
+        tree."""
+        _s, addr = server
+        with _connect(addr, client="w0") as c:
+            t = c.create_array("tr_chaos", 32)
+            chaos.install_chaos("seed=5;wire.send:drop:times=3;"
+                                "wire.recv:torn:times=2")
+            try:
+                for _ in range(40):
+                    t.add(np.ones(32, np.float32))
+                t.wait()
+            finally:
+                chaos.uninstall_chaos()
+            assert c.reconnects >= 1
+        client_adds = {r["req"]: r for r in _spans(sink,
+                                                   "wire.client.add")}
+        server_adds = _spans(sink, "server.dispatch.add")
+        assert server_adds
+        for r in server_adds:
+            assert r["req"] in client_adds, \
+                "server span req must match a client-minted add req"
+            rp = r["rparent"]
+            assert rp["span"] == client_adds[r["req"]]["id"]
+
+
+class TestShedEcho:
+    def test_shed_reply_echoes_trace_id(self, tmp_path, sink):
+        """A shed reply names the shedder AND echoes the request's
+        trace id; the client's retry-wait span carries both, under the
+        same request — a slow traced request shows WHERE its wait
+        went."""
+        s = TableServer(f"unix:{tmp_path}/shed.sock", name="tshed",
+                        qos="bulk:match=shed-*,weight=1,rate=1,burst=1")
+        addr = s.start()
+        try:
+            with _connect(addr, client="shed-a") as c:
+                t = c.create_array("tr_shed", 32)
+                for _ in range(6):
+                    t.add(np.ones(32, np.float32), sync=True)
+                    if c.sheds >= 1:
+                        break
+                assert c.sheds >= 1
+        finally:
+            chaos.uninstall_chaos()
+            s.stop()
+            reset_tables()
+            core.shutdown()
+        waits = _spans(sink, "wire.client.shed_wait")
+        assert waits, "an honored shed emits a retry-wait span"
+        # the echoed trace id names the request the server shed — one
+        # of the client-minted adds (the shed may be honored during an
+        # ack drain, so the wait span itself can sit outside any
+        # request scope; the echo is what still pins it to a tree)
+        minted = {r["req"] for r in _spans(sink)
+                  if r.get("req") is not None}
+        for r in waits:
+            assert r["attrs"]["server"] == "tshed"
+            assert r["attrs"]["req"] in minted
+
+
+class TestFleetTree:
+    def test_fanout_spans_under_one_root_across_members(self, tmp_path,
+                                                        sink):
+        pmap = partition.PartitionMap(2)
+        servers, addrs = [], []
+        try:
+            for r in range(2):
+                s = TableServer(
+                    f"unix:{tmp_path}/fl{r}.sock", name=f"tfl-{r}",
+                    partition=partition.PartitionMember(pmap, r))
+                addrs.append(s.start())
+                servers.append(s)
+            fc = router.connect_fleet(addrs, client="w0", quant=None)
+            t = fc.create_array("tr_fleet", 101)
+            t.add(np.ones(101, np.float32), sync=True)
+            t.get()
+            fc.close()
+        finally:
+            chaos.uninstall_chaos()
+            for s in servers:
+                s.stop()
+            reset_tables()
+            core.shutdown()
+        roots = [r for r in _spans(sink, "fleet.get")
+                 if r.get("parent") is None]
+        assert len(roots) == 1
+        req = roots[0]["req"]
+        fanout = [r for r in _spans(sink, "fleet.fanout")
+                  if r.get("req") == req]
+        assert fanout, "per-shard fan-out spans join the fleet request"
+        assert all(r["parent"] == roots[0]["id"] for r in fanout)
+        served = {r["attrs"]["server"]
+                  for r in _spans(sink, "server.dispatch.get")
+                  if r.get("req") == req}
+        assert served == {"tfl-0", "tfl-1"}, \
+            "one fleet get must dispatch on BOTH members under one req"
+
+
+class TestSubprocessServer:
+    def test_cross_process_merge_one_root(self, tmp_path, sink):
+        """The real thing: a server SUBPROCESS with its own trace
+        sink, one client request, two JSONL files merged -> one tree
+        with the single true root in the client pid, server roots
+        rparent-stitched to it, and a clock sample against the server
+        pid feeding the timeline alignment."""
+        server_jsonl = tmp_path / "server-trace.jsonl"
+        ready = tmp_path / "ready.txt"
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+                   MVTPU_TRACE_JSONL=str(server_jsonl))
+        env.pop("MVTPU_TRACE_DIR", None)
+        env.pop("MVTPU_STATUSZ_PORT", None)
+        env.pop(wire.TRACE_ENV, None)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "multiverso_tpu.server",
+             "--address", f"unix:{tmp_path}/sub.sock",
+             "--name", "tsub", "--ready-file", str(ready)],
+            env=env, cwd=REPO)
+        try:
+            deadline = time.monotonic() + 60
+            while not ready.exists() and time.monotonic() < deadline:
+                assert proc.poll() is None, "server died during start"
+                time.sleep(0.05)
+            addr = ready.read_text().strip().split(",")[0]
+            with _connect(addr, client="w0") as c:
+                t = c.create_array("tr_sub", 64)
+                t.add(np.ones(64, np.float32), sync=True)
+                t.get()
+            time.sleep(0.3)     # let the dispatch thread settle spans
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        merged = (trace.read_trace(str(sink))
+                  + trace.read_trace(str(server_jsonl)))
+        spans = [r for r in merged if r.get("kind") == "span"]
+        gets = [r for r in spans if r.get("name") == "wire.client.get"]
+        assert gets
+        req = gets[-1]["req"]
+        tree = [r for r in spans if r.get("req") == req]
+        pids = {r["pid"] for r in tree}
+        assert len(pids) == 2, "the tree spans client + server pids"
+        assert proc.pid in pids
+        true_roots = [r for r in tree if r.get("parent") is None
+                      and not r.get("rparent")]
+        assert len(true_roots) == 1
+        assert true_roots[0]["pid"] == os.getpid()
+        stitched = [r for r in tree if r.get("rparent")]
+        assert stitched and all(r["pid"] == proc.pid for r in stitched)
+        for r in stitched:
+            assert r["rparent"]["pid"] == os.getpid()
+        clocks = [r for r in merged if r.get("kind") == "clock"
+                  and r.get("peer", {}).get("pid") == proc.pid]
+        assert clocks, "the client sampled the server's clock"
+        assert all(isinstance(r["offset_us"], float) for r in clocks)
+
+
+class TestReportStitching:
+    """Pure-function checks on the report-side merge math: offset
+    direction, reference-process exclusion, track labeling, and the
+    chrome flow arrows that draw the cross-process parent links."""
+
+    @staticmethod
+    def _records():
+        return [
+            # client (host 0, pid 100) measured server pid 200 running
+            # +1500us ahead -> the report must shift pid 200 BACK
+            {"kind": "clock", "ts": 10.0, "host": 0, "pid": 100,
+             "tid": 1, "peer": {"host": 0, "pid": 200},
+             "offset_us": 1500.0, "rtt_us": 80.0},
+            {"kind": "span", "name": "wire.client.get", "id": 7,
+             "parent": None, "ts": 10.0, "dur_s": 0.01,
+             "req": "r0-100-1", "host": 0, "pid": 100, "tid": 1},
+            {"kind": "span", "name": "server.dispatch.get", "id": 3,
+             "parent": None, "ts": 10.004, "dur_s": 0.002,
+             "req": "r0-100-1", "host": 0, "pid": 200, "tid": 9,
+             "rparent": {"host": 0, "pid": 100, "span": 7},
+             "attrs": {"server": "s0"}},
+        ]
+
+    def test_clock_offsets_shift_peers_not_references(self):
+        offs = report.clock_offsets(self._records())
+        # the recorder (pid 100) is a reference and never shifted;
+        # its peer gets the NEGATED offset in seconds (peer was ahead,
+        # so its timestamps come back)
+        assert (0, 100) not in offs
+        assert offs[(0, 200)] == pytest.approx(-1500e-6)
+
+    def test_chrome_export_stitches_and_aligns(self):
+        doc = report.to_chrome_trace(self._records())
+        evs = doc["traceEvents"]
+        names = {e.get("name") for e in evs
+                 if e.get("ph") == "M"
+                 and e.get("name") != "process_name"}
+        labels = [e["args"]["name"] for e in evs
+                  if e.get("ph") == "M"
+                  and e.get("name") == "process_name"]
+        assert any("clock" in lab for lab in labels), \
+            "a shifted track must say so in its label"
+        child = next(e for e in evs if e.get("ph") == "X"
+                     and e.get("name") == "server.dispatch.get")
+        assert child["args"]["rparent"] == "h0:p100:s7"
+        flows = [e for e in evs if e.get("ph") in ("s", "f")]
+        assert {e["ph"] for e in flows} == {"s", "f"}, \
+            "each cross-process link draws a start+finish flow pair"
+        # the flow pair shares one id and joins the two tracks
+        start = next(e for e in flows if e["ph"] == "s")
+        finish = next(e for e in flows if e["ph"] == "f")
+        assert start["id"] == finish["id"]
+        assert start["pid"] != finish["pid"]
+        # alignment: the shifted child's chrome ts reflects -1500us
+        parent = next(e for e in evs if e.get("ph") == "X"
+                      and e.get("name") == "wire.client.get")
+        assert child["ts"] == pytest.approx(
+            parent["ts"] + 4000 - 1500, abs=1.0)

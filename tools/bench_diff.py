@@ -92,7 +92,12 @@ DEFAULT_WATCH = ("value", "e2e_words_per_sec", "lda_doc_tokens_per_sec",
                  # partitioned servers stopped turning N processes into
                  # served throughput
                  "serving_fleet_ops_per_sec",
-                 "fleet_scaling_efficiency")
+                 "fleet_scaling_efficiency",
+                 # tracing-on ops lane (serving_mp): add throughput
+                 # with the wire trace context stamped on every frame —
+                 # a drop here means distributed tracing stopped being
+                 # cheap enough to leave on
+                 "serving_mp_traced_ops_per_sec")
 
 # LOWER-is-better watches: a rise past the threshold regresses
 DEFAULT_WATCH_LOWER = ("serving_p99_ms",
@@ -477,6 +482,24 @@ def selftest() -> int:
         fe_doc3["serving_fleet_single_ops_per_sec"] = 60.0  # unwatched
         assert main([fe_old, put("fe_base.json", fe_doc3)]) == 0, \
             "the single-server baseline rides along unwatched"
+        # traced ops lane: the tracing-on throughput is watched — a
+        # collapse means the trace context stopped being cheap, while
+        # the untraced twin and the ratio ride along unwatched
+        tr_old = put("tr_old.json", {
+            "metric": "wire_mb_per_sec", "value": 10.0,
+            "unit": "MiB/s", "wire_mb_per_sec": 10.0,
+            "serving_mp_traced_ops_per_sec": 4800.0,
+            "serving_mp_untraced_ops_per_sec": 5000.0,
+            "serving_mp_trace_ratio": 0.96})
+        tr_doc = json.loads(json.dumps(json.load(open(tr_old))))
+        tr_doc["serving_mp_traced_ops_per_sec"] = 1400.0    # -70%
+        tr_doc["serving_mp_trace_ratio"] = 0.28
+        assert main([tr_old, put("tr_bad.json", tr_doc)]) == 1, \
+            "traced ops/s drop must fail (tracing got expensive)"
+        tr_doc2 = json.loads(json.dumps(json.load(open(tr_old))))
+        tr_doc2["serving_mp_untraced_ops_per_sec"] = 1000.0  # unwatched
+        assert main([tr_old, put("tr_base.json", tr_doc2)]) == 0, \
+            "the untraced twin rides along unwatched"
         # unusable inputs exit 2, not a traceback
         hung = put("hung.json", {"rc": 124, "tail": "...", "parsed": None})
         assert main([hung, raw_ok]) == 2, "no parsed line -> exit 2"

@@ -1,0 +1,194 @@
+"""CI smoke for distributed tracing (``make trace-smoke``).
+
+The acceptance demo for the fleet observability plane, end to end
+across REAL processes: spawn a 2-member sharded fleet (each member a
+launcher subprocess with a statusz port and its own per-pid trace
+sink), drive one scatter-gather fleet get from this process's client,
+then scrape + merge the fleet with ``telemetry.report --fleet`` and
+assert the story holds:
+
+- every member's ``/trace`` and ``/metrics?json=1`` scrape cleanly and
+  merge with the local client JSONL into one chrome trace with a
+  process track per (host, pid) — client + both members = 3 tracks;
+- ONE request id stitches spans across all 3 processes, with exactly
+  one true root (the client's ``fleet.*`` span) — every server-side
+  root carries an ``rparent`` naming the client span it serves, and
+  the chrome export draws the flow arrows;
+- the client sampled a non-null clock offset against BOTH members
+  (the RTT-midpoint estimator behind the timeline alignment);
+- the merged fleet metrics snapshot is a well-formed
+  ``mvtpu.metrics.v1`` document covering both members.
+
+Exit code 0 = one slow fleet get reconstructs as one tree; any
+assertion prints a reason and exits 1. Stdlib only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_TMP = tempfile.mkdtemp(prefix="mvtpu_trace_smoke_")
+CLIENT_JSONL = os.path.join(_TMP, "client-trace.jsonl")
+# the client process's sink must be armed BEFORE the transport loads
+os.environ["MVTPU_TRACE_JSONL"] = CLIENT_JSONL
+os.environ.pop("MVTPU_TRACE_DIR", None)
+os.environ.pop("MVTPU_WIRE_TRACE", None)    # tracing ON (the default)
+
+FAILURES: list = []
+
+
+def check(ok: bool, what: str) -> None:
+    tag = "ok" if ok else "FAIL"
+    print(f"trace-smoke: [{tag}] {what}")
+    if not ok:
+        FAILURES.append(what)
+
+
+def main() -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fleet_file = os.path.join(_TMP, "fleet.json")
+    server_traces = os.path.join(_TMP, "server-traces")
+    os.makedirs(server_traces, exist_ok=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo,
+               MVTPU_STATUSZ_PORT="0",
+               MVTPU_TRACE_DIR=server_traces)
+    env.pop("MVTPU_TRACE_JSONL", None)      # members get per-pid files
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "multiverso_tpu.server", "--fleet", "2",
+         "--address", "unix:" + os.path.join(_TMP, "fleet.sock"),
+         "--name", "trace-fleet", "--fleet-file", fleet_file],
+        env=env, cwd=repo)
+    try:
+        doc = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if os.path.exists(fleet_file):
+                try:
+                    with open(fleet_file) as f:
+                        doc = json.load(f)
+                except ValueError:
+                    doc = None
+                if doc and len(doc.get("members", [])) == 2 \
+                        and all(m.get("statusz_port")
+                                for m in doc["members"]):
+                    break
+            if proc.poll() is not None:
+                check(False, f"fleet launcher stayed up "
+                             f"(rc={proc.returncode})")
+                return 1
+            time.sleep(0.1)
+        ok = bool(doc) and len(doc.get("members", [])) == 2
+        check(ok, "fleet launcher published a 2-member fleet file")
+        if not ok:
+            return 1
+        member_pids = {m["pid"] for m in doc["members"]}
+
+        # -- one traced fleet get ------------------------------------------
+        from multiverso_tpu.client import router
+        import numpy as np
+        fc = router.connect_fleet_file(fleet_file, client="tracer",
+                                       quant=None)
+        t = fc.create_array("trace_w", 64)
+        t.add(np.ones(64, np.float32), sync=True)
+        got = t.get()
+        check(got.shape == (64,), "fleet get answered")
+        fc.close()
+        time.sleep(0.5)     # let member dispatch threads settle spans
+
+        # -- scrape + merge the fleet --------------------------------------
+        from multiverso_tpu.telemetry import report
+        chrome_out = os.path.join(_TMP, "fleet-trace.json")
+        snap_out = os.path.join(_TMP, "fleet-metrics.json")
+        rc = report.main([fleet_file, "--fleet",
+                          "--client-trace", CLIENT_JSONL,
+                          "--chrome-trace", chrome_out,
+                          "--snapshot-out", snap_out])
+        check(rc == 0, f"report --fleet scrape-merge exits 0 (rc={rc})")
+
+        records, _snap, errors = report.scrape_fleet(
+            fleet_file, [CLIENT_JSONL])
+        check(not errors, f"every member scraped cleanly ({errors})")
+
+        # one request, one tree, >= 3 processes
+        by_req: dict = {}
+        for r in records:
+            if r.get("kind") == "span" and r.get("req"):
+                by_req.setdefault(r["req"], []).append(r)
+        wide = {req: spans for req, spans in by_req.items()
+                if len({(s["host"], s["pid"]) for s in spans}) >= 3}
+        check(bool(wide),
+              f"a request id spans >= 3 processes "
+              f"({len(by_req)} requests merged)")
+        if wide:
+            req, spans = next(iter(wide.items()))
+            roots = [s for s in spans if s.get("parent") is None]
+            true_roots = [s for s in roots if not s.get("rparent")]
+            check(len(true_roots) == 1,
+                  f"request {req} has exactly ONE true root "
+                  f"({len(true_roots)}; {len(roots)} local roots)")
+            check(true_roots and true_roots[0]["pid"]
+                  not in member_pids,
+                  "the tree's root lives in the CLIENT process")
+            stitched = [s for s in roots if s.get("rparent")]
+            check(all(s["pid"] in member_pids for s in stitched)
+                  and len({s["pid"] for s in stitched}) == 2,
+                  f"server-side roots on BOTH members carry rparent "
+                  f"({len(stitched)} stitched)")
+
+        # clock offsets: sampled, non-null, one per member
+        clocks = [r for r in records if r.get("kind") == "clock"]
+        peers = {r.get("peer", {}).get("pid") for r in clocks
+                 if isinstance(r.get("offset_us"), (int, float))}
+        check(member_pids <= peers,
+              f"client sampled a non-null clock offset against both "
+              f"members ({len(clocks)} clock records)")
+
+        # chrome export: 3 process tracks + flow arrows
+        with open(chrome_out) as f:
+            chrome = json.load(f)
+        evs = chrome.get("traceEvents", [])
+        tracks = {e["pid"] for e in evs
+                  if e.get("ph") == "M"
+                  and e.get("name") == "process_name"}
+        check(len(tracks) >= 3,
+              f"chrome trace has >= 3 process tracks ({len(tracks)})")
+        flows = [e for e in evs if e.get("ph") in ("s", "f")]
+        check(len(flows) >= 2,
+              f"chrome trace draws cross-process flow arrows "
+              f"({len(flows)} flow events)")
+
+        # merged fleet metrics snapshot: bench_diff-readable
+        with open(snap_out) as f:
+            snap = json.load(f)
+        check(snap.get("kind") == "mvtpu.metrics.v1"
+              and snap.get("hosts") == 2,
+              f"fleet snapshot merges both members "
+              f"(kind={snap.get('kind')}, hosts={snap.get('hosts')})")
+        check(any(k.startswith("wire.requests")
+                  for k in snap.get("counters", {})),
+              "fleet snapshot carries the wire request counters")
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+
+    if FAILURES:
+        print(f"trace-smoke: FAILED ({len(FAILURES)}): {FAILURES}",
+              file=sys.stderr)
+        return 1
+    print("trace-smoke: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
