@@ -76,6 +76,13 @@
 #                   stitched server spans, chrome flow arrows),
 #                   non-null clock offsets against both members, and a
 #                   merged mvtpu.metrics.v1 fleet snapshot
+#   make autotune-smoke - closed-loop autotuning smoke: a wire server
+#                   starts MIStuned (fuse=1, protected QoS class
+#                   starved at 2 ops/s) under a bulk flood; the
+#                   control.Controller must converge protected
+#                   throughput within 10% of a hand-tuned reference,
+#                   with every knob move audited in the decision ring
+#                   (emits autotune_bench.json)
 #   make chaos    - the chaos lane: fault-injection test subset
 #                   (ft subsystem + overwrite crash-window fuzz) plus a
 #                   CLI checkpoint/resume smoke under an active
@@ -89,8 +96,8 @@ NEW ?= BENCH_r05.json
 
 .PHONY: test dryrun bench bench-dryrun bench-diff bench-diff-selftest \
 	client-bench ckpt-bench kernel-bench tier-bench serve-smoke \
-	mp-smoke flood-smoke fleet-smoke trace-smoke health-smoke chaos \
-	fuzz lint native ci
+	mp-smoke flood-smoke fleet-smoke trace-smoke health-smoke \
+	autotune-smoke chaos fuzz lint native ci
 
 fuzz:
 	$(PY) tests/deep_fuzz.py
@@ -137,6 +144,9 @@ fleet-smoke:
 trace-smoke:
 	$(PY) tools/trace_smoke.py
 
+autotune-smoke:
+	MVTPU_SERVING_TINY=1 $(PY) benchmarks/serving.py --autotune
+
 health-smoke:
 	$(PY) tools/health_smoke.py
 
@@ -175,4 +185,5 @@ native:
 
 ci: lint bench-diff-selftest native test dryrun bench-dryrun \
 	client-bench ckpt-bench kernel-bench tier-bench serve-smoke \
-	mp-smoke flood-smoke fleet-smoke trace-smoke health-smoke chaos
+	mp-smoke flood-smoke fleet-smoke trace-smoke health-smoke \
+	autotune-smoke chaos

@@ -34,12 +34,12 @@ to a wire loads the wire.
 
 from __future__ import annotations
 
-import os
 from typing import Any, Optional
 
 from multiverso_tpu.client.cache import CachedView
 from multiverso_tpu.client.coalesce import CoalescingBuffer, PendingHandle
 from multiverso_tpu.client.staging import KVStagingWriter, stage_kv_adds
+from multiverso_tpu.control import knobs as _knobs
 
 _TRANSPORT_NAMES = ("WireClient", "RemoteArrayTable", "RemoteKVTable",
                     "RemoteHandle", "DeltaBatcher", "RemoteError",
@@ -70,14 +70,18 @@ def __getattr__(name: str):
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")
 
-COALESCE_ENV = "MVTPU_COALESCE"
-STALENESS_ENV = "MVTPU_STALENESS"
+# env names come from the control-plane knob table — one source of
+# truth for name, bounds, and docs (control/knobs.py)
+COALESCE_ENV = _knobs.spec("client.coalesce_k").env
+STALENESS_ENV = _knobs.spec("client.staleness").env
 
 
 def coalesce_from_env() -> int:
-    """``MVTPU_COALESCE`` as an int (0 = coalescing off)."""
+    """``MVTPU_COALESCE`` as an int (0 = coalescing off — OFF is
+    outside the knob's clamped range, hence the raw read)."""
+    raw = _knobs.env_raw("client.coalesce_k")
     try:
-        return max(int(os.environ.get(COALESCE_ENV, "0") or "0"), 0)
+        return max(int(raw or "0"), 0)
     except ValueError:
         return 0
 
@@ -85,11 +89,11 @@ def coalesce_from_env() -> int:
 def staleness_from_env() -> Optional[int]:
     """``MVTPU_STALENESS`` as an int bound, or None when unset/invalid
     (0 is a VALID bound — dedupe-only caching)."""
-    raw = os.environ.get(STALENESS_ENV)
+    raw = _knobs.env_raw("client.staleness")
     if raw is None or raw == "":
         return None
     try:
-        return max(int(raw), 0)
+        return _knobs.spec("client.staleness").clamp(int(raw))
     except ValueError:
         return None
 

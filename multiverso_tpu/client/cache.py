@@ -37,6 +37,7 @@ from typing import Any, Optional, Tuple
 
 import numpy as np
 
+from multiverso_tpu.control import knobs as _knobs
 from multiverso_tpu.telemetry import metrics as telemetry
 from multiverso_tpu.telemetry import trace as tracing
 from multiverso_tpu.utils.async_buffer import ASyncBuffer
@@ -72,6 +73,10 @@ class CachedView:
                                             table=lbl)
         self._h_get = telemetry.histogram(
             "client.get.seconds", telemetry.LATENCY_BUCKETS, table=lbl)
+        # control-plane binding: get() reads max_staleness per call,
+        # so a controller write widens/narrows the bound live
+        _knobs.bind("client.staleness", self, "max_staleness",
+                    label=lbl)
         # a view never serves nothing: first snapshot is synchronous
         self._gen, self._val = self._sync_snapshot()
         # refresh pipeline: (generation, device future, trace link)

@@ -41,6 +41,7 @@ from typing import Any, List, Optional
 
 import numpy as np
 
+from multiverso_tpu.control import knobs as _knobs
 from multiverso_tpu.telemetry import metrics as telemetry
 from multiverso_tpu.telemetry import trace as tracing
 from multiverso_tpu.updaters import AddOption
@@ -140,6 +141,9 @@ class CoalescingBuffer:
                                           table=lbl)
         self._h_flush = telemetry.histogram(
             "client.flush.seconds", telemetry.LATENCY_BUCKETS, table=lbl)
+        # control-plane binding: _maybe_flush_locked reads max_deltas
+        # per buffered add, so K moves live
+        _knobs.bind("client.coalesce_k", self, "max_deltas", label=lbl)
         # occupancy as a queue gauge: buffered-delta count + group age
         self._qg = telemetry.QueueGauges(f"coalesce:{lbl}")
         # request ids riding the open group (stamped onto the flush

@@ -1,0 +1,29 @@
+"""multiverso_tpu.control — the knob registry and the closed-loop
+autotuner built on top of the observability plane.
+
+``knobs`` is the typed knob table (every runtime tunable, env-seeded,
+weakref-bound to the live objects whose hot paths read it);
+``controller`` is the control loop that moves those knobs from live
+telemetry — per-process off the registry snapshot, fleet-wide off the
+merged ``/metrics?json=1`` scrape — with hysteresis, rate-limited
+steps, a kill switch, and a ``control.decision`` audit span per move.
+
+Importing this package pulls both modules: any process that
+constructs a server (and therefore binds knobs) also has the
+``/control`` actuation surface loaded, which ``telemetry/statusz``
+resolves strictly through ``sys.modules`` to stay jax-free.
+"""
+
+from multiverso_tpu.control import knobs
+from multiverso_tpu.control import controller
+from multiverso_tpu.control.controller import (
+    Controller, FleetController, apply_set, apply_step,
+    control_status, disabled, kill, maybe_controller,
+    parse_objectives, recent_decisions,
+)
+
+__all__ = [
+    "Controller", "FleetController", "apply_set", "apply_step",
+    "control_status", "controller", "disabled", "kill", "knobs",
+    "maybe_controller", "parse_objectives", "recent_decisions",
+]

@@ -144,12 +144,16 @@ def init(argv: Optional[Sequence[str]] = None, *,
         # arms the live introspection server, MVTPU_SLO the tail-
         # latency monitor, MVTPU_HEALTH the training-health monitor
         # (all idempotent across re-inits)
+        from multiverso_tpu.control.controller import maybe_controller
         from multiverso_tpu.telemetry.health import maybe_health_monitor
         from multiverso_tpu.telemetry.slo import maybe_slo_monitor
         from multiverso_tpu.telemetry.statusz import maybe_statusz
         maybe_statusz()
         maybe_slo_monitor()
         maybe_health_monitor()
+        # MVTPU_AUTOTUNE closes the loop: the controller reads the
+        # monitors' metrics and actuates the knob table
+        maybe_controller()
 
         devs = list(devices) if devices is not None else jax.devices()
         dp = data_parallel if data_parallel is not None \
@@ -240,6 +244,8 @@ def shutdown(finalize: bool = True) -> None:
             return
         _RT.initialized = False
         _RT.mesh = None
+    from multiverso_tpu.control.controller import shutdown_controllers
+    shutdown_controllers()
     if finalize:
         from multiverso_tpu.utils import dashboard
         log.debug("dashboard at shutdown:\n%s", dashboard.report())

@@ -79,6 +79,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from multiverso_tpu.control import knobs as _knobs
 from multiverso_tpu.telemetry import metrics as telemetry
 
 QOS_ENV = "MVTPU_SERVER_QOS"
@@ -107,7 +108,8 @@ _MAX_BUCKETS = 4096
 class QosClass:
     """One parsed QoS class (see module docstring for the grammar)."""
 
-    __slots__ = ("name", "match", "weight", "rate", "burst")
+    __slots__ = ("name", "match", "weight", "_rate", "burst",
+                 "__weakref__")
 
     def __init__(self, name: str, match: str = "*",
                  weight: float = 1.0, rate: float = 0.0,
@@ -119,11 +121,25 @@ class QosClass:
         self.name = name
         self.match = match
         self.weight = float(weight)
-        self.rate = float(rate)
+        self._rate = float(rate)
         self.burst = float(burst) if burst is not None \
             else max(self.rate, 1.0)
         if self.burst <= 0:
             raise ValueError(f"qos class {name!r}: burst must be > 0")
+
+    @property
+    def rate(self) -> float:
+        return self._rate
+
+    @rate.setter
+    def rate(self, v: float) -> None:
+        # runtime-mutable (control-plane binding): when the rate is
+        # raised past the bucket capacity, grow the burst with it —
+        # otherwise a starved class stays starved by its old burst
+        self._rate = float(v)
+        burst = getattr(self, "burst", None)
+        if burst is not None and self._rate > burst:
+            self.burst = self._rate
 
     def matches(self, client_id: str) -> bool:
         return fnmatch.fnmatchcase(client_id, self.match)
@@ -229,14 +245,21 @@ class AdmissionController:
         if qos is None:
             qos = os.environ.get(QOS_ENV, "")
         if queue_bound is None:
-            queue_bound = parse_queue_bound(
-                os.environ.get(QUEUE_ENV, ""))
+            queue_bound = _knobs.initial("server.queue_bound")
         self.server = server
         self.classes = parse_qos(qos)
         if not any(c.match == "*" for c in self.classes):
             # implicit catch-all so classify() is total
             self.classes.append(QosClass("default"))
         self.bound = max(int(queue_bound), 0)
+        # control-plane bindings: offer() reads self.bound and the
+        # class rate/weight per frame, so these are live immediately
+        _knobs.bind("server.queue_bound", self, "bound", label=server)
+        for c in self.classes:
+            _knobs.bind("server.qos.rate", c, "rate",
+                        label=f"{server}:{c.name}")
+            _knobs.bind("server.qos.weight", c, "weight",
+                        label=f"{server}:{c.name}")
         self._cond = threading.Condition()
         self._lanes: Dict[str, _Lane] = {
             c.name: _Lane(c) for c in self.classes}

@@ -44,6 +44,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from multiverso_tpu.control import knobs as _knobs
 from multiverso_tpu.tables.hashing import _join_keys
 from multiverso_tpu.telemetry import metrics as telemetry
 from multiverso_tpu.utils import log
@@ -76,6 +77,14 @@ class TableReplica:
                                            server=server)
         self._c_degraded = telemetry.counter(
             "server.replica.degraded_hits", server=server)
+        self._c_relaxed = telemetry.counter(
+            "server.replica.relaxed_hits", server=server)
+        # control-plane staleness slack: extra generations a snapshot
+        # may lag past the CLIENT-requested bound and still be served
+        # (a relaxed reply carries the real staleness). 0 = strict.
+        self.slack = _knobs.initial("server.replica.slack")
+        _knobs.bind("server.replica.slack", self, "slack",
+                    label=f"{server}:{lbl}")
 
     # -- dispatch-thread half ----------------------------------------------
 
@@ -185,12 +194,21 @@ class TableReplica:
             return None
         lag = max(self.table.generation - gen, 0)   # plain int reads
         degraded = False
+        relaxed = False
         if lag > bound:
-            if not relax:
+            slack = max(int(self.slack), 0)
+            if relax:
+                degraded = True
+                self._c_degraded.inc()
+            elif lag <= bound + slack:
+                # within the control plane's staleness slack: serve
+                # past the requested bound, marked, rather than
+                # queueing the read behind the writes it lags
+                relaxed = True
+                self._c_relaxed.inc()
+            else:
                 self._c_misses.inc()
                 return None
-            degraded = True
-            self._c_degraded.inc()
         self._c_hits.inc()
         self._g_stale.set(float(lag))
         head = {"ok": True, "gen": gen, "replica": True,
@@ -203,6 +221,8 @@ class TableReplica:
             head["req"] = tr["req"]
         if degraded:
             head["degraded"] = True
+        if relaxed:
+            head["relaxed"] = True
         if self.kind == "array":
             return (head, [value])
         keys = np.ascontiguousarray(arrays[0]).astype(np.uint64,

@@ -85,6 +85,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from multiverso_tpu import core
+from multiverso_tpu.control import knobs as _knobs
 from multiverso_tpu.ft import chaos as _chaos
 from multiverso_tpu.io import wiresock
 from multiverso_tpu.server import admission as _admission_mod
@@ -163,13 +164,6 @@ def fleet_info() -> Optional[Tuple[str, int]]:
         if s._fleet_file and s._partition is not None:
             return s._fleet_file, s._partition.rank
     return None
-
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
 
 
 class _Conn:
@@ -273,11 +267,15 @@ class TableServer:
         self._replicas: Dict[int, TableReplica] = {}
         self._next_table = 0
         self._fuse = max(int(fuse) if fuse is not None
-                         else _env_int(FUSE_ENV, 1), 1)
-        self._dedup_depth = max(_env_int(DEDUP_ENV, _DEDUP_CACHE),
+                         else _knobs.initial("server.fuse"), 1)
+        self._dedup_depth = max(_knobs.initial("server.dedup",
+                                               _DEDUP_CACHE),
                                 _DEDUP_FLOOR)
         self._dedup_clients = max(
-            _env_int(DEDUP_CLIENTS_ENV, _DEDUP_CLIENTS), 1)
+            _knobs.initial("server.dedup_clients", _DEDUP_CLIENTS), 1)
+        # the dispatch loop re-reads self._fuse every drain cycle, so
+        # a controller write takes effect on the next batch
+        _knobs.bind("server.fuse", self, "_fuse", label=self.name)
         # LRU of LRUs: client_id -> OrderedDict(rid -> reply)
         self._dedup: "collections.OrderedDict[str, collections.OrderedDict]" \
             = collections.OrderedDict()
@@ -298,7 +296,8 @@ class TableServer:
         # slow-request exemplars: a min-heap of (total_s, seq, row)
         # keeps the top-N slowest settled requests with their per-stage
         # breakdown (surfaced via status() -> /statusz)
-        self._exemplar_cap = max(_env_int(EXEMPLARS_ENV, _EXEMPLARS), 1)
+        self._exemplar_cap = max(
+            _knobs.initial("server.exemplars", _EXEMPLARS), 1)
         self._exemplars: List[tuple] = []
         self._exemplar_seq = 0
         self._exemplar_lock = threading.Lock()

@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from multiverso_tpu.control import knobs as _knobs
 from multiverso_tpu.storage.tiers import (BucketRecord, DiskTier,
                                           HostTier, RecordSpec)
 from multiverso_tpu.telemetry import metrics as telemetry
@@ -60,14 +61,14 @@ TIER_ALPHA_ENV = "MVTPU_TIER_ALPHA"
 _MANAGERS: "weakref.WeakSet" = weakref.WeakSet()
 
 
-def _env_int(name: str, default: int) -> int:
-    raw = os.environ.get(name, "").strip()
-    if not raw:
-        return default
+def _knob_int(name: str, default: int) -> int:
+    """Env-seeded knob read with the tier layer's forgiving error
+    handling (a malformed env var degrades to the default, it does
+    not kill table construction)."""
     try:
-        return int(raw)
-    except ValueError:
-        log.warn("ignoring non-integer %s=%r", name, raw)
+        return int(_knobs.initial(name, default))
+    except ValueError as e:
+        log.warn("%s; using %d", e, default)
         return default
 
 
@@ -99,10 +100,11 @@ class TierConfig:
                  spill_dir: Optional[str] = None,
                  alpha: Optional[float] = None) -> "TierConfig":
         if device_buckets is None:
-            device_buckets = _env_int(TIER_DEVICE_ENV, total_buckets)
+            device_buckets = _knob_int("storage.device_buckets",
+                                       total_buckets)
         if host_buckets is None:
-            host_buckets = _env_int(TIER_HOST_ENV,
-                                    max(total_buckets // 4, 1))
+            host_buckets = _knob_int("storage.host_buckets",
+                                     max(total_buckets // 4, 1))
         if spill_dir is None:
             spill_dir = os.environ.get(TIER_DIR_ENV, "").strip() \
                 or os.path.join("/tmp", "mvtpu_tiers")
@@ -138,6 +140,13 @@ class TierManager:
         self.total_buckets = int(total_buckets)
         self.device_buckets = min(int(config.device_buckets),
                                   self.total_buckets)
+        # the physical slot count above is frozen at construction
+        # (arrays below are sized by it); the control plane moves a
+        # soft BUDGET underneath it — plan() evicts down to the
+        # budget, never past the batch's own working set
+        self.device_budget = self.device_buckets
+        _knobs.bind("storage.device_buckets", self, "device_budget",
+                    label=name)
         self.config = config
         self.spec = spec
         self.tier = np.full(self.total_buckets, TIER_VIRGIN, np.int8)
@@ -220,7 +229,14 @@ class TierManager:
             n = int((self.tier[missing] == code).sum())
             if n:
                 self._c_miss[code].inc(n)
-        shortfall = len(missing) - len(self._free_slots)
+        # budget-capped headroom: free slots count only up to the
+        # control plane's device budget (clamped so one batch's
+        # working set always fits — the physical bound above rules)
+        cap = max(min(int(self.device_budget), self.device_buckets),
+                  len(needed), 1)
+        in_use = self.device_buckets - len(self._free_slots)
+        headroom = min(len(self._free_slots), max(cap - in_use, 0))
+        shortfall = len(missing) - headroom
         if shortfall <= 0:
             victims = np.zeros(0, np.int64)
         else:

@@ -104,6 +104,30 @@ def _q_ms(h: dict, q: float) -> str:
     return "-" if v is None else f"{v * 1e3:.3f}"
 
 
+def render_decisions(records: List[dict]) -> str:
+    """Autotuning audit trail: every ``control.decision`` span in the
+    (merged) trace, time-ordered — a fleet tuning episode reads as one
+    table across processes, knob by knob."""
+    rows = []
+    for r in records:
+        if r.get("kind") != "span" or r.get("name") != \
+                "control.decision":
+            continue
+        at = r.get("attrs") or {}
+        rows.append([f"{float(r.get('ts', 0)):.3f}",
+                     str(r.get("host", "")),
+                     str(at.get("knob", "")),
+                     str(at.get("label", "")),
+                     f"{at.get('from')} -> {at.get('to')}",
+                     str(at.get("origin", "")),
+                     str(at.get("rule", ""))])
+    if not rows:
+        return ""
+    return ("control decisions:\n" + _table(
+        rows, ["ts", "host", "knob", "label", "change", "origin",
+               "rule"]))
+
+
 def render_trace(records: List[dict]) -> str:
     spans: Dict[str, List[float]] = {}
     steps: List[dict] = []
@@ -487,6 +511,9 @@ def main(argv=None) -> int:
             print(render_top("trace", records, args.top))
         else:
             out = [render_trace(records)]
+            decisions = render_decisions(records)
+            if decisions:
+                out.append(decisions)
             if snap is not None:
                 out.append(render_snapshot(snap))
             print("\n\n".join(out))

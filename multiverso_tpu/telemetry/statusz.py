@@ -154,7 +154,22 @@ def _statusz_doc() -> dict:
         "storage": _storage_status(),
         "transport": _transport_status(counters, gauges,
                                        snap.get("histograms", {})),
+        "control": _control_status(),
     }
+
+
+def _control_status() -> Optional[dict]:
+    """The autotuner's status — armed objectives, live knob values,
+    the decision ring — via sys.modules like every other sibling
+    (statusz stays jax-free; the control package loads with the
+    servers it tunes)."""
+    ctrl = sys.modules.get("multiverso_tpu.control.controller")
+    if ctrl is None:
+        return None
+    try:
+        return ctrl.control_status()
+    except Exception:
+        return None
 
 
 def _health_status() -> Optional[dict]:
@@ -263,7 +278,7 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 if path == "/":
                     body = ("mvtpu statusz — endpoints: /metrics "
                             "(?fleet=1), /healthz, /statusz "
-                            "(?fleet=1), /trace\n")
+                            "(?fleet=1), /trace, /control (POST)\n")
                     self._reply(200, body.encode(), "text/plain")
                     return
                 if "fleet=1" in query.split("&"):
@@ -314,6 +329,69 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         except (BrokenPipeError, ConnectionResetError):
             pass                    # scraper went away mid-reply
         except Exception as e:      # introspection must never wedge
+            try:
+                self._reply(500, f"{e!r}\n".encode(), "text/plain")
+            except Exception:
+                pass
+
+    def do_POST(self) -> None:      # noqa: N802 (http.server contract)
+        """``POST /control`` — the autotuner's actuation surface.
+
+        Ops: ``{"op": "kill"}`` (hard kill switch), ``{"op": "set",
+        "knob", "value", ...}`` and ``{"op": "step", "knob", "dir",
+        ...}``; set/step accept optional ``label``, ``rule``,
+        ``evidence``, ``origin``, and a trace ``ctx`` that parent-
+        links the resulting ``control.decision`` spans under the
+        caller's (fleet controller's) span. 503 when the control
+        package isn't loaded — same sys.modules discipline as every
+        sibling lookup here."""
+        try:
+            path, _, _ = self.path.partition("?")
+            if path != "/control":
+                self._reply(404, b"not found\n", "text/plain")
+                return
+            ctrl = sys.modules.get("multiverso_tpu.control.controller")
+            if ctrl is None:
+                self._reply_json(503,
+                                 {"error": "control plane not loaded"})
+                return
+            n = int(self.headers.get("Content-Length") or 0)
+            try:
+                doc = json.loads(self.rfile.read(n) or b"{}")
+            except ValueError:
+                self._reply_json(400, {"error": "bad JSON body"})
+                return
+            op = doc.get("op")
+            if op == "kill":
+                ctrl.kill(str(doc.get("reason") or "post"))
+                self._reply_json(200, {"ok": True, "killed": True})
+                return
+            if op not in ("set", "step") or not doc.get("knob"):
+                self._reply_json(
+                    400, {"error": "op must be kill|set|step "
+                                   "(set/step need a knob)"})
+                return
+            kw = dict(label=doc.get("label"),
+                      rule=str(doc.get("rule") or f"post:{op}"),
+                      evidence=doc.get("evidence"),
+                      origin=str(doc.get("origin") or "post"),
+                      ctx=doc.get("ctx"))
+            try:
+                if op == "set":
+                    changes = ctrl.apply_set(doc["knob"],
+                                             doc.get("value"), **kw)
+                else:
+                    changes = ctrl.apply_step(
+                        doc["knob"], int(doc.get("dir") or 1), **kw)
+            except (KeyError, TypeError, ValueError) as e:
+                self._reply_json(400, {"error": str(e)})
+                return
+            self._reply_json(200, {"ok": not ctrl.disabled(),
+                                   "killed": ctrl.disabled(),
+                                   "changes": changes})
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:      # actuation surface must not wedge
             try:
                 self._reply(500, f"{e!r}\n".encode(), "text/plain")
             except Exception:

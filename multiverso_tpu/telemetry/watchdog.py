@@ -350,6 +350,15 @@ class Watchdog:
                     for s in ts_mod.status_all()]
             except Exception:
                 pass
+        # the autotuner's decision ring: a post-mortem must show what
+        # the control plane was DOING to the knobs on the way down
+        control_decisions = []
+        ctrl = sys.modules.get("multiverso_tpu.control.controller")
+        if ctrl is not None:
+            try:
+                control_decisions = ctrl.recent_decisions()
+            except Exception:
+                pass
         with open(os.path.join(path, "watchdog.json"), "w") as f:
             json.dump({
                 "kind": DUMP_KIND, "name": self.name,
@@ -363,6 +372,7 @@ class Watchdog:
                 "slo_violations": violations,
                 "health": health_status,
                 "slow_requests": slow_requests,
+                "control_decisions": control_decisions,
             }, f, indent=1)
         # keep-K retention AFTER the new dump lands: the artifact being
         # written right now must never be the one pruned away

@@ -1,0 +1,416 @@
+"""Closed-loop autotuning (ISSUE 16): objective grammar, hysteresis,
+step clamping, the kill switch, the ``/control`` POST surface, the
+decision-span audit trail through ``report --fleet``, and a
+forced-misconfiguration convergence run against a real wire server.
+
+The controller must be boring by construction: a violation moves a
+knob one clamped step only after ``confirm`` consecutive bad
+evaluations, then holds; a noisy boundary moves nothing; a kill (env
+veto OR latch) refuses every apply including fleet-pushed ones.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from multiverso_tpu import client as mv_client
+from multiverso_tpu import core
+from multiverso_tpu.control import controller as ctl
+from multiverso_tpu.control import knobs
+from multiverso_tpu.server.table_server import TableServer
+from multiverso_tpu.tables import reset_tables
+from multiverso_tpu.telemetry import metrics, trace
+
+
+@pytest.fixture(autouse=True)
+def control_clean(monkeypatch):
+    """Every test starts unarmed, unkilled, with an empty decision
+    ring and a fresh registry (knob bindings are weakrefs — they die
+    with their test-local owners)."""
+    monkeypatch.delenv(ctl.AUTOTUNE_ENV, raising=False)
+    ctl.shutdown_controllers()
+    ctl._KILLED = False
+    ctl._KILL_REASON = None
+    ctl._DECISIONS.clear()
+    metrics.registry().reset()
+    yield
+    ctl.shutdown_controllers()
+    ctl._KILLED = False
+    ctl._KILL_REASON = None
+    ctl._DECISIONS.clear()
+    metrics.registry().reset()
+    reset_tables()
+    core.shutdown()
+
+
+class _Owner:
+    """A bindable knob owner (weakref-able plain object)."""
+
+    def __init__(self, **attrs):
+        self.__dict__.update(attrs)
+
+
+def _post(port, doc, path="/control"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(doc).encode("utf-8"),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
+# -- objective grammar -----------------------------------------------------
+
+class TestParseObjectives:
+    def test_slo_histogram_rule(self):
+        (o,) = ctl.parse_objectives(
+            "server.wire.latency.p99 < 5ms -> server.fuse+")
+        assert not isinstance(o.rule, ctl.DerivedRule)  # slo.SloRule
+        assert o.rule.bound_s == pytest.approx(0.005)
+        assert o.actions == [("server.fuse", 1)]
+
+    def test_derived_ratio_and_gauge_rules(self):
+        a, b = ctl.parse_objectives(
+            "storage.miss_ratio < 0.05 -> storage.device_buckets+; "
+            "my.win.gauge < 3 -> server.fuse-")
+        assert isinstance(a.rule, ctl.DerivedRule)
+        assert a.rule.metric == "storage.miss_ratio"
+        assert isinstance(b.rule, ctl.DerivedRule)
+        assert b.rule.metric == "my.win.gauge"
+        assert b.actions == [("server.fuse", -1)]
+
+    def test_multiple_actions_per_rule(self):
+        (o,) = ctl.parse_objectives(
+            "serving.latency.p99 < 20ms -> server.qos.rate+, "
+            "server.fuse+")
+        assert o.actions == [("server.qos.rate", 1), ("server.fuse", 1)]
+
+    def test_empty_spec_is_empty(self):
+        assert ctl.parse_objectives("") == []
+        assert ctl.parse_objectives(" ; ") == []
+
+    @pytest.mark.parametrize("spec", [
+        "serving.latency.p99 < 5ms",            # no action
+        "serving.latency.p99 < 5ms -> ",        # empty action
+        "no_bound_here -> server.fuse+",        # rule without a bound
+        "x < 1 -> bogus.knob+",                 # unknown knob
+        "x < 1 -> server.dedup+",               # initial-only knob
+        "x < 1 -> server.fuse",                 # no +/- direction
+    ])
+    def test_malformed_specs_raise(self, spec):
+        with pytest.raises(ValueError):
+            ctl.parse_objectives(spec)
+
+
+class TestEvaluate:
+    def test_histogram_rule_names_worst_series(self):
+        h = metrics.histogram("ctl.lat.seconds",
+                              metrics.LATENCY_BUCKETS, server="a")
+        for _ in range(50):
+            h.observe(0.5)
+        (o,) = ctl.parse_objectives("ctl.lat.p99 < 1ms -> server.fuse+")
+        violated, ev = o.evaluate(metrics.registry().snapshot())
+        assert violated and "ctl.lat.seconds" in ev["metric"]
+        assert ev["value"] > ev["bound"]
+
+    def test_gauge_rule(self):
+        g = metrics.gauge("ctl.win.p99_ms")
+        (o,) = ctl.parse_objectives("ctl.win.p99_ms < 2 -> server.fuse+")
+        g.set(5.0)
+        violated, ev = o.evaluate(metrics.registry().snapshot())
+        assert violated and ev["value"] == 5.0
+        g.set(1.0)
+        violated, _ = o.evaluate(metrics.registry().snapshot())
+        assert not violated
+
+    def test_shed_ratio_rule(self):
+        metrics.counter("server.shed", server="s").inc(10)
+        metrics.counter("server.admission.admitted", server="s").inc(90)
+        (o,) = ctl.parse_objectives(
+            "server.shed_ratio < 0.05 -> server.queue_bound+")
+        violated, ev = o.evaluate(metrics.registry().snapshot())
+        assert violated and ev["value"] == pytest.approx(0.1)
+
+
+# -- hysteresis + clamping -------------------------------------------------
+
+def _gauge_source(g):
+    return lambda: metrics.registry().snapshot()
+
+
+class TestHysteresis:
+    def _ctl(self, confirm=2, hold=2):
+        owner = _Owner(fuse=1)
+        knobs.bind("server.fuse", owner, "fuse", label="hys")
+        (o,) = ctl.parse_objectives("hys.win < 2 -> server.fuse+")
+        c = ctl.Controller([o], confirm=confirm, hold=hold)
+        return owner, c, metrics.gauge("hys.win")
+
+    def test_noisy_boundary_never_moves(self):
+        owner, c, g = self._ctl(confirm=2)
+        for i in range(10):     # alternating: streak never reaches 2
+            g.set(5.0 if i % 2 == 0 else 1.0)
+            assert c.check_once() == []
+        assert owner.fuse == 1
+        assert ctl.recent_decisions() == []
+
+    def test_sustained_violation_steps_after_confirm(self):
+        owner, c, g = self._ctl(confirm=3, hold=0)
+        g.set(5.0)
+        assert c.check_once() == []     # streak 1
+        assert c.check_once() == []     # streak 2
+        moved = c.check_once()          # streak 3 -> move
+        assert [m["knob"] for m in moved] == ["server.fuse"]
+        assert owner.fuse == 3          # one clamped step (step=2)
+
+    def test_cooldown_holds_after_a_move(self):
+        owner, c, g = self._ctl(confirm=1, hold=2)
+        g.set(5.0)
+        assert c.check_once() != []     # move
+        assert c.check_once() == []     # hold 1
+        assert c.check_once() == []     # hold 2
+        assert c.check_once() != []     # moves again
+        assert owner.fuse == 5
+
+    def test_step_size_and_hi_bound_clamped(self):
+        owner = _Owner(fuse=63)
+        knobs.bind("server.fuse", owner, "fuse", label="clamp")
+        spec = knobs.spec("server.fuse")
+        changes = knobs.step("server.fuse", 1, label="clamp")
+        assert changes == [("clamp", 63, 64)]   # clamped to hi, not 65
+        assert owner.fuse <= spec.hi
+        assert knobs.step("server.fuse", 1, label="clamp") == []
+
+    def test_mul_knob_steps_off_the_zero_floor(self):
+        owner = _Owner(rate=0.0)
+        knobs.bind("server.qos.rate", owner, "rate", label="mul")
+        knobs.step("server.qos.rate", 1, label="mul")
+        assert owner.rate == 2.0        # additive off the floor
+        knobs.step("server.qos.rate", 1, label="mul")
+        assert owner.rate == 4.0        # then multiplicative
+        knobs.step("server.qos.rate", -1, label="mul")
+        assert owner.rate == 2.0
+
+
+# -- kill switch -----------------------------------------------------------
+
+class TestKillSwitch:
+    def test_env_veto_refuses_every_apply(self, monkeypatch):
+        owner = _Owner(fuse=1)
+        knobs.bind("server.fuse", owner, "fuse", label="veto")
+        monkeypatch.setenv(ctl.AUTOTUNE_ENV, "0")
+        assert ctl.disabled()
+        assert ctl.apply_step("server.fuse", 1) == []
+        assert ctl.apply_set("server.fuse", 8) == []
+        assert owner.fuse == 1
+        assert ctl.maybe_controller() is None
+
+    def test_kill_latches_and_rings(self):
+        owner = _Owner(fuse=1)
+        knobs.bind("server.fuse", owner, "fuse", label="kl")
+        assert ctl.apply_step("server.fuse", 1) != []
+        ctl.kill("operator says stop")
+        assert ctl.disabled()
+        assert ctl.apply_step("server.fuse", 1) == []
+        assert owner.fuse == 3          # frozen at the pre-kill value
+        ring = ctl.recent_decisions()
+        assert ring[-1]["op"] == "kill"
+        assert ring[-1]["reason"] == "operator says stop"
+        st = ctl.control_status()
+        assert st["killed"] and st["kill_reason"] == "operator says stop"
+
+    def test_control_post_kill_and_actuate(self):
+        from multiverso_tpu.telemetry import statusz
+        owner = _Owner(fuse=1)
+        knobs.bind("server.fuse", owner, "fuse", label="sz")
+        srv = statusz.StatuszServer(0).start()
+        try:
+            code, reply = _post(srv.port, {
+                "op": "set", "knob": "server.fuse", "value": 9,
+                "label": "sz", "origin": "test"})
+            assert code == 200 and reply["ok"]
+            assert owner.fuse == 9
+            assert reply["changes"][0]["to"] == 9
+            # /statusz carries the decision ring
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/statusz",
+                    timeout=10) as r:
+                doc = json.loads(r.read())
+            sect = doc["control"]
+            assert sect["knobs"]["server.fuse"]["sz"] == 9
+            assert any(d.get("knob") == "server.fuse"
+                       for d in sect["decisions"])
+            # the hard kill over the wire
+            code, reply = _post(srv.port, {"op": "kill",
+                                           "reason": "http"})
+            assert code == 200 and reply["killed"]
+            code, reply = _post(srv.port, {
+                "op": "step", "knob": "server.fuse", "dir": 1})
+            assert reply["killed"] and reply["changes"] == []
+            assert owner.fuse == 9
+        finally:
+            srv.stop()
+
+    def test_control_post_rejects_garbage(self):
+        from multiverso_tpu.telemetry import statusz
+        srv = statusz.StatuszServer(0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(srv.port, {"op": "frobnicate"})
+            assert ei.value.code == 400
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(srv.port, {"op": "kill"}, path="/bogus")
+            assert ei.value.code == 404
+        finally:
+            srv.stop()
+
+
+# -- the audit trail through report --fleet --------------------------------
+
+class TestFleetAudit:
+    def _fleet_file(self, tmp_path, port):
+        path = str(tmp_path / "fleet.json")
+        with open(path, "w") as f:
+            json.dump({"kind": "mvtpu.fleet.v1", "map": {},
+                       "members": [{"rank": 0, "name": "m0",
+                                    "addresses": [],
+                                    "statusz_port": port,
+                                    "pid": 0}]}, f)
+        return path
+
+    def test_decision_span_round_trip(self, tmp_path):
+        from multiverso_tpu.telemetry import report, statusz
+        trace.set_trace_file(str(tmp_path / "trace.jsonl"))
+        owner = _Owner(fuse=1)
+        knobs.bind("server.fuse", owner, "fuse", label="rt")
+        srv = statusz.StatuszServer(0).start()
+        try:
+            fleet = self._fleet_file(tmp_path, srv.port)
+            # a fleet-style actuation: POST carries the caller's trace
+            # context, the member's decision span must adopt it
+            with trace.request("control.retune", knob="server.fuse"):
+                wctx = trace.wire_context()
+                _post(srv.port, {
+                    "op": "set", "knob": "server.fuse", "value": 5,
+                    "rule": "test.rule < 1", "origin": "fleet",
+                    "ctx": wctx})
+            assert owner.fuse == 5
+            records, snap, errors = report.scrape_fleet(fleet)
+            assert errors == []
+            spans = [r for r in records if r.get("kind") == "span"
+                     and r.get("name") == "control.decision"]
+            assert len(spans) == 1
+            at = spans[0]["attrs"]
+            assert at["knob"] == "server.fuse" and at["to"] == 5
+            assert at["origin"] == "fleet"
+            assert at["rule"] == "test.rule < 1"
+            # parent-linked into the caller's tree: same request id,
+            # rparent names the remote span
+            assert spans[0]["req"] == wctx["req"]
+            assert spans[0]["rparent"]["span"] == wctx["span"]
+            # the merged snapshot counts the decision
+            assert any(k.startswith("control.decisions")
+                       for k in snap["counters"])
+            # and the human rendering names the move
+            text = report.render_decisions(records)
+            assert "server.fuse" in text and "1 -> 5" in text
+        finally:
+            srv.stop()
+            trace.set_trace_file(None)
+
+    def test_fleet_controller_end_to_end(self, tmp_path):
+        """FleetController scrapes the member's /metrics, sees the
+        violation, POSTs a step, and the member's binding moves."""
+        from multiverso_tpu.telemetry import statusz
+        owner = _Owner(fuse=1)
+        knobs.bind("server.fuse", owner, "fuse", label="fc")
+        srv = statusz.StatuszServer(0).start()
+        try:
+            fleet = self._fleet_file(tmp_path, srv.port)
+            metrics.gauge("fc.win").set(5.0)
+            fc = ctl.FleetController(
+                fleet, ctl.parse_objectives("fc.win < 1 -> server.fuse+"),
+                confirm=1, hold=0)
+            moved = fc.check_once()
+            assert owner.fuse == 3
+            assert moved and moved[0]["port"] == srv.port
+            assert moved[0]["origin"] == "fleet"
+            # the member's ring saw a fleet-origin decision
+            assert any(d.get("origin") == "fleet"
+                       for d in ctl.recent_decisions())
+            # healthy metrics -> no further actuation
+            metrics.gauge("fc.win").set(0.5)
+            assert fc.check_once() == []
+            assert owner.fuse == 3
+        finally:
+            srv.stop()
+
+
+# -- arming ----------------------------------------------------------------
+
+class TestArming:
+    def test_maybe_controller_armed_and_idempotent(self, monkeypatch):
+        monkeypatch.setenv(ctl.AUTOTUNE_ENV,
+                           "arm.win < 1 -> server.fuse+")
+        monkeypatch.setenv(ctl.EVERY_ENV, "30")
+        c = ctl.maybe_controller()
+        assert c is not None and c.every_s == 30.0
+        assert ctl.maybe_controller() is c      # idempotent
+        assert ctl.control_status()["enabled"]
+
+    def test_maybe_controller_rejects_bad_spec(self, monkeypatch):
+        monkeypatch.setenv(ctl.AUTOTUNE_ENV, "garbage spec")
+        assert ctl.maybe_controller() is None
+
+    def test_initial_env_seeding(self, monkeypatch):
+        monkeypatch.setenv("MVTPU_SERVER_FUSE", "7")
+        assert knobs.initial("server.fuse") == 7
+        monkeypatch.setenv("MVTPU_SERVER_FUSE", "1000")
+        assert knobs.initial("server.fuse") == 64   # clamped to hi
+        monkeypatch.setenv("MVTPU_SERVER_FUSE", "junk")
+        with pytest.raises(ValueError):
+            knobs.initial("server.fuse")
+
+
+# -- forced misconfiguration converges on a real server --------------------
+
+class TestConvergence:
+    def test_mistuned_server_fuse_converges(self, tmp_path):
+        """A real wire TableServer constructed with fuse=1 (the
+        misconfiguration) + a sustained violated objective: the
+        controller must ratchet the LIVE server's fuse depth up in
+        clamped steps, stop when the signal clears, and keep serving
+        bit-exact ops throughout."""
+        s = TableServer(f"unix:{tmp_path}/conv.sock", name="conv",
+                        fuse=1)
+        addr = s.start()
+        try:
+            g = metrics.gauge("conv.win.p99_ms")
+            (o,) = ctl.parse_objectives(
+                "conv.win.p99_ms < 10 -> server.fuse+")
+            c = ctl.Controller([o], confirm=1, hold=0)
+            with mv_client.connect(addr, quant=None) as cl:
+                t = cl.create_array("conv_arr", 32)
+                d = np.arange(32, dtype=np.float32)
+                t.add(d, sync=True)
+                g.set(100.0)            # the forced violation
+                fuses = [s._fuse]
+                for _ in range(3):
+                    assert c.check_once() != []
+                    fuses.append(s._fuse)
+                    t.add(d, sync=True)     # serving continues mid-tune
+                assert fuses == [1, 3, 5, 7]    # clamped +2 ratchet
+                g.set(1.0)              # signal clears -> no more moves
+                assert c.check_once() == []
+                assert s._fuse == 7
+                got = np.asarray(t.get())   # 1 seed add + 3 mid-tune
+                assert got.tobytes() == (d * 4).tobytes()
+            ring = [e for e in ctl.recent_decisions()
+                    if e.get("knob") == "server.fuse"]
+            assert [(e["from"], e["to"]) for e in ring] == \
+                [(1, 3), (3, 5), (5, 7)]
+        finally:
+            s.stop()

@@ -8,7 +8,6 @@ both members, a REAL server subprocess merged with the local client
 trace (clock samples included), and the report-side stitching math
 (clock offsets + chrome flow arrows)."""
 
-import json
 import os
 import subprocess
 import sys

@@ -97,7 +97,13 @@ DEFAULT_WATCH = ("value", "e2e_words_per_sec", "lda_doc_tokens_per_sec",
                  # with the wire trace context stamped on every frame —
                  # a drop here means distributed tracing stopped being
                  # cheap enough to leave on
-                 "serving_mp_traced_ops_per_sec")
+                 "serving_mp_traced_ops_per_sec",
+                 # autotune lane (serving.py --autotune): protected
+                 # throughput AFTER the controller converges a mistuned
+                 # server — a drop means the closed loop stopped
+                 # recovering the hand-tuned operating point, while the
+                 # mistuned starting floor rides along unwatched
+                 "autotune_converged_ops_per_sec")
 
 # LOWER-is-better watches: a rise past the threshold regresses
 DEFAULT_WATCH_LOWER = ("serving_p99_ms",
@@ -500,6 +506,27 @@ def selftest() -> int:
         tr_doc2["serving_mp_untraced_ops_per_sec"] = 1000.0  # unwatched
         assert main([tr_old, put("tr_base.json", tr_doc2)]) == 0, \
             "the untraced twin rides along unwatched"
+        # autotune lane: the converged protected throughput is watched
+        # — the closed loop failing to recover the operating point
+        # shows up as a drop, while the mistuned floor and the decision
+        # count ride along unwatched
+        at_old = put("at_old.json", {
+            "metric": "autotune_converged_ops_per_sec", "value": 130.0,
+            "unit": "ops/s", "autotune_converged_ops_per_sec": 130.0,
+            "autotune_handtuned_ops_per_sec": 125.0,
+            "autotune_mistuned_ops_per_sec": 2.0,
+            "autotune_frac_of_handtuned": 1.04,
+            "autotune_decisions": 20.0})
+        at_doc = json.loads(json.dumps(json.load(open(at_old))))
+        at_doc["autotune_converged_ops_per_sec"] = 40.0     # -69%
+        at_doc["value"] = 40.0
+        assert main([at_old, put("at_bad.json", at_doc)]) == 1, \
+            "converged-throughput drop must fail (loop stopped tuning)"
+        at_doc2 = json.loads(json.dumps(json.load(open(at_old))))
+        at_doc2["autotune_mistuned_ops_per_sec"] = 0.5      # unwatched
+        at_doc2["autotune_decisions"] = 35.0
+        assert main([at_old, put("at_base.json", at_doc2)]) == 0, \
+            "the mistuned floor and decision count ride unwatched"
         # unusable inputs exit 2, not a traceback
         hung = put("hung.json", {"rc": 124, "tail": "...", "parsed": None})
         assert main([hung, raw_ok]) == 2, "no parsed line -> exit 2"

@@ -9,7 +9,11 @@ Checks per file:
 - every imported name is used somewhere in the module (attribute
   roots, decorators, annotations included). ``__init__.py`` files are
   exempt (re-export surface), as are ``from __future__`` imports,
-  underscore-prefixed bindings, and lines carrying ``# noqa``.
+  underscore-prefixed bindings, and lines carrying ``# noqa``,
+- every ``MVTPU_*`` env var named anywhere in the tree appears in the
+  README knob reference — an undocumented knob is a knob nobody can
+  tune (or kill). String constants that are prefixes (trailing
+  ``_``/``*``) are exempt; so are lines carrying ``# noqa``.
 
 Exit status: number of findings (0 = clean), capped at 125.
 """
@@ -17,9 +21,13 @@ Exit status: number of findings (0 = clean), capped at 125.
 from __future__ import annotations
 
 import ast
+import re
 import sys
 from pathlib import Path
 from typing import List, Tuple
+
+#: a complete MVTPU env var name (NOT a prefix like "MVTPU_TIER_")
+_ENV_RE = re.compile(r"MVTPU_[A-Z0-9_]*[A-Z0-9]")
 
 
 def _imported_names(tree: ast.AST) -> List[Tuple[str, int, str]]:
@@ -79,6 +87,49 @@ def lint_file(path: Path) -> List[str]:
     return findings
 
 
+def _env_vars(path: Path, tree: ast.AST) -> List[Tuple[str, int, str]]:
+    """[(env var, lineno, path)] for every complete ``MVTPU_*`` name
+    in a string constant (env reads in this tree always name the var
+    as a literal or a module-level ``*_ENV`` constant)."""
+    lines = path.read_text().splitlines()
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Constant)
+                and isinstance(node.value, str)):
+            continue
+        lineno = getattr(node, "lineno", 0)
+        if 0 < lineno <= len(lines) and "noqa" in lines[lineno - 1]:
+            continue
+        if not _ENV_RE.fullmatch(node.value):
+            continue
+        out.append((node.value, lineno, str(path)))
+    return out
+
+
+def knob_doc_findings(files: List[Path],
+                      readme: Path) -> List[str]:
+    """Every ``MVTPU_*`` env var named in ``files`` must appear in the
+    README knob reference."""
+    if not readme.is_file():
+        return [f"{readme}: missing (knob-doc check needs it)"]
+    documented = set(_ENV_RE.findall(readme.read_text()))
+    findings = []
+    seen = set()
+    for f in files:
+        try:
+            tree = ast.parse(f.read_text(), filename=str(f))
+        except SyntaxError:
+            continue        # already reported by lint_file
+        for env, lineno, where in _env_vars(f, tree):
+            if env in documented or (env, where) in seen:
+                continue
+            seen.add((env, where))
+            findings.append(
+                f"{where}:{lineno}: env var {env} is not documented "
+                "in README.md (knob reference)")
+    return findings
+
+
 def main(argv: List[str]) -> int:
     roots = [Path(p) for p in (argv or ["multiverso_tpu"])]
     files: List[Path] = []
@@ -90,6 +141,8 @@ def main(argv: List[str]) -> int:
     findings: List[str] = []
     for f in files:
         findings.extend(lint_file(f))
+    readme = Path(__file__).resolve().parent.parent / "README.md"
+    findings.extend(knob_doc_findings(files, readme))
     for line in findings:
         print(line)
     print(f"lint: {len(files)} files, {len(findings)} finding(s)",
