@@ -81,7 +81,11 @@
 #                   starved at 2 ops/s) under a bulk flood; the
 #                   control.Controller must converge protected
 #                   throughput within 10% of a hand-tuned reference,
-#                   with every knob move audited in the decision ring
+#                   with every knob move audited in the decision ring;
+#                   a second phase re-mistunes with the objective in
+#                   the windowed form (p99@1s) and must converge
+#                   spending no more latency-clause decisions than a
+#                   non-actuating cumulative shadow of the same rule
 #                   (emits autotune_bench.json)
 #   make chaos    - the chaos lane: fault-injection test subset
 #                   (ft subsystem + overwrite crash-window fuzz) plus a

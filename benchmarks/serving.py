@@ -190,6 +190,15 @@ def _tiered_storm() -> dict:
 # hand-tuned reference measured on an identically-loaded server. Every
 # knob move lands in the decision ring / ``control.decision`` spans, so
 # the whole episode is reconstructable from ``/statusz``.
+#
+# The ISSUE-17 extension (phase C) re-runs the same convergence with
+# the latency SLO written as a WINDOWED grammar term
+# (``autotune.lat.p99@2s``) over a real telemetry histogram, racing a
+# non-actuating shadow of the cumulative form (lifetime
+# ``autotune.lat.p99``) on identical snapshots — the windowed form
+# must converge and settle with a decision count no worse than the
+# cumulative form, which keeps firing on the never-forgotten starved
+# samples.
 
 AUTOTUNE = dict(table_n=256, window_ops=40, window_s=0.35, rounds=30,
                 settle=2, flood_threads=2, flood_pipeline=8,
@@ -198,10 +207,13 @@ if TINY:
     AUTOTUNE.update(window_ops=24, window_s=0.25)
 
 
-def _autotune_window(t) -> tuple:
+def _autotune_window(t, hist=None) -> tuple:
     """One measurement window of sync protected gets: (ops/s, p99_s).
     Ops are serialized — a starved token bucket or a fuse-crippled
-    dispatch loop shows up directly in both numbers."""
+    dispatch loop shows up directly in both numbers. ``hist`` (a
+    telemetry histogram) additionally receives every raw latency, so
+    a windowed controller term can judge the actual distribution
+    instead of a hand-maintained per-window gauge."""
     a = AUTOTUNE
     lats = []
     t0 = time.perf_counter()
@@ -209,6 +221,8 @@ def _autotune_window(t) -> tuple:
         s0 = time.perf_counter()
         np.asarray(t.get())
         lats.append(time.perf_counter() - s0)
+        if hist is not None:
+            hist.observe(lats[-1])
         if time.perf_counter() - t0 >= a["window_s"]:
             break
     dt = time.perf_counter() - t0
@@ -296,11 +310,11 @@ def _autotune_lane() -> dict:
         # phase B — the mistuned server: fuse=1 and the protected
         # class starved at 2 ops/s (burst defaults to max(rate,1)=2,
         # so starvation bites from the very first window)
+        mist_qos = (f"train:match=train*,weight=8,"
+                    f"rate={a['starved_rate']};"
+                    f"bulk:match=bulk*,weight=1,rate={a['good_rate']}")
         srv = TableServer(
-            f"unix:{d}/auto.sock", name="auto", fuse=1,
-            qos=(f"train:match=train*,weight=8,"
-                 f"rate={a['starved_rate']};"
-                 f"bulk:match=bulk*,weight=1,rate={a['good_rate']}"))
+            f"unix:{d}/auto.sock", name="auto", fuse=1, qos=mist_qos)
         addr = srv.start()
         stop = threading.Event()
         errors: list = []
@@ -360,10 +374,114 @@ def _autotune_lane() -> dict:
         rate_now = knobs_now.get("server.qos.rate", {}) \
             .get("auto:train", a["starved_rate"])
         srv.stop()
+        del srv     # drop its bindings — phase C's controller must
+        # only actuate the windowed server
+
+        # phase C — the SAME latency SLO, but written as a windowed
+        # term over a real telemetry histogram
+        # (``autotune.lat.p99@2s``) instead of a hand-maintained
+        # per-window gauge. A fresh identically-mistuned server must
+        # converge under it. Alongside, the SLO written in the
+        # pre-windowed cumulative grammar (``autotune.lat.p99`` —
+        # lifetime bucket totals) is evaluated as a non-actuating
+        # shadow on the very same snapshots: lifetime p99 never
+        # forgets the starved samples, so the cumulative form keeps
+        # demanding knob moves long after the server has recovered,
+        # while the windowed form observes the recovery and settles.
+        # That asymmetry — not scheduling luck — is what makes the
+        # "decision count no worse" gate hold.
+        lat_hist = telemetry.histogram("autotune.lat")
+        # the window is matched to the lane's sub-second round
+        # cadence (a production objective would say @30s); the
+        # decision gate below compares the latency clause alone —
+        # the slowdown guard is shared verbatim by both forms
+        spec_w = (f"autotune.lat.p99@1s < {bound_ms:.3f}ms "
+                  "-> server.qos.rate+, server.fuse+; "
+                  "autotune.win.slowdown < 1.08 -> server.qos.rate+")
+        shadow = ctl_mod.parse_objectives(
+            f"autotune.lat.p99 < {bound_ms:.3f}ms "
+            "-> server.qos.rate+, server.fuse+")[0]
+        srv_w = TableServer(f"unix:{d}/autow.sock", name="autow",
+                            fuse=1, qos=mist_qos)
+        addr_w = srv_w.start()
+        stop_w = threading.Event()
+        errors_w: list = []
+        floods_w = [threading.Thread(target=_autotune_flood,
+                                     args=(addr_w, i, stop_w,
+                                           errors_w),
+                                     name=f"auto-flood-w{i}",
+                                     daemon=True)
+                    for i in range(a["flood_threads"])]
+        snap_box: dict = {}
+        ctl_w = ctl_mod.Controller(
+            ctl_mod.parse_objectives(spec_w), every_s=3600.0,
+            confirm=1, hold=0, source=lambda: snap_box["snap"])
+        decisions_w = 0
+        decisions_w_lat = 0
+        lat_raw = ctl_w.objectives[0].raw
+        shadow_cost = 0
+        shadow_fired_last = False
+        rounds_w = 0
+        settled_w = False
+        try:
+            with mv_client.connect(addr_w, client="train0") as c:
+                t = c.create_array("auto_train", a["table_n"])
+                t.add(np.ones(a["table_n"], np.float32), sync=True)
+                for f in floods_w:
+                    f.start()
+                # seed the windowed store with one pre-flight sample
+                # so the @2s term has a left edge to diff against
+                snap_box["snap"] = telemetry.registry().snapshot()
+                ctl_w.check_once()
+                _autotune_window(t, lat_hist)   # mistuned warm window
+                settled = 0
+                while rounds_w < a["rounds"]:
+                    rounds_w += 1
+                    ops, p99 = _autotune_window(t, lat_hist)
+                    telemetry.gauge("autotune.win.slowdown").set(
+                        round(hand_ops / max(ops, 1e-9), 6))
+                    snap = telemetry.registry().snapshot()
+                    snap_box["snap"] = snap
+                    fired, _ = shadow.evaluate(snap)
+                    if fired:
+                        # what the cumulative form would have spent:
+                        # one move per live binding of each action
+                        shadow_cost += sum(
+                            len(ctl_mod.knobs.current().get(k, {}))
+                            for k, _dir in shadow.actions)
+                    shadow_fired_last = fired
+                    moved = ctl_w.check_once()
+                    decisions_w += len(moved)
+                    decisions_w_lat += sum(
+                        1 for m in moved if m.get("rule") == lat_raw)
+                    if not moved and p99 * 1e3 <= bound_ms:
+                        settled += 1
+                        if settled >= a["settle"]:
+                            settled_w = True
+                            break
+                    else:
+                        settled = 0
+                conv_w = [_autotune_window(t, lat_hist)
+                          for _ in range(5)]
+        finally:
+            stop_w.set()
+            for f in floods_w:
+                f.join(timeout=OP_TIMEOUT_S)
+        if errors_w:
+            raise SystemExit("autotune windowed: "
+                             + "; ".join(errors_w))
+        conv_ops_w = max(s[0] for s in conv_w)
+        conv_p99_w = sorted(s[1] for s in conv_w)[len(conv_w) // 2]
+        knobs_w = ctl_mod.knobs.current()
+        fuse_w = knobs_w.get("server.fuse", {}).get("autow", 1)
+        rate_w = knobs_w.get("server.qos.rate", {}) \
+            .get("autow:train", a["starved_rate"])
+        srv_w.stop()
     finally:
         shutil.rmtree(d, ignore_errors=True)
 
     frac = conv_ops / hand_ops
+    frac_w = conv_ops_w / hand_ops
     ring = [e for e in ctl_mod.recent_decisions()
             if e.get("origin") == "local"]
     line = {
@@ -382,8 +500,20 @@ def _autotune_lane() -> dict:
         "autotune_mistuned_p99_ms": round(mist_p99 * 1e3, 3),
         "autotune_final_fuse": fuse_now,
         "autotune_final_train_rate": round(float(rate_now), 3),
+        "autotune_windowed_ops_per_sec": round(conv_ops_w, 2),
+        "autotune_windowed_frac_of_handtuned": round(frac_w, 4),
+        "autotune_windowed_p99_ms": round(conv_p99_w * 1e3, 3),
+        "autotune_decisions_windowed": decisions_w,
+        "autotune_decisions_windowed_lat": decisions_w_lat,
+        "autotune_decisions_cumulative_form":
+            shadow_cost + (decisions_w - decisions_w_lat),
+        "autotune_windowed_rounds": rounds_w,
+        "autotune_windowed_final_fuse": fuse_w,
+        "autotune_windowed_final_train_rate": round(float(rate_w), 3),
     }
-    # the acceptance gates — a lane that doesn't converge FAILS
+    # the acceptance gates — a lane that doesn't converge FAILS (the
+    # line goes to stderr first so a failing run is diagnosable)
+    print(json.dumps(line), file=sys.stderr, flush=True)
     assert decisions > 0, "autotune: controller never moved a knob"
     assert ring, "autotune: decision ring is empty"
     assert mist_ops < hand_ops * 0.7, \
@@ -395,6 +525,29 @@ def _autotune_lane() -> dict:
     assert frac >= 0.9, \
         f"autotune: converged at {frac:.2f}x of hand-tuned " \
         f"({conv_ops:.0f} vs {hand_ops:.0f} ops/s)"
+    # windowed-form gates: the @2s objective must converge just like
+    # the gauge form did, spending no more knob moves than the
+    # cumulative grammar would have — and the cumulative form must
+    # STILL be demanding moves when the windowed one settles (lifetime
+    # totals cannot observe recovery; that is the point of windows)
+    assert decisions_w > 0, \
+        "autotune: windowed objective never moved a knob"
+    assert settled_w, \
+        f"autotune: windowed objective never settled in " \
+        f"{rounds_w} rounds"
+    assert conv_p99_w * 1e3 <= bound_ms, \
+        f"autotune: windowed-form p99 {conv_p99_w * 1e3:.1f}ms over " \
+        f"the {bound_ms:.1f}ms bound"
+    assert frac_w >= 0.9, \
+        f"autotune: windowed form converged at {frac_w:.2f}x of " \
+        f"hand-tuned ({conv_ops_w:.0f} vs {hand_ops:.0f} ops/s)"
+    assert decisions_w_lat <= shadow_cost, \
+        f"autotune: windowed latency clause spent " \
+        f"{decisions_w_lat} decisions vs {shadow_cost} for the " \
+        f"cumulative form (slowdown guard identical in both)"
+    assert shadow_fired_last, \
+        "autotune: cumulative shadow was not firing at settle — " \
+        "the windowed/cumulative comparison is vacuous"
     return line
 
 

@@ -63,6 +63,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from multiverso_tpu.control import knobs
 from multiverso_tpu.telemetry import metrics as _metrics
 from multiverso_tpu.telemetry import slo as _slo
+from multiverso_tpu.telemetry import timeseries as _timeseries
 from multiverso_tpu.telemetry import trace as _trace
 from multiverso_tpu.utils import log
 
@@ -128,6 +129,117 @@ def _parse_bound(raw: str) -> float:
         return _slo._parse_value(raw)       # "5ms" -> 0.005
 
 
+class WindowedRule:
+    """A rule over the trailing window instead of lifetime totals:
+    ``rate(server.ops)@30s < 500`` (windowed counter rate, summed
+    across label series) or ``server.latency.p99@30s < 5ms``
+    (windowed histogram quantile via interval-delta of bucket counts,
+    worst matching series). The rule carries its OWN bounded
+    :class:`telemetry.timeseries.SeriesStore` fed by every snapshot
+    its controller evaluates — so the same rule object reacts to the
+    local registry under a :class:`Controller` and to the MERGED
+    fleet snapshot under a :class:`FleetController`, with no global-
+    store cross-talk between the two."""
+
+    STATS = ("p50", "p90", "p99", "p999", "mean")
+
+    def __init__(self, raw: str, form: str, metric: str,
+                 stat: Optional[str], window_s: float,
+                 bound: float) -> None:
+        self.raw = raw
+        self.form = form            # "rate" | "hist"
+        self.metric = metric
+        self.stat = stat
+        self.window_s = float(window_s)
+        self.bound_s = float(bound)     # SloRule field name, kept
+        self._store = _timeseries.SeriesStore()
+
+    def observe(self, snap: dict) -> None:
+        self._store.sample(snap)
+
+    def score_windowed(self) -> Tuple[Optional[float], Optional[dict]]:
+        """(worst windowed value, evidence) from the accumulated
+        history; (None, None) until two samples straddle a window."""
+        st = self._store
+        if self.form == "rate":
+            total, found = 0.0, False
+            for full in st.keys():
+                kind, _, key = full.partition(":")
+                if kind != "counter" \
+                        or key.partition("{")[0] != self.metric:
+                    continue
+                r = st.rate(key, self.window_s)
+                if r is not None:
+                    total += r
+                    found = True
+            if not found:
+                return None, None
+            return total, {"metric": self.metric, "stat": "rate",
+                           "window_s": self.window_s, "value": total,
+                           "bound": self.bound_s}
+        worst: Optional[float] = None
+        worst_key = None
+        for full in st.keys():
+            kind, _, key = full.partition(":")
+            if kind != "hist" or not _slo._match(self.metric, key):
+                continue
+            if self.stat == "mean":
+                h = st.hist_window(key, self.window_s)
+                value = (h["sum"] / h["count"]
+                         if h and h["count"] else None)
+            else:
+                q = int(self.stat[1:]) / 10.0 ** len(self.stat[1:])
+                value = st.quantile(key, q, self.window_s)
+            if value is None:
+                continue
+            if worst is None or value > worst:
+                worst, worst_key = value, key
+        if worst is None:
+            return None, None
+        return worst, {"metric": worst_key, "stat": self.stat,
+                       "window_s": self.window_s, "value": worst,
+                       "bound": self.bound_s}
+
+
+def _parse_windowed(rule_part: str) -> Optional[WindowedRule]:
+    """Parse one windowed rule clause, or None when the clause has no
+    ``@window`` term (the cumulative grammars take it). A PRESENT
+    ``@`` with a malformed window/stat raises — same loud-typo policy
+    as the rest of the grammar."""
+    metric_part, lt, bound_part = rule_part.partition("<")
+    if not lt:
+        return None
+    term = metric_part.strip()
+    name, at, win = term.rpartition("@")
+    if not at:
+        return None
+    name = name.strip()
+    try:
+        window_s = _slo._parse_value(win.strip())
+    except ValueError:
+        raise ValueError(f"windowed rule {rule_part!r}: bad window "
+                         f"{win.strip()!r} (want e.g. 30s)") from None
+    if window_s <= 0:
+        raise ValueError(f"windowed rule {rule_part!r}: window must "
+                         "be positive")
+    bound = _parse_bound(bound_part)
+    if name.startswith("rate(") and name.endswith(")"):
+        metric = name[5:-1].strip()
+        if not metric:
+            raise ValueError(
+                f"windowed rule {rule_part!r}: empty rate() metric")
+        return WindowedRule(rule_part, "rate", metric, None,
+                            window_s, bound)
+    metric, dot, stat = name.rpartition(".")
+    if not dot or stat not in WindowedRule.STATS:
+        raise ValueError(
+            f"windowed rule {rule_part!r}: expected "
+            "'rate(<counter>)@<win>' or "
+            f"'<hist>.<{'|'.join(WindowedRule.STATS)}>@<win>'")
+    return WindowedRule(rule_part, "hist", metric, stat, window_s,
+                        bound)
+
+
 class Objective:
     """One parsed ``rule -> actions`` clause."""
 
@@ -141,6 +253,12 @@ class Objective:
         """(violated, evidence) against one registry snapshot. For
         histogram rules the evidence names the worst-scoring series,
         mirroring ``SloMonitor.check_once``."""
+        if isinstance(self.rule, WindowedRule):
+            self.rule.observe(snap)
+            value, evidence = self.rule.score_windowed()
+            if value is None or value <= self.rule.bound_s:
+                return False, None
+            return True, evidence
         if isinstance(self.rule, DerivedRule):
             value = self.rule.score(snap)
             if value is None or value <= self.rule.bound_s:
@@ -175,18 +293,22 @@ def parse_objectives(spec: str) -> List[Objective]:
             raise ValueError(
                 f"objective {clause!r}: expected '<rule> -> <knob>+'")
         rule_part = rule_part.strip()
-        try:
-            rule: Any = _slo.parse_rule(rule_part)
-        except ValueError:
-            # not a histogram statistic — a derived ratio or a plain
-            # gauge/counter name
-            metric, lt, bound = rule_part.partition("<")
-            if not lt:
-                raise ValueError(
-                    f"objective rule {rule_part!r}: expected "
-                    "'<metric> < <bound>'") from None
-            rule = DerivedRule(rule_part, metric.strip(),
-                               _parse_bound(bound))
+        # windowed terms first: an '@window' suffix means "react to
+        # the trailing window, not lifetime totals"
+        rule: Any = _parse_windowed(rule_part)
+        if rule is None:
+            try:
+                rule = _slo.parse_rule(rule_part)
+            except ValueError:
+                # not a histogram statistic — a derived ratio or a
+                # plain gauge/counter name
+                metric, lt, bound = rule_part.partition("<")
+                if not lt:
+                    raise ValueError(
+                        f"objective rule {rule_part!r}: expected "
+                        "'<metric> < <bound>'") from None
+                rule = DerivedRule(rule_part, metric.strip(),
+                                   _parse_bound(bound))
         actions: List[Tuple[str, int]] = []
         for item in action_part.split(","):
             item = item.strip()

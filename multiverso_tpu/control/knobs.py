@@ -136,6 +136,18 @@ SPECS: Dict[str, Knob] = {k.name: k for k in (
           kind="int", default=0, lo=0, hi=1 << 20, step=0,
           owner="storage",
           doc="tiered-KV host-tier bucket count (initial-only)"),
+    _spec("telemetry.ts_every", env="MVTPU_TS_EVERY", kind="float",
+          default=1.0, lo=0.0, hi=3600.0, step=0, owner="telemetry",
+          doc="time-series sampler cadence, seconds (0 = off; unset "
+              "= on once statusz arms; initial-only)"),
+    _spec("attribution.topk_k", env="MVTPU_TOPK_K", kind="int",
+          default=32, lo=0, hi=4096, step=0, owner="telemetry",
+          doc="heavy-hitter sketch capacity K (0 kills the "
+              "attribution plane; initial-only)"),
+    _spec("attribution.heat_buckets", env="MVTPU_TOPK_HEAT",
+          kind="int", default=16, lo=1, hi=4096, step=0,
+          owner="telemetry",
+          doc="per-table range-heat buckets (initial-only)"),
 )}
 
 

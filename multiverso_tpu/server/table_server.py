@@ -91,6 +91,7 @@ from multiverso_tpu.io import wiresock
 from multiverso_tpu.server import admission as _admission_mod
 from multiverso_tpu.server import wire
 from multiverso_tpu.server.replica import TableReplica
+from multiverso_tpu.telemetry import attribution as _attribution
 from multiverso_tpu.telemetry import metrics as telemetry
 from multiverso_tpu.telemetry import trace as _trace
 from multiverso_tpu.updaters import AddOption
@@ -302,6 +303,9 @@ class TableServer:
         self._exemplar_seq = 0
         self._exemplar_lock = threading.Lock()
         self._ops = 0
+        # usage attribution: who (client, table, op) and where (range
+        # heat) — None when killed via MVTPU_TOPK_K=0
+        self._attr = _attribution.plane()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -383,7 +387,12 @@ class TableServer:
                 "admission": self._admission.status(),
                 "replicas": [rep.status()
                              for rep in self._replicas.values()],
-                "slow": self.slow_exemplars()}
+                "slow": self.slow_exemplars(),
+                # top talkers + range heat ride the stats wire op, so
+                # an operator probe sees attribution without an HTTP
+                # port (the flood smoke's scorer path)
+                "topk": (self._attr.topk_doc(n=8)
+                         if self._attr is not None else None)}
 
     def slow_exemplars(self) -> List[Dict[str, Any]]:
         """The exemplar ring, slowest first: one row per settled
@@ -528,6 +537,10 @@ class TableServer:
             conn.client_id, header,
             (conn, header, arrays, time.monotonic()))
         if shed is not None:
+            if self._attr is not None:
+                self._attr.shed(conn.client_id,
+                                self._table_name(header),
+                                str(header.get("op", "?")))
             shed["rid"] = header.get("rid")
             # shed replies name the shedder and echo the trace id, so
             # the client's retry-wait span says which server/class
@@ -605,7 +618,9 @@ class TableServer:
                 t0 = time.monotonic()
                 reply = self._safe_execute(conn, op, header, arrays)
                 self._finish(conn, op, header, reply, t0,
-                             h_dispatch, enq_ts)
+                             h_dispatch, enq_ts,
+                             n_bytes=sum(int(a.nbytes)
+                                         for a in arrays))
             elif batch:
                 self._run_fused_batch(batch, h_dispatch)
             if stop_after:
@@ -651,7 +666,8 @@ class TableServer:
 
     def _finish(self, conn: _Conn, op: str, header: Dict[str, Any],
                 reply: Optional[tuple], t0: float, h_dispatch,
-                enq_ts: Optional[float] = None) -> None:
+                enq_ts: Optional[float] = None,
+                n_bytes: int = 0) -> None:
         now = time.monotonic()
         h_dispatch.observe(now - t0)
         self._ops += 1
@@ -682,6 +698,13 @@ class TableServer:
                     attrs["fused"] = int(fused)
                 _trace.emit_span(f"server.dispatch.{op}",
                                  t_wall - exec_s, exec_s, **attrs)
+        if self._attr is not None \
+                and op not in _admission_mod.CONTROL_OPS:
+            if rarrays:
+                n_bytes += sum(int(a.nbytes) for a in rarrays)
+            self._attr.record(conn.client_id, self._table_name(header),
+                              op, n_bytes=n_bytes,
+                              queue_ms=wait_s * 1e3)
         if op not in _admission_mod.CONTROL_OPS:
             row = {"rid": rid, "op": op, "client": conn.client_id,
                    "class": self._admission.class_name(conn.client_id,
@@ -719,10 +742,11 @@ class TableServer:
                                                       arrays)
             else:
                 replies.update(self._execute_group(unit))
-        for idx, (conn, header, _arrays, enq_ts) in enumerate(batch):
+        for idx, (conn, header, arrays, enq_ts) in enumerate(batch):
             self._finish(conn, str(header.get("op", "?")),
                          header, replies.get(idx), t0,
-                         h_dispatch, enq_ts)
+                         h_dispatch, enq_ts,
+                         n_bytes=sum(int(a.nbytes) for a in arrays))
 
     def _plan_units(self, batch: List[tuple]) -> List[_Unit]:
         """Group the cycle's frames. A frame may only join a group that
@@ -852,6 +876,7 @@ class TableServer:
                         f"{total.shape}")
                 else:
                     total += delta
+            self._heat_touch_dense(header0, table, weight=float(k))
             handle = table.add(total, option, sync=sync)
             reply = {"ok": True, "gen": handle.generation, "fused": k}
             return {idx: (dict(reply), []) for idx, *_ in items}
@@ -871,6 +896,7 @@ class TableServer:
                 all_deltas.append(delta)
             cat_keys = np.concatenate(all_keys)
             cat_deltas = np.concatenate(all_deltas, axis=0)
+            self._heat_touch_keys(header0, cat_keys)
             # CoalescingBuffer KV rule: cross-request duplicates
             # pre-sum so the stateful-updater unique-ids contract
             # holds for the ONE fused batch
@@ -889,6 +915,7 @@ class TableServer:
         if op == "get":
             for _idx, _conn, header, _arrays in items:
                 self._maybe_arm_replica(header)
+            self._heat_touch_dense(header0, table, weight=float(k))
             values = np.ascontiguousarray(table.get())
             return {idx: ({"ok": True, "fused": k}, [values])
                     for idx, *_ in items}
@@ -901,7 +928,9 @@ class TableServer:
                     .astype(np.uint64, copy=False)
                 all_keys.append(keys)
                 lens.append(len(keys))
-            values, found = table.get(np.concatenate(all_keys))
+            cat_keys = np.concatenate(all_keys)
+            self._heat_touch_keys(header0, cat_keys)
+            values, found = table.get(cat_keys)
             out: Dict[int, tuple] = {}
             off = 0
             for (idx, *_), n in zip(items, lens):
@@ -1027,6 +1056,65 @@ class TableServer:
             raise KeyError(f"no table {tid} on this server")
         return table
 
+    def _table_name(self, header: Dict[str, Any]) -> str:
+        try:
+            tid = int(header.get("table", -1))
+        except (TypeError, ValueError):
+            return "?"
+        t = self._tables.get(tid)
+        name = getattr(t, "name", None) if t is not None else None
+        return str(name) if name else (str(header.get("name"))
+                                       if header.get("name") else "?")
+
+    # -- range heat (attribution plane) -------------------------------------
+
+    def _heat_touch_dense(self, header: Dict[str, Any], table,
+                          weight: float = 1.0) -> None:
+        """Attribute one dense whole-table op across the member's
+        OWNED element range (the PartitionMap dense split): a
+        whole-table add/get warms every owned element equally."""
+        if self._attr is None:
+            return
+        tid = int(header.get("table", -1))
+        part = self._table_parts.get(tid)
+        if part is not None and "range" in part:
+            lo, hi = part["range"]
+        else:
+            lo, hi = 0, int(getattr(table, "size", 1) or 1)
+        name = self._table_name(header)
+        self._attr.heat(name, "element", lo, hi) \
+            .touch_span(lo, hi, weight)
+
+    def _heat_touch_keys(self, header: Dict[str, Any],
+                         keys: np.ndarray) -> None:
+        """Attribute one KV op's keys into the member's owned
+        splitmix64 bucket range — the SAME logical bucket space
+        :class:`server.partition.PartitionMap` routes on, so fleet
+        members' heat vectors concatenate into one aligned strip.
+        Unpartitioned servers hash into their own heat-bucket space
+        (lo=0, hi=heat_buckets) with the same splitmix64 finalizer."""
+        if self._attr is None or len(keys) == 0:
+            return
+        name = self._table_name(header)
+        if self._partition is not None:
+            lo, hi = self._partition.bucket_range()
+            pos = self._partition.map.kv_bucket(keys)
+            heat = self._attr.heat(name, "bucket", lo, hi)
+        else:
+            from multiverso_tpu.tables import hashing as _hashing
+            nb = self._attr.heat_buckets
+            pos = _hashing._hash_u64(keys) % np.uint64(nb)
+            heat = self._attr.heat(name, "bucket", 0, nb)
+        span = heat.hi - heat.lo
+        rel = pos.astype(np.int64) - heat.lo
+        rel = rel[(rel >= 0) & (rel < span)]
+        if len(rel) == 0:
+            return
+        idx = np.minimum(rel * heat.buckets // span, heat.buckets - 1)
+        counts = np.bincount(idx, minlength=heat.buckets)
+        for b in np.nonzero(counts)[0]:
+            heat.counts[int(b)] += float(counts[b])
+
     def _op_create(self, header: Dict[str, Any]) -> tuple:
         name = str(header["name"])
         kind = str(header.get("kind", "array"))
@@ -1140,6 +1228,7 @@ class TableServer:
     def _op_get(self, header: Dict[str, Any]) -> tuple:
         table = self._table(header)
         self._maybe_arm_replica(header)
+        self._heat_touch_dense(header, table)
         values = table.get()
         return ({"ok": True}, [np.ascontiguousarray(values)])
 
@@ -1149,6 +1238,7 @@ class TableServer:
         self._maybe_arm_replica(header)
         keys = np.ascontiguousarray(arrays[0]).astype(np.uint64,
                                                       copy=False)
+        self._heat_touch_keys(header, keys)
         values, found = table.get(keys)
         return ({"ok": True}, [np.ascontiguousarray(values),
                                np.ascontiguousarray(found)])
@@ -1157,6 +1247,7 @@ class TableServer:
                 arrays: List[np.ndarray],
                 force_sync: bool = False) -> tuple:
         table = self._table(header)
+        self._heat_touch_dense(header, table)
         # dequant-before-apply: the table layer only ever sees floats
         delta = wire.decode_delta(header.get("quant"), arrays)
         handle = table.add(delta, self._option(header),
@@ -1169,6 +1260,7 @@ class TableServer:
         table = self._table(header)
         keys = np.ascontiguousarray(arrays[0]).astype(np.uint64,
                                                       copy=False)
+        self._heat_touch_keys(header, keys)
         delta = wire.decode_delta(header.get("quant"), arrays[1:])
         handle = table.add(keys, delta, self._option(header),
                            sync=bool(header.get("sync")) or force_sync)

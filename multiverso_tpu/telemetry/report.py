@@ -13,7 +13,15 @@ which it got:
 - a span/step trace JSONL (``trace.set_trace_file`` output) → per-name
   span aggregates plus the step timeline tail,
 - a metric-event JSONL (``MVTPU_METRICS_JSONL`` / ``emit_metric``
-  sink) → last value per metric.
+  sink) → last value per metric,
+- a windowed-series doc (``/vars?window=`` output or a
+  ``report --fleet --vars-out`` merge, ``kind == "mvtpu.series.v1"``)
+  → windowed rates / gauges / quantile tables,
+- a flight-recorder series dump (watchdog ``series.json``,
+  ``kind == "mvtpu.series.dump.v1"``) → per-series sparklines of the
+  trailing window,
+- a heavy-hitter doc (``/topk`` output, ``kind == "mvtpu.topk.v1"``)
+  → top-talkers table + per-range heat strips.
 
 ``--chrome-trace [OUT]`` converts a span/step/metric JSONL into Chrome
 trace-event JSON (default OUT ``-`` = stdout) loadable in Perfetto
@@ -33,7 +41,10 @@ trace's per-connection offset records, and reports the fleet as ONE
 system: a merged ``--chrome-trace`` with a process track per
 (host, pid) and flow arrows stitching each request's cross-process
 tree, plus a fleet-total metrics snapshot (``--snapshot-out``)
-bench_diff can read.
+bench_diff can read. The default table view also scrapes the usage
+plane — merged ``/vars?window=`` (``--window``, ``--vars-out``) and
+merged ``/topk`` rendered as a fleet top-talkers table with per-range
+heat strips aligned member by member.
 
 Pure stdlib, never imports jax: it must run against the artifact of a
 HUNG run (the round-5 bench probes wedged with zero diagnostic signal —
@@ -46,9 +57,11 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-from typing import Dict, List
+from typing import Dict, List, Optional
 
+from multiverso_tpu.telemetry import attribution as _attribution
 from multiverso_tpu.telemetry import metrics as _metrics
+from multiverso_tpu.telemetry import timeseries as _timeseries
 from multiverso_tpu.telemetry import trace as _trace
 
 
@@ -359,6 +372,161 @@ def render_health(snap: dict) -> str:
     return "\n\n".join(out)
 
 
+_BLOCKS = " ▁▂▃▄▅▆▇█"
+
+
+def _spark(values: List[float], peak: Optional[float] = None) -> str:
+    """Unicode block sparkline, scaled to ``peak`` (default: own max)
+    so strips sharing a peak are visually comparable."""
+    if not values:
+        return ""
+    top = peak if peak else max(values)
+    if top <= 0:
+        return _BLOCKS[0] * len(values)
+    hi = len(_BLOCKS) - 1
+    return "".join(
+        _BLOCKS[min(max(int(v / top * hi + 0.5), 0), hi)]
+        for v in values)
+
+
+def _heat_parts(heat: dict) -> Dict[str, List[dict]]:
+    """Normalize member-doc heat (``{table: part}``) and merged-doc
+    heat (``{table: [part, ...]}``) to the list form."""
+    out: Dict[str, List[dict]] = {}
+    for table, h in (heat or {}).items():
+        out[table] = list(h) if isinstance(h, list) else [dict(h)]
+    return out
+
+
+def render_topk(doc: dict, n: int = 10) -> str:
+    """Top-talkers table + per-range heat strips of an
+    ``mvtpu.topk.v1`` document (single member or merged fleet).
+
+    One row per (client, table, op) in ``ops`` rank order, with the
+    same key's standing in every other dimension joined in — "-" when
+    a dimension's sketch is not tracking that key. Heat strips lay a
+    table's per-member ranges side by side (sorted by range start)
+    scaled to one shared peak, so the hottest bucket of the FLEET is
+    the tallest block of the whole strip."""
+    if doc.get("disabled"):
+        return "(attribution plane disabled — MVTPU_TOPK_K=0)"
+    dims = doc.get("dims", {})
+    out: List[str] = []
+    members = doc.get("members")
+    label = (f"fleet top talkers ({members} member(s))"
+             if members else "top talkers")
+    by_key: Dict[str, Dict[str, tuple]] = {}
+    for dim in _attribution.DIMS:
+        for r in (dims.get(dim) or {}).get("top", []):
+            key = _attribution.key_str(r.get("client", ""),
+                                       r.get("table", ""),
+                                       r.get("op", ""))
+            by_key.setdefault(key, {})[dim] = (
+                float(r.get("estimate", 0.0)),
+                float(r.get("error", 0.0)))
+    ranked = sorted(by_key.items(),
+                    key=lambda kv: -kv[1].get("ops", (0.0, 0.0))[0])
+
+    def cell(cells: Dict[str, tuple], dim: str) -> str:
+        c = cells.get(dim)
+        if c is None:
+            return "-"
+        est, err = c
+        return _num(est) if not err else f"{_num(est)}±{_num(err)}"
+
+    rows = [[*_attribution.split_key(key), cell(cells, "ops"),
+             cell(cells, "bytes"), cell(cells, "queue_ms"),
+             cell(cells, "sheds")]
+            for key, cells in ranked[:n]]
+    if rows:
+        totals = ", ".join(
+            f"{d}={_num(float((dims.get(d) or {}).get('total', 0.0)))}"
+            for d in _attribution.DIMS
+            if (dims.get(d) or {}).get("total"))
+        out.append(f"{label} (totals: {totals or 'none'}):\n" + _table(
+            rows, ["client", "table", "op", "ops", "bytes", "queue_ms",
+                   "sheds"]))
+    parts_by_table = _heat_parts(doc.get("heat", {}))
+    for table, parts in sorted(parts_by_table.items()):
+        peak = max((max(p.get("counts") or [0.0]) for p in parts),
+                   default=0.0)
+        lines = [f"heat [{table}] "
+                 f"({parts[0].get('space', '?')} space, shared peak "
+                 f"{_num(peak)}):"]
+        for p in parts:
+            who = (f"m{p['member']}" if "member" in p else "local")
+            lines.append(
+                f"  {who:<6} [{p.get('lo', 0):>8}, {p.get('hi', 0):>8})"
+                f"  {_spark(p.get('counts', []), peak)}"
+                f"  total {_num(float(p.get('total', 0.0)))}")
+        out.append("\n".join(lines))
+    if not out:
+        return "(empty top-k document)"
+    return "\n\n".join(out)
+
+
+def render_series(doc: dict) -> str:
+    """Windowed-vars table of an ``mvtpu.series.v1`` document (one
+    member's ``/vars`` or the :func:`timeseries.merge_vars` fleet
+    view): per-counter rates over the window, gauge last-points, and
+    windowed histogram quantiles."""
+    w = doc.get("window", 0.0)
+    members = doc.get("members")
+    head = (f"windowed vars (last {_num(w)}s, {members} member(s))"
+            if members else f"windowed vars (last {_num(w)}s)")
+    out: List[str] = []
+    rates = doc.get("rates", {})
+    deltas = doc.get("deltas", {})
+    if rates or deltas:
+        keys = sorted(set(rates) | set(deltas))
+        rows = [[k,
+                 _num(rates[k]) if k in rates else "-",
+                 _num(deltas[k]) if k in deltas else "-"]
+                for k in keys]
+        out.append(f"{head} — counters:\n"
+                   + _table(rows, ["name", "per_s", "delta"]))
+    gauges = doc.get("gauges", {})
+    if gauges:
+        rows = [[k, _num(v)] for k, v in sorted(gauges.items())]
+        out.append("gauges (latest):\n" + _table(rows, ["name",
+                                                        "value"]))
+    hists = doc.get("histograms", {})
+    if hists:
+        rows = []
+        for k, h in sorted(hists.items()):
+            def ms(v):
+                return "-" if v is None else f"{v * 1e3:.3f}"
+            rows.append([k, _num(h.get("count", 0)),
+                         ms(h.get("p50")), ms(h.get("p99")),
+                         ms(h.get("p999"))])
+        out.append("windowed histograms:\n" + _table(
+            rows, ["name", "count", "p50_ms", "p99_ms", "p999_ms"]))
+    if not out:
+        return f"{head}: (no series yet — sampler warming up?)"
+    return "\n\n".join(out)
+
+
+def render_series_dump(doc: dict) -> str:
+    """Sparkline view of an ``mvtpu.series.dump.v1`` flight-recorder
+    document: one line per series, the trailing window rendered as
+    blocks with the min/max/last values spelled out — the "what were
+    the last 60 seconds like" a post-mortem opens with."""
+    series = doc.get("series", {})
+    if not series:
+        return "(empty series dump)"
+    rows = []
+    for key, s in sorted(series.items()):
+        vals = [float(p[1]) for p in s.get("points", [])]
+        if not vals:
+            continue
+        rows.append([key, s.get("unit", ""), _spark(vals),
+                     _num(min(vals)), _num(max(vals)), _num(vals[-1])])
+    head = (f"series dump (last {_num(doc.get('window', 0.0))}s, "
+            f"{len(rows)} series):")
+    return head + "\n" + _table(
+        rows, ["series", "unit", "trail", "min", "max", "last"])
+
+
 def render_metric_events(records: List[dict]) -> str:
     last: Dict[str, dict] = {}
     for r in records:
@@ -425,8 +593,47 @@ def scrape_fleet(fleet_file: str, client_traces=(),
     return records, snap, errors
 
 
+def scrape_usage(fleet_file: str, window: float = 30.0,
+                 timeout: float = 10.0):
+    """Scrape every fleet member's usage plane (``/vars?window=`` +
+    ``/topk``) and return ``(vars_merged, topk_merged, errors)`` —
+    the merged windowed-series doc (:func:`timeseries.merge_vars`),
+    the merged heavy-hitter doc (:func:`attribution.merge_topk`), or
+    None for whichever nothing answered. Same partial-fleet tolerance
+    as :func:`scrape_fleet`."""
+    from multiverso_tpu.server import partition   # jax-free, cheap
+    doc = partition.read_fleet_file(fleet_file)
+    if doc is None:
+        raise ValueError(f"not a fleet file: {fleet_file}")
+    vars_docs: List[dict] = []
+    topk_docs: List[dict] = []
+    errors: List[str] = []
+    for m in doc.get("members", []):
+        port, rank = m.get("statusz_port"), m.get("rank")
+        if not port:
+            continue       # scrape_fleet already reports these
+        try:
+            v = json.loads(_http_get(port, f"/vars?window={window:g}",
+                                     timeout))
+            if v.get("kind") == _timeseries.SERIES_KIND:
+                vars_docs.append(v)
+            t = json.loads(_http_get(port, "/topk", timeout))
+            if t.get("kind") == _attribution.TOPK_KIND \
+                    and not t.get("disabled"):
+                topk_docs.append(t)
+        except (OSError, ValueError) as e:
+            errors.append(f"member rank={rank} port={port} usage: "
+                          f"{e!r}")
+    vars_merged = (_timeseries.merge_vars(vars_docs)
+                   if vars_docs else None)
+    topk_merged = (_attribution.merge_topk(topk_docs)
+                   if topk_docs else None)
+    return vars_merged, topk_merged, errors
+
+
 def _load(path: str):
-    """Autodetect artifact type → ("snapshot"|"trace"|"events", data)."""
+    """Autodetect artifact type → ("snapshot"|"series"|"seriesdump"|
+    "topk"|"trace"|"events", data)."""
     with open(path) as f:
         head = f.read(1 << 20)
     stripped = head.lstrip()
@@ -435,9 +642,16 @@ def _load(path: str):
             doc = json.loads(head)
         except ValueError:
             doc = None
-        if isinstance(doc, dict) and doc.get("kind") == \
-                _metrics.SNAPSHOT_KIND:
-            return "snapshot", doc
+        if isinstance(doc, dict):
+            kind = doc.get("kind")
+            if kind == _metrics.SNAPSHOT_KIND:
+                return "snapshot", doc
+            if kind == _timeseries.SERIES_KIND:
+                return "series", doc
+            if kind == _timeseries.DUMP_KIND:
+                return "seriesdump", doc
+            if kind == _attribution.TOPK_KIND:
+                return "topk", doc
     records = _trace.read_trace(path)
     if records and all("metric" in r for r in records):
         return "events", records
@@ -477,6 +691,13 @@ def main(argv=None) -> int:
                    help="with --fleet: also write the merged "
                         "fleet-total metrics snapshot (mvtpu.metrics.v1"
                         " JSON — bench_diff readable) to OUT")
+    p.add_argument("--window", type=float, default=30.0, metavar="S",
+                   help="with --fleet: trailing window (seconds) for "
+                        "the merged /vars scrape (default 30)")
+    p.add_argument("--vars-out", default=None, metavar="OUT",
+                   help="with --fleet: also write the merged windowed "
+                        "series doc (mvtpu.series.v1 JSON — bench_diff"
+                        " readable) to OUT")
     args = p.parse_args(argv)
 
     def write_chrome(records: List[dict]) -> None:
@@ -510,12 +731,25 @@ def main(argv=None) -> int:
         elif args.top:
             print(render_top("trace", records, args.top))
         else:
+            fleet_vars, fleet_topk, uerrors = scrape_usage(
+                args.path, args.window)
+            for err in uerrors:
+                print(f"fleet scrape: {err}", file=sys.stderr)
+            if args.vars_out and fleet_vars is not None:
+                with open(args.vars_out, "w") as f:
+                    json.dump(fleet_vars, f)
+                print(f"wrote fleet windowed series doc to "
+                      f"{args.vars_out}", file=sys.stderr)
             out = [render_trace(records)]
             decisions = render_decisions(records)
             if decisions:
                 out.append(decisions)
             if snap is not None:
                 out.append(render_snapshot(snap))
+            if fleet_vars is not None:
+                out.append(render_series(fleet_vars))
+            if fleet_topk is not None:
+                out.append(render_topk(fleet_topk))
             print("\n\n".join(out))
         return 0
 
@@ -535,7 +769,14 @@ def main(argv=None) -> int:
         print(render_health(data))
         return 0
     if args.top:
-        print(render_top(kind, data, args.top))
+        if kind == "topk":
+            print(render_topk(data, args.top))
+        elif kind in ("series", "seriesdump"):
+            print(f"--top is not meaningful for a {kind} document",
+                  file=sys.stderr)
+            return 2
+        else:
+            print(render_top(kind, data, args.top))
         return 0
     if args.prometheus:
         if kind != "snapshot":
@@ -546,6 +787,12 @@ def main(argv=None) -> int:
         return 0
     if kind == "snapshot":
         print(render_snapshot(data))
+    elif kind == "series":
+        print(render_series(data))
+    elif kind == "seriesdump":
+        print(render_series_dump(data))
+    elif kind == "topk":
+        print(render_topk(data))
     elif kind == "events":
         print(render_metric_events(data))
     else:

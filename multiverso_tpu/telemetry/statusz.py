@@ -278,7 +278,8 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 if path == "/":
                     body = ("mvtpu statusz — endpoints: /metrics "
                             "(?fleet=1), /healthz, /statusz "
-                            "(?fleet=1), /trace, /control (POST)\n")
+                            "(?fleet=1), /trace, /vars (?window=30), "
+                            "/topk, /control (POST)\n")
                     self._reply(200, body.encode(), "text/plain")
                     return
                 if "fleet=1" in query.split("&"):
@@ -324,6 +325,34 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 })
             elif path == "/trace":
                 self._reply(200, _trace_tail(), "application/jsonl")
+            elif path == "/vars":
+                # windowed metrics history (timeseries rings). Take a
+                # fresh sample first so the window's leading edge is
+                # NOW, not the last sampler tick.
+                from multiverso_tpu.telemetry import (timeseries
+                                                      as _ts)
+                window = 30.0
+                for kv in query.split("&"):
+                    k, _, v = kv.partition("=")
+                    if k == "window":
+                        try:
+                            window = max(float(v), 0.001)
+                        except ValueError:
+                            pass
+                st = _ts.store()
+                st.sample()
+                self._reply_json(200, st.vars_doc(window))
+            elif path == "/topk":
+                from multiverso_tpu.telemetry import (attribution
+                                                      as _attr)
+                plane = _attr.plane()
+                if plane is None:
+                    self._reply_json(200, {
+                        "kind": _attr.TOPK_KIND, "ts": time.time(),
+                        "pid": os.getpid(), "disabled": True,
+                        "k": 0, "dims": {}, "heat": {}})
+                    return
+                self._reply_json(200, plane.topk_doc())
             else:
                 self._reply(404, b"not found\n", "text/plain")
         except (BrokenPipeError, ConnectionResetError):
@@ -507,5 +536,14 @@ def maybe_statusz() -> Optional[StatuszServer]:
                             f"{e!r}; server disabled")
             return None
         _watchdog._warn(f"statusz: serving on port {_SERVER.port} "
-                        f"(/metrics /healthz /statusz /trace)")
+                        f"(/metrics /healthz /statusz /trace /vars "
+                        f"/topk)")
+        try:
+            # an introspection port without history answers half the
+            # questions: arm the time-series sampler alongside
+            # (MVTPU_TS_EVERY=0 still vetoes)
+            from multiverso_tpu.telemetry import timeseries as _ts
+            _ts.maybe_sampler(default_on=True)
+        except Exception:       # noqa: BLE001 — statusz never raises
+            pass
         return _SERVER

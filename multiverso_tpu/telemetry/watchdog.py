@@ -14,7 +14,9 @@ a wedged one. This module closes that gap:
   1. **warn**  — one loud stderr line (always),
   2. **dump**  — write a post-mortem directory under ``MVTPU_DUMP_DIR``:
      all-thread stacks (``faulthandler``), the metrics registry
-     snapshot, the tail of the active span trace, and a manifest,
+     snapshot, the tail of the active span trace, the trailing ~60s of
+     every metric series (``series.json``, report-renderable), and a
+     manifest,
   3. **kill** — after dumping, ``os._exit(SELF_TERMINATE_RC)`` so a
      wedged process dies fast with its diagnostics on disk instead of
      hanging into a driver timeout that leaves nothing.
@@ -301,10 +303,28 @@ class Watchdog:
             except OSError as e:
                 _warn(f"watchdog: trace tail failed: {e!r}")
 
-        # 4. manifest — ties the artifacts to who/when/why, and names
+        import json
+
+        # 4. the trailing ~60s of every metric as renderable series
+        # (when the timeseries module is loaded and has history) — the
+        # dump finally carries what the metrics were DOING on the way
+        # down, not just their final cumulative values
+        series_file = None
+        tseries = _sibling("timeseries")
+        if tseries is not None:
+            try:
+                doc = tseries.store().dump_doc(window=60.0)
+                if doc.get("series"):
+                    with open(os.path.join(path, "series.json"),
+                              "w") as f:
+                        json.dump(doc, f)
+                    series_file = "series.json"
+            except Exception as e:
+                _warn(f"watchdog: series dump failed: {e!r}")
+
+        # 5. manifest — ties the artifacts to who/when/why, and names
         # the restart point: the latest good run checkpoint (when the
         # ft subsystem is loaded — sys.modules lookup, never an import)
-        import json
         latest_ckpt = None
         ft_ckpt = sys.modules.get("multiverso_tpu.ft.checkpoint")
         if ft_ckpt is not None:
@@ -373,6 +393,7 @@ class Watchdog:
                 "health": health_status,
                 "slow_requests": slow_requests,
                 "control_decisions": control_decisions,
+                "series_file": series_file,
             }, f, indent=1)
         # keep-K retention AFTER the new dump lands: the artifact being
         # written right now must never be the one pruned away

@@ -1,0 +1,375 @@
+"""Windowed time-series history (ISSUE 17): ring decimation +
+coarsening retention math, interval-delta windowed statistics checked
+against brute force over the raw observations, the birth-baseline rule
+for series younger than one window, ``vars_doc``/``merge_vars``
+fleet semantics, the ``/vars`` endpoint over real HTTP, and the
+controller's windowed-term grammar (``rate(c)@30s``, ``h.p99@30s``).
+
+Everything drives :class:`timeseries.SeriesStore` with explicit
+snapshots and timestamps — no sleeping, no sampler thread — so the
+retention math is asserted exactly.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from multiverso_tpu.control import controller as ctl
+from multiverso_tpu.telemetry import metrics, statusz, timeseries
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    metrics.registry().reset()
+    timeseries._reset_for_tests()
+    yield
+    metrics.registry().reset()
+    timeseries._reset_for_tests()
+
+
+def snap(counters=None, gauges=None, hists=None, ts=None):
+    d = {"counters": counters or {}, "gauges": gauges or {},
+         "histograms": hists or {}}
+    if ts is not None:
+        d["ts"] = ts
+    return d
+
+
+def hist_state(bounds, counts, total=None):
+    return {"bounds": list(bounds), "counts": list(counts),
+            "count": sum(counts) if total is None else total,
+            "sum": 0.0}
+
+
+# -- ring decimation + coarsening retention --------------------------------
+
+
+class TestRing:
+    def test_last_sample_per_resolution_bucket_wins(self):
+        r = timeseries._Ring(resolution=1.0, cap=8)
+        r.push(10.0, 1.0)
+        r.push(10.4, 2.0)       # same 1s bucket: replaces
+        r.push(10.9, 3.0)       # still the same bucket
+        r.push(11.1, 4.0)       # next bucket
+        assert r.items() == [(10.9, 3.0), (11.1, 4.0)]
+
+    def test_capacity_evicts_oldest(self):
+        r = timeseries._Ring(resolution=1.0, cap=3)
+        for i in range(5):
+            r.push(float(i), float(i))
+        assert r.items() == [(2.0, 2.0), (3.0, 3.0), (4.0, 4.0)]
+
+    def test_coarse_tier_decimates(self):
+        r = timeseries._Ring(resolution=10.0, cap=4)
+        for i in range(25):
+            r.push(float(i), float(i))
+        # one (the last) sample per 10s bucket: 9, 19, 24
+        assert r.items() == [(9.0, 9.0), (19.0, 19.0), (24.0, 24.0)]
+
+
+class TestSeriesRetention:
+    def test_pyramid_keeps_recent_fine_and_old_coarse(self):
+        tiers = ((1.0, 5), (10.0, 6), (60.0, 4))
+        s = timeseries.Series("counter", tiers=tiers)
+        for i in range(100):                   # 100s at 1 Hz
+            s.push(float(i), float(i))
+        pts = dict(s.points())
+        # the fine tier still holds the last 5 seconds exactly
+        for t in (95.0, 96.0, 97.0, 98.0, 99.0):
+            assert pts[t] == t
+        # older history survives only at 10s resolution
+        assert 49.0 in pts and 59.0 in pts
+        assert 48.0 not in pts
+        # total retention is bounded by the tier capacities
+        assert len(pts) <= 5 + 6 + 4
+
+    def test_points_window_cut(self):
+        s = timeseries.Series("gauge", tiers=((1.0, 50),))
+        for i in range(20):
+            s.push(float(i), float(i))
+        pts = s.points(window=5.0, now=19.0)
+        assert [t for t, _ in pts] == [14.0, 15.0, 16.0, 17.0, 18.0,
+                                       19.0]
+
+    def test_at_or_before_falls_back_to_oldest(self):
+        s = timeseries.Series("counter", tiers=((1.0, 4),))
+        for i in (10, 11, 12, 13):
+            s.push(float(i), float(i))
+        assert s.at_or_before(11.5) == (11.0, 11.0)
+        # request older than retention: the oldest retained sample is
+        # the honest (shorter-window) answer
+        assert s.at_or_before(3.0) == (10.0, 10.0)
+
+
+# -- windowed statistics ---------------------------------------------------
+
+
+class TestWindowedStats:
+    def test_rate_and_delta_are_interval_deltas(self):
+        st = timeseries.SeriesStore()
+        for t, v in ((0.0, 0.0), (10.0, 100.0), (20.0, 400.0)):
+            st.sample(snap(counters={"server.ops": v}), ts=t)
+        assert st.delta("server.ops", 10.0, now=20.0) == 300.0
+        assert st.rate("server.ops", 10.0, now=20.0) == 30.0
+        # wider window than retention: interval from the oldest sample
+        assert st.delta("server.ops", 500.0, now=20.0) == 400.0
+
+    def test_counter_reset_clamps_to_zero(self):
+        st = timeseries.SeriesStore()
+        st.sample(snap(counters={"c": 100.0}), ts=0.0)
+        st.sample(snap(counters={"c": 5.0}), ts=10.0)   # restart
+        assert st.delta("c", 10.0, now=10.0) == 0.0
+        assert st.rate("c", 10.0, now=10.0) == 0.0
+
+    def test_single_sample_has_no_window(self):
+        st = timeseries.SeriesStore()
+        st.sample(snap(counters={"c": 7.0}), ts=0.0)
+        assert st.rate("c", 30.0, now=0.0) is None
+        assert st.quantile("h", 0.99, 30.0) is None
+
+    def test_windowed_quantile_vs_brute_force(self):
+        bounds = (0.001, 0.01, 0.1, 1.0, 10.0)
+        h = metrics.histogram("ts.lat", bounds=bounds)
+        st = timeseries.SeriesStore()
+
+        def push(ts):
+            hs = metrics.registry().snapshot()["histograms"]["ts.lat"]
+            st.sample(snap(hists={"ts.lat": hs}), ts=ts)
+
+        old = [0.005] * 50          # before the window: all fast
+        for v in old:
+            h.observe(v)
+        push(0.0)
+        new = [0.5] * 20 + [0.05] * 20      # inside the window
+        for v in new:
+            h.observe(v)
+        push(30.0)
+
+        for q in (0.5, 0.9, 0.99):
+            got = st.quantile("ts.lat", q, window=30.0, now=30.0)
+            # exactness vs the interval counts fed through the shared
+            # interpolation (what "brute force over the window" means
+            # once values are bucketized)
+            iv = st.hist_window("ts.lat", 30.0, now=30.0)
+            assert iv["count"] == len(new)
+            want = metrics.quantile_from_counts(
+                iv["bounds"], iv["counts"], iv["count"], q)
+            assert got == pytest.approx(want)
+            # and the bucket holding the true quantile brackets it
+            new.sort()
+            true = new[min(int(q * len(new)), len(new) - 1)]
+            b = next(i for i, ub in enumerate(bounds) if true <= ub)
+            lo = bounds[b - 1] if b else 0.0
+            assert lo <= got <= bounds[b]
+
+    def test_windowed_quantile_ignores_pre_window_mass(self):
+        bounds = (0.01, 0.1, 1.0)
+        st = timeseries.SeriesStore()
+        st.sample(snap(hists={"h": hist_state(bounds, [1000, 0, 0])}),
+                  ts=0.0)
+        st.sample(snap(hists={"h": hist_state(bounds, [1000, 0, 9])}),
+                  ts=60.0)
+        # lifetime p50 would sit in the first bucket; the window holds
+        # ONLY the 9 slow observations
+        assert st.quantile("h", 0.5, window=30.0, now=60.0) > 0.1
+
+    def test_birth_baseline_gives_young_series_a_left_edge(self):
+        st = timeseries.SeriesStore()
+        st.sample(snap(counters={"old": 5.0}), ts=0.0)
+        # "young" appears fully formed on the second tick: everything
+        # it has accumulated belongs to the gap since the previous
+        # tick, so windowed stats must see it
+        st.sample(snap(counters={"old": 6.0, "young": 42.0}), ts=1.0)
+        assert st.delta("young", 30.0, now=1.0) == 42.0
+        assert st.rate("young", 30.0, now=1.0) == pytest.approx(42.0)
+        bounds = (1.0, 10.0)
+        st.sample(snap(hists={"h": hist_state(bounds, [3, 1])}),
+                  ts=2.0)
+        assert st.quantile("h", 0.5, window=30.0, now=2.0) is not None
+
+    def test_no_baseline_on_first_ever_tick(self):
+        st = timeseries.SeriesStore()
+        st.sample(snap(counters={"c": 9.0}), ts=5.0)
+        # nothing to anchor the gap against: no synthetic history
+        assert st.delta("c", 30.0, now=5.0) is None
+
+    def test_max_keys_drops_not_raises(self):
+        st = timeseries.SeriesStore()
+        st.sample(snap(counters={f"k{i}": 1.0
+                                 for i in range(timeseries.MAX_KEYS
+                                                + 10)}), ts=0.0)
+        assert st.dropped_keys >= 10
+
+
+# -- documents + fleet merge -----------------------------------------------
+
+
+class TestVarsDoc:
+    def _store(self, scale=1.0):
+        st = timeseries.SeriesStore()
+        bounds = (0.01, 0.1, 1.0)
+        st.sample(snap(counters={"server.ops": 0.0},
+                       gauges={"q": 1.0 * scale},
+                       hists={"lat": hist_state(bounds, [0, 0, 0])}),
+                  ts=0.0)
+        st.sample(snap(counters={"server.ops": 300.0 * scale},
+                       gauges={"q": 2.0 * scale},
+                       hists={"lat": hist_state(bounds,
+                                                [90, 10, 0])}),
+                  ts=30.0)
+        return st
+
+    def test_vars_doc_shape(self):
+        doc = self._store().vars_doc(window=30.0, now=30.0)
+        assert doc["kind"] == timeseries.SERIES_KIND
+        assert doc["rates"]["server.ops"] == pytest.approx(10.0)
+        assert doc["deltas"]["server.ops"] == 300.0
+        assert doc["gauges"]["q"] == 2.0
+        h = doc["histograms"]["lat"]
+        assert h["count"] == 100 and h["p99"] is not None
+
+    def test_merge_vars_adds_rates_maxes_gauges_pools_hists(self):
+        a = self._store(1.0).vars_doc(window=30.0, now=30.0)
+        b = self._store(2.0).vars_doc(window=30.0, now=30.0)
+        m = timeseries.merge_vars([a, b])
+        assert m["kind"] == timeseries.SERIES_KIND
+        assert m["rates"]["server.ops"] == pytest.approx(30.0)
+        assert m["deltas"]["server.ops"] == 900.0
+        assert m["gauges"]["q"] == 4.0
+        h = m["histograms"]["lat"]
+        assert h["count"] == 200
+        assert sum(h["counts"]) == 200
+        # pooled quantile recomputed from the summed interval buckets
+        assert h["p99"] == pytest.approx(
+            metrics.quantile_from_counts(h["bounds"], h["counts"],
+                                         h["count"], 0.99))
+
+    def test_dump_doc_renders_series(self):
+        st = self._store()
+        doc = st.dump_doc(window=60.0)
+        assert doc["kind"] == timeseries.DUMP_KIND
+        keys = set(doc["series"])
+        assert "counter:server.ops" in keys
+        assert any(k.startswith("hist:") for k in keys)
+
+
+# -- /vars over real HTTP --------------------------------------------------
+
+
+class TestVarsEndpoint:
+    def test_vars_http(self):
+        st = timeseries.store()
+        bounds = (0.01, 0.1, 1.0)
+        st.sample(snap(counters={"server.ops": 0.0},
+                       hists={"lat": hist_state(bounds, [0, 0, 0])}),
+                  ts=0.0)
+        st.sample(snap(counters={"server.ops": 120.0},
+                       hists={"lat": hist_state(bounds, [50, 5, 0])}),
+                  ts=30.0)
+        srv = statusz.StatuszServer(0).start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/vars?window=3600",
+                    timeout=10) as r:
+                assert r.status == 200
+                doc = json.loads(r.read())
+        finally:
+            srv.stop()
+        assert doc["kind"] == timeseries.SERIES_KIND
+        assert doc["rates"]["server.ops"] == pytest.approx(4.0)
+        assert doc["histograms"]["lat"]["p99"] is not None
+
+
+# -- sampler arming --------------------------------------------------------
+
+
+class TestSampler:
+    def test_env_zero_disables(self, monkeypatch):
+        monkeypatch.setenv("MVTPU_TS_EVERY", "0")
+        assert timeseries.maybe_sampler(default_on=True) is None
+
+    def test_unset_defaults_off_unless_asked(self, monkeypatch):
+        monkeypatch.delenv("MVTPU_TS_EVERY", raising=False)
+        assert timeseries.maybe_sampler() is None
+        s = timeseries.maybe_sampler(default_on=True)
+        assert s is not None
+        assert timeseries.maybe_sampler(default_on=True) is s  # idem
+
+
+# -- controller windowed-term grammar --------------------------------------
+
+
+class TestWindowedGrammar:
+    def test_rate_term_parses_and_fires(self):
+        objs = ctl.parse_objectives(
+            "rate(server.ops)@30s < 50 -> server.fuse+")
+        rule = objs[0].rule
+        assert isinstance(rule, ctl.WindowedRule)
+        assert (rule.form, rule.metric, rule.window_s) \
+            == ("rate", "server.ops", 30.0)
+        # labeled series SUM: 2 x 100 ops over 1s = 200/s > 50
+        s0 = snap(counters={"server.ops{server=a}": 0.0,
+                            "server.ops{server=b}": 0.0}, ts=0.0)
+        s1 = snap(counters={"server.ops{server=a}": 100.0,
+                            "server.ops{server=b}": 100.0}, ts=1.0)
+        assert objs[0].evaluate(s0) == (False, None)    # no window yet
+        violated, ev = objs[0].evaluate(s1)
+        assert violated and ev["value"] == pytest.approx(200.0)
+        assert ev["stat"] == "rate" and ev["window_s"] == 30.0
+
+    def test_hist_quantile_term_worst_series(self):
+        objs = ctl.parse_objectives(
+            "lat.p99@30s < 5ms -> server.fuse+")
+        bounds = (0.001, 0.01, 0.1)
+        fast = hist_state(bounds, [100, 0, 0])
+        slow0 = hist_state(bounds, [0, 0, 0])
+        slow1 = hist_state(bounds, [0, 0, 100])
+        assert objs[0].evaluate(
+            snap(hists={"lat{s=a}": fast, "lat{s=b}": slow0},
+                 ts=0.0)) == (False, None)
+        violated, ev = objs[0].evaluate(
+            snap(hists={"lat{s=a}": fast, "lat{s=b}": slow1},
+                 ts=10.0))
+        assert violated and ev["metric"] == "lat{s=b}"
+        assert ev["value"] > 0.005
+
+    def test_windowed_rule_recovers_when_window_drains(self):
+        objs = ctl.parse_objectives(
+            "rate(c)@10s < 5 -> server.fuse+")
+        objs[0].evaluate(snap(counters={"c": 0.0}, ts=0.0))
+        assert objs[0].evaluate(
+            snap(counters={"c": 100.0}, ts=10.0))[0]
+        # traffic stops: the same lifetime total, rate falls under
+        for t in (20.0, 30.0):
+            violated, _ = objs[0].evaluate(
+                snap(counters={"c": 100.0}, ts=t))
+        assert not violated
+
+    def test_private_store_no_cross_talk(self):
+        a = ctl.parse_objectives("rate(c)@10s < 5 -> server.fuse+")[0]
+        b = ctl.parse_objectives("rate(c)@10s < 5 -> server.fuse+")[0]
+        a.evaluate(snap(counters={"c": 0.0}, ts=0.0))
+        a.evaluate(snap(counters={"c": 100.0}, ts=10.0))
+        # b never observed anything: still no window
+        assert b.evaluate(snap(counters={"c": 100.0}, ts=10.0)) \
+            == (False, None)
+
+    @pytest.mark.parametrize("spec", [
+        "rate(server.ops)@bogus < 50 -> server.fuse+",
+        "rate(server.ops)@-5s < 50 -> server.fuse+",
+        "rate()@30s < 50 -> server.fuse+",
+        "lat.p42@30s < 5ms -> server.fuse+",
+        "lat@30s < 5ms -> server.fuse+",
+    ])
+    def test_malformed_windowed_terms_raise(self, spec):
+        with pytest.raises(ValueError):
+            ctl.parse_objectives(spec)
+
+    def test_cumulative_clauses_still_parse(self):
+        objs = ctl.parse_objectives(
+            "storage.miss_ratio < 0.5 -> server.fuse+; "
+            "rate(c)@30s < 5 -> server.fuse+")
+        assert len(objs) == 2
+        assert not isinstance(objs[0].rule, ctl.WindowedRule)
+        assert isinstance(objs[1].rule, ctl.WindowedRule)

@@ -15,7 +15,14 @@ autodetects each side:
   ``hist_mean_s:...``).
 
 - a client-pipeline micro-bench line (``client_bench.json`` from
-  ``benchmarks/client_pipeline.py`` — same flat metric-line shape).
+  ``benchmarks/client_pipeline.py`` — same flat metric-line shape),
+
+- a windowed-series doc (a ``/vars?window=`` capture or the merged
+  fleet doc ``report --fleet --vars-out`` writes,
+  ``kind == "mvtpu.series.v1"`` — counter rates become ``rate:...``
+  / ``delta:...`` keys, gauges ``gauge:...``, windowed histogram
+  quantiles ``win_p99_s:...`` etc.), so a CI gate can diff "ops/s
+  over the last 30 seconds" instead of lifetime cumulative counts.
 
 Prints every shared numeric key with old/new/delta%, plus keys present
 on only one side. Exit status is the CI contract: 0 when every watched
@@ -42,6 +49,7 @@ import sys
 from typing import Dict, List, Tuple
 
 SNAPSHOT_KIND = "mvtpu.metrics.v1"
+SERIES_KIND = "mvtpu.series.v1"
 DEFAULT_WATCH = ("value", "e2e_words_per_sec", "lda_doc_tokens_per_sec",
                  # client-pipeline micro-bench (benchmarks/
                  # client_pipeline.py): the coalesced-add and cached-get
@@ -98,6 +106,11 @@ DEFAULT_WATCH = ("value", "e2e_words_per_sec", "lda_doc_tokens_per_sec",
                  # a drop here means distributed tracing stopped being
                  # cheap enough to leave on
                  "serving_mp_traced_ops_per_sec",
+                 # attribution lane (serving_mp): add throughput with
+                 # the heavy-hitter accounting plane ON — a drop means
+                 # usage attribution stopped being cheap enough to
+                 # leave on in the dispatch loop
+                 "serving_mp_attributed_ops_per_sec",
                  # autotune lane (serving.py --autotune): protected
                  # throughput AFTER the controller converges a mistuned
                  # server — a drop means the closed loop stopped
@@ -157,6 +170,21 @@ def load_metrics(path: str) -> Dict[str, float]:
             if h.get("count"):
                 out[f"hist_mean_s:{k}"] = h["sum"] / h["count"]
                 out[f"hist_count:{k}"] = float(h["count"])
+        return out
+    if doc.get("kind") == SERIES_KIND:
+        out = {}
+        for k, v in doc.get("rates", {}).items():
+            out[f"rate:{k}"] = float(v)
+        for k, v in doc.get("deltas", {}).items():
+            out[f"delta:{k}"] = float(v)
+        for k, v in doc.get("gauges", {}).items():
+            out[f"gauge:{k}"] = float(v)
+        for k, h in doc.get("histograms", {}).items():
+            if h.get("count"):
+                out[f"win_count:{k}"] = float(h["count"])
+                for q in ("p50", "p99", "p999"):
+                    if h.get(q) is not None:
+                        out[f"win_{q}_s:{k}"] = float(h[q])
         return out
     if "parsed" in doc:                       # driver trajectory capture
         parsed = doc.get("parsed")
@@ -527,6 +555,52 @@ def selftest() -> int:
         at_doc2["autotune_decisions"] = 35.0
         assert main([at_old, put("at_base.json", at_doc2)]) == 0, \
             "the mistuned floor and decision count ride unwatched"
+        # attribution lane: the attributed ops/s is watched — a
+        # collapse means the accounting sketches got expensive, while
+        # the unattributed twin and the ratio ride along unwatched
+        ab_old = put("ab_old.json", {
+            "metric": "wire_mb_per_sec", "value": 10.0,
+            "unit": "MiB/s", "wire_mb_per_sec": 10.0,
+            "serving_mp_attributed_ops_per_sec": 4900.0,
+            "serving_mp_unattributed_ops_per_sec": 5000.0,
+            "serving_mp_attr_ratio": 0.98})
+        ab_doc = json.loads(json.dumps(json.load(open(ab_old))))
+        ab_doc["serving_mp_attributed_ops_per_sec"] = 1500.0  # -69%
+        ab_doc["serving_mp_attr_ratio"] = 0.3
+        assert main([ab_old, put("ab_bad.json", ab_doc)]) == 1, \
+            "attributed ops/s drop must fail (accounting got expensive)"
+        ab_doc2 = json.loads(json.dumps(json.load(open(ab_old))))
+        ab_doc2["serving_mp_unattributed_ops_per_sec"] = 900.0
+        assert main([ab_old, put("ab_base.json", ab_doc2)]) == 0, \
+            "the unattributed twin rides along unwatched"
+        # windowed-series docs (/vars?window= captures): rates,
+        # gauges, and windowed quantiles flatten with their own
+        # prefixes and diff like any snapshot
+        sr = {"kind": SERIES_KIND, "window": 30.0,
+              "rates": {"server.ops{server=a}": 120.0},
+              "deltas": {"server.ops{server=a}": 3600.0},
+              "gauges": {"queue.depth{worker=0}": 4.0},
+              "histograms": {"server.latency.seconds": {
+                  "bounds": [0.001, 0.01], "counts": [50, 5, 0],
+                  "count": 55, "sum": 0.2, "p50": 0.0006,
+                  "p99": 0.009, "p999": None}}}
+        sr2 = json.loads(json.dumps(sr))
+        sr2["rates"]["server.ops{server=a}"] = 30.0        # -75%
+        sr_old = put("sr_old.json", sr)
+        sr_new = put("sr_new.json", sr2)
+        m = load_metrics(sr_old)
+        assert m["rate:server.ops{server=a}"] == 120.0
+        assert m["win_p99_s:server.latency.seconds"] == 0.009
+        assert "win_p999_s:server.latency.seconds" not in m, \
+            "a None quantile must not flatten"
+        assert main([sr_old, sr_new]) == 0, \
+            "unwatched windowed rate drop rides along"
+        assert main([sr_old, sr_new, "--watch",
+                     "rate:server.ops{server=a}"]) == 1, \
+            "watched windowed rate regression must fail"
+        assert main([sr_old, sr_new, "--watch-lower",
+                     "win_p99_s:server.latency.seconds"]) == 0, \
+            "an unchanged windowed p99 passes a lower-is-better watch"
         # unusable inputs exit 2, not a traceback
         hung = put("hung.json", {"rc": 124, "tail": "...", "parsed": None})
         assert main([hung, raw_ok]) == 2, "no parsed line -> exit 2"

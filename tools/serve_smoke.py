@@ -107,6 +107,36 @@ def fleet_smoke() -> None:
         check(got.tobytes() == np.ones(64, np.float32).tobytes(),
               "scatter-gather get over the fleet is bit-exact")
 
+        # keep a trickle of ops flowing for ~2.5s so each member's
+        # 1 Hz series sampler brackets the traffic, then scrape the
+        # usage plane off every member's statusz
+        for _ in range(5):
+            t.get()
+            time.sleep(0.5)
+        for m in doc["members"]:
+            sport_m = m["statusz_port"]
+            code, body = fetch(sport_m, "/vars?window=30")
+            vdoc = json.loads(body)
+            disp = next(
+                (h for k, h in vdoc.get("histograms", {}).items()
+                 if k.partition("{")[0] == "wire.dispatch.seconds"
+                 and h.get("p99") is not None), None)
+            check(code == 200
+                  and vdoc.get("kind") == "mvtpu.series.v1"
+                  and disp is not None,
+                  f"member rank {m.get('rank')} /vars has windowed "
+                  f"dispatch p99 ({disp})")
+            code, body = fetch(sport_m, "/topk")
+            tdoc = json.loads(body)
+            ops_top = (tdoc.get("dims", {}).get("ops", {})
+                       .get("top", []))
+            check(code == 200
+                  and tdoc.get("kind") == "mvtpu.topk.v1"
+                  and any(e.get("client", "").startswith("smoke")
+                          for e in ops_top),
+                  f"member rank {m.get('rank')} /topk names the smoke "
+                  f"client ({[e.get('client') for e in ops_top]})")
+
         sport = doc["members"][0]["statusz_port"]
         code, body = fetch(sport, "/statusz?fleet=1")
         fdoc = json.loads(body)
@@ -206,6 +236,23 @@ def main() -> int:
     check(len(linked) > 0,
           f"some request links >= 2 span kinds "
           f"(e.g. {sorted(by_req.get(linked[0], []))[:4] if linked else []})")
+
+    code, body = fetch(port, "/vars?window=120")
+    vdoc = json.loads(body)
+    check(code == 200 and vdoc.get("kind") == "mvtpu.series.v1",
+          "/vars serves the windowed series document")
+    lat = next((h for k, h in vdoc.get("histograms", {}).items()
+                if k.partition("{")[0] == "serving.latency.seconds"
+                and h.get("p99") is not None), None)
+    check(lat is not None,
+          f"/vars windowed serving.latency p99 present "
+          f"(p99={lat.get('p99') if lat else None})")
+
+    code, body = fetch(port, "/topk")
+    tdoc = json.loads(body)
+    check(code == 200 and tdoc.get("kind") == "mvtpu.topk.v1"
+          and set(tdoc.get("dims", {})) >= {"ops", "bytes"},
+          "/topk serves the attribution document with ops/bytes dims")
 
     import urllib.error
     try:
