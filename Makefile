@@ -68,6 +68,17 @@
 #                   the surviving shard serving; emits
 #                   serving_mp_fleet.json — a partial line on every
 #                   give-up path
+#   make replica-smoke - replicated-shard smoke: one rank with a
+#                   delta-streamed follower (--replicas 2); asserts
+#                   1-bit adds replicate at quantized cost (bytes
+#                   ratio >= 2x vs full-precision sync), follower-
+#                   routed staleness reads >= 1.5x the primary-pinned
+#                   baseline under the same write storm with both
+#                   finals bit-exact, and a SIGKILLed primary fails
+#                   over (map v2, window replayed exactly once, every
+#                   range serving, final bit-exact); emits
+#                   serving_mp_replica.json — a partial line on every
+#                   give-up path
 #   make trace-smoke - distributed-tracing smoke: a real 2-member
 #                   fleet + a traced client fleet get, then a
 #                   telemetry.report --fleet scrape-merge; asserts one
@@ -100,8 +111,8 @@ NEW ?= BENCH_r05.json
 
 .PHONY: test dryrun bench bench-dryrun bench-diff bench-diff-selftest \
 	client-bench ckpt-bench kernel-bench tier-bench serve-smoke \
-	mp-smoke flood-smoke fleet-smoke trace-smoke health-smoke \
-	autotune-smoke chaos fuzz lint native ci
+	mp-smoke flood-smoke fleet-smoke replica-smoke trace-smoke \
+	health-smoke autotune-smoke chaos fuzz lint native ci
 
 fuzz:
 	$(PY) tests/deep_fuzz.py
@@ -144,6 +155,9 @@ flood-smoke:
 
 fleet-smoke:
 	MVTPU_SERVING_MP_TINY=1 $(PY) benchmarks/serving_mp.py --servers 2
+
+replica-smoke:
+	MVTPU_SERVING_MP_TINY=1 $(PY) benchmarks/serving_mp.py --replicas
 
 trace-smoke:
 	$(PY) tools/trace_smoke.py
@@ -189,5 +203,5 @@ native:
 
 ci: lint bench-diff-selftest native test dryrun bench-dryrun \
 	client-bench ckpt-bench kernel-bench tier-bench serve-smoke \
-	mp-smoke flood-smoke fleet-smoke trace-smoke health-smoke \
-	autotune-smoke chaos
+	mp-smoke flood-smoke fleet-smoke replica-smoke trace-smoke \
+	health-smoke autotune-smoke chaos

@@ -199,6 +199,14 @@ def _tiered_storm() -> dict:
 # must converge and settle with a decision count no worse than the
 # cumulative form, which keeps firing on the never-forgotten starved
 # samples.
+#
+# The ISSUE-18 extension (phase D) is the phase-change re-track: after
+# the windowed objective settles, the protected workload flips from
+# read-heavy (sync gets) to write-heavy (sync adds) and an operator
+# re-mistunes the live knobs through the knob table. The SAME
+# controller — never reset, same windowed store, same histogram — must
+# observe the new phase's starvation (the old phase's samples age out
+# of the @1s window) and re-converge within the same 10% gate.
 
 AUTOTUNE = dict(table_n=256, window_ops=40, window_s=0.35, rounds=30,
                 settle=2, flood_threads=2, flood_pipeline=8,
@@ -207,19 +215,24 @@ if TINY:
     AUTOTUNE.update(window_ops=24, window_s=0.25)
 
 
-def _autotune_window(t, hist=None) -> tuple:
-    """One measurement window of sync protected gets: (ops/s, p99_s).
+def _autotune_window(t, hist=None, op=None) -> tuple:
+    """One measurement window of sync protected ops: (ops/s, p99_s).
     Ops are serialized — a starved token bucket or a fuse-crippled
     dispatch loop shows up directly in both numbers. ``hist`` (a
     telemetry histogram) additionally receives every raw latency, so
     a windowed controller term can judge the actual distribution
-    instead of a hand-maintained per-window gauge."""
+    instead of a hand-maintained per-window gauge. ``op`` is one
+    protected operation (default: a sync get — the read-heavy phase);
+    the re-track phase passes a sync add to flip the workload
+    write-heavy."""
     a = AUTOTUNE
+    if op is None:
+        op = lambda: np.asarray(t.get())    # noqa: E731
     lats = []
     t0 = time.perf_counter()
     while len(lats) < a["window_ops"]:
         s0 = time.perf_counter()
-        np.asarray(t.get())
+        op()
         lats.append(time.perf_counter() - s0)
         if hist is not None:
             hist.observe(lats[-1])
@@ -463,6 +476,63 @@ def _autotune_lane() -> dict:
                         settled = 0
                 conv_w = [_autotune_window(t, lat_hist)
                           for _ in range(5)]
+
+                # phase D — phase change: the SAME controller (no
+                # reset, same windowed store, same histogram) must
+                # re-track after the protected workload flips from
+                # read-heavy (sync gets) to write-heavy (sync adds)
+                # AND an operator re-mistunes the live knobs. The
+                # windowed @1s term forgets the read phase's samples
+                # as they age out, so it observes the new starvation
+                # and re-ratchets; a cumulative form would judge the
+                # new phase through the old phase's lifetime totals.
+                wdelta = np.ones(a["table_n"], np.float32)
+
+                def wop():
+                    t.add(wdelta, sync=True)
+
+                # write-heavy reference: the converged knobs ARE the
+                # hand-tuned point for this phase (reads and writes
+                # share the dispatch queue, so "good" is the same)
+                ref_wr = [_autotune_window(t, lat_hist, op=wop)
+                          for _ in range(3)]
+                ref_w_ops = sorted(s[0] for s in ref_wr)[1]
+                ref_w_p99 = sorted(s[1] for s in ref_wr)[1]
+                # the write phase has its own intrinsic latency (a
+                # sync add is not a sync get) — the settle bound is
+                # derived from the write reference exactly the way
+                # phase A derived ``bound_ms`` from the read one, and
+                # never tighter than the objective's own bound
+                bound_d_ms = max(4.0 * ref_w_p99 * 1e3, bound_ms)
+                # live re-mistune, through the same knob table the
+                # controller actuates — not a server restart
+                ctl_mod.knobs.set("server.fuse", 1, label="autow")
+                ctl_mod.knobs.set("server.qos.rate",
+                                  a["starved_rate"],
+                                  label="autow:train")
+                mist_d_ops, mist_d_p99 = _autotune_window(
+                    t, lat_hist, op=wop)
+                decisions_d = 0
+                rounds_d = 0
+                settled_d = False
+                settled = 0
+                while rounds_d < a["rounds"]:
+                    rounds_d += 1
+                    ops, p99 = _autotune_window(t, lat_hist, op=wop)
+                    telemetry.gauge("autotune.win.slowdown").set(
+                        round(ref_w_ops / max(ops, 1e-9), 6))
+                    snap_box["snap"] = telemetry.registry().snapshot()
+                    moved = ctl_w.check_once()
+                    decisions_d += len(moved)
+                    if not moved and p99 * 1e3 <= bound_d_ms:
+                        settled += 1
+                        if settled >= a["settle"]:
+                            settled_d = True
+                            break
+                    else:
+                        settled = 0
+                conv_d = [_autotune_window(t, lat_hist, op=wop)
+                          for _ in range(3)]
         finally:
             stop_w.set()
             for f in floods_w:
@@ -472,6 +542,8 @@ def _autotune_lane() -> dict:
                              + "; ".join(errors_w))
         conv_ops_w = max(s[0] for s in conv_w)
         conv_p99_w = sorted(s[1] for s in conv_w)[len(conv_w) // 2]
+        conv_d_ops = max(s[0] for s in conv_d)
+        conv_d_p99 = sorted(s[1] for s in conv_d)[len(conv_d) // 2]
         knobs_w = ctl_mod.knobs.current()
         fuse_w = knobs_w.get("server.fuse", {}).get("autow", 1)
         rate_w = knobs_w.get("server.qos.rate", {}) \
@@ -482,6 +554,7 @@ def _autotune_lane() -> dict:
 
     frac = conv_ops / hand_ops
     frac_w = conv_ops_w / hand_ops
+    frac_d = conv_d_ops / max(ref_w_ops, 1e-9)
     ring = [e for e in ctl_mod.recent_decisions()
             if e.get("origin") == "local"]
     line = {
@@ -510,6 +583,15 @@ def _autotune_lane() -> dict:
         "autotune_windowed_rounds": rounds_w,
         "autotune_windowed_final_fuse": fuse_w,
         "autotune_windowed_final_train_rate": round(float(rate_w), 3),
+        "autotune_retrack_ops_per_sec": round(conv_d_ops, 2),
+        "autotune_retrack_ref_ops_per_sec": round(ref_w_ops, 2),
+        "autotune_retrack_mistuned_ops_per_sec": round(mist_d_ops, 2),
+        "autotune_retrack_frac": round(frac_d, 4),
+        "autotune_retrack_p99_ms": round(conv_d_p99 * 1e3, 3),
+        "autotune_retrack_p99_bound_ms": round(bound_d_ms, 3),
+        "autotune_retrack_mistuned_p99_ms": round(mist_d_p99 * 1e3, 3),
+        "autotune_retrack_decisions": decisions_d,
+        "autotune_retrack_rounds": rounds_d,
     }
     # the acceptance gates — a lane that doesn't converge FAILS (the
     # line goes to stderr first so a failing run is diagnosable)
@@ -548,6 +630,23 @@ def _autotune_lane() -> dict:
     assert shadow_fired_last, \
         "autotune: cumulative shadow was not firing at settle — " \
         "the windowed/cumulative comparison is vacuous"
+    # phase-change re-track gates: the flip + live re-mistune must
+    # actually bite, and the SAME controller (never reset) must bring
+    # the write-heavy protected class back within the same 10% gate
+    assert mist_d_ops < ref_w_ops * 0.7, \
+        f"autotune: phase-change re-mistune didn't bite " \
+        f"({mist_d_ops:.0f} vs {ref_w_ops:.0f} ops/s)"
+    assert decisions_d > 0, \
+        "autotune: controller never re-acted after the phase change"
+    assert settled_d, \
+        f"autotune: windowed objective never re-settled after the " \
+        f"phase change ({rounds_d} rounds)"
+    assert conv_d_p99 * 1e3 <= bound_d_ms, \
+        f"autotune: re-tracked write p99 {conv_d_p99 * 1e3:.1f}ms " \
+        f"over the {bound_d_ms:.1f}ms bound"
+    assert frac_d >= 0.9, \
+        f"autotune: re-tracked at {frac_d:.2f}x of the write-heavy " \
+        f"reference ({conv_d_ops:.0f} vs {ref_w_ops:.0f} ops/s)"
     return line
 
 

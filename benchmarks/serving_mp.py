@@ -107,11 +107,39 @@ only its own partition — the surviving shard still serves
 bit-exactly. Emits ``serving_fleet_ops_per_sec`` and
 ``fleet_scaling_efficiency`` with the same partial-JSON give-up
 contract as the flood lane.
+
+``--replicas`` runs the REPLICATED-SHARD lane instead (``make
+replica-smoke``): one rank with ``--replicas 2`` (a primary streaming
+applied deltas to a follower), measured three ways. (1) Bytes ratio:
+1-bit-quantized adds must replicate at quantized cost — the tap
+forwards the ORIGINAL encoded frames, so the repl wire beats
+full-precision sync by ≥ ``MVTPU_REPLICA_BYTES_RATIO`` (default 2.0).
+(2) Read scaling: a continuous pipelined write storm (parent
+process, sliding in-flight window so the backlog never drains or
+grows unbounded) runs while jax-free reader processes do tight-bound
+staleness reads pinned to the primary (off lane) then the follower
+(on lane), alternating median-of-N passes. The fleet runs unfused
+(``--fuse 1``, the server default) so the generation advances per
+applied add: a primary snapshot miss pays the whole barrier-laden
+write queue, while the follower is within bound for every acked
+write (the tap's sync-before-ack barrier) and serves off its
+reader-thread snapshot — follower-routed reads must win by ≥
+``MVTPU_REPLICA_RATIO`` (default 1.5), with BOTH finals bit-exact
+against the per-thread storm write counts (primary bytes ==
+follower bytes). (3) Failover: on a
+2-rank R=2 fleet, SIGKILL the rank-0 primary mid-write-storm; the
+router promotes the follower (map v→v+1), replays the unacked window
+exactly once, every range keeps serving, and the final is bit-exact
+— zero acked-or-issued writes lost. Emits
+``replica_read_ops_per_sec`` and ``replication_bytes_ratio``
+(``serving_mp_replica.json`` / ``MVTPU_REPLICA_BENCH_JSON``) with the
+same partial-JSON give-up contract as the flood lane.
 """
 
 from __future__ import annotations
 
 import argparse
+import collections
 import json
 import os
 import signal
@@ -217,6 +245,33 @@ FLEET_RATIO = float(os.environ.get("MVTPU_FLEET_RATIO", "") or 1.5)
 # bounded-staleness reads, and correctness is gated on the final
 # fresh get() instead
 FLEET_STALENESS = 1 << 20
+
+# replica lane (--replicas) geometry. staleness=0 (read-my-acked-
+# writes freshness) is the point: under the fully-pipelined write
+# storm the PRIMARY's in-process snapshot replica is perpetually >= 1
+# generation behind (the snapshot is async, one D2H in flight at a
+# time), so primary-routed reads miss onto the dispatch queue BEHIND
+# the storm's fused write cycles — while the cross-process follower
+# can ALWAYS serve bound 0 for acked writes: the tap's sync-before-ack
+# barrier means every acked frame is applied on the follower before
+# the writer sees the ack, and the follower's lag reference advances
+# at intake on the strict-FIFO control lane. The measured ratio is
+# that read/write isolation, on the same tables — not multi-core
+# parallelism (it holds on one core).
+REPL = ({"size": 1 << 15, "reads": 40, "read_threads": 2,
+         "workers": 2, "staleness": 0, "write_every": 16,
+         "quiet_adds": 4, "storm_adds": 96, "passes": 3,
+         "storm_threads": 2, "storm_window": 48,
+         "kill_after": 24, "quant_adds": 6}
+        if TINY else
+        {"size": 1 << 16, "reads": 80, "read_threads": 2,
+         "workers": 2, "staleness": 0, "write_every": 16,
+         "quiet_adds": 4, "storm_adds": 192, "passes": 3,
+         "storm_threads": 2, "storm_window": 48,
+         "kill_after": 48, "quant_adds": 8})
+REPLICA_RATIO = float(os.environ.get("MVTPU_REPLICA_RATIO", "") or 1.5)
+REPLICA_BYTES_RATIO = float(
+    os.environ.get("MVTPU_REPLICA_BYTES_RATIO", "") or 2.0)
 
 
 def _load_transport():
@@ -493,6 +548,74 @@ def run_fleet_worker(fleet_file: str, lane: str, rank: int,
            "range": [lo, hi], "servers": fc.n,
            "tx_bytes": fc.tx_bytes, "rx_bytes": fc.rx_bytes,
            "transport": fc.clients[0].transport}
+    fc.close()
+    print(json.dumps(out), flush=True)
+
+
+def repl_delta(rank: int) -> np.ndarray:
+    """Integer-grid delta for the replica lane (same exactness
+    argument as :func:`fleet_delta`, sized to REPL geometry)."""
+    size = REPL["size"]
+    return ((np.arange(size) % 7) + 1 + rank).astype(np.float32)
+
+
+def run_replica_worker(fleet_file: str, lane: str, rank: int,
+                       workers: int) -> None:
+    """One jax-free replica-lane worker: ``read_threads`` closed-loop
+    readers doing tight-bound staleness reads. The write storm lives
+    in the PARENT process (see ``_replica_read_lanes``) so reader GIL
+    activity here can never starve the writers — readers and writers
+    are different processes, the honest shape of a serving fleet. The
+    lane name picks the routing: ``...-on`` readers pin the follower
+    (``read_replica=1``), ``...-off`` readers pin the primary
+    (``read_replica=0``) — same fleet, same tables, same storm.
+    Reports the read window under the ops-lane keys."""
+    router = _load_router()
+    assert "jax" not in sys.modules, \
+        "worker process imported jax — the jax-free contract is broken"
+    router.transport._chaos.chaos_from_env()
+    pick = 1 if lane.endswith("-on") else 0
+
+    fc = router.connect_fleet_file(fleet_file, client=f"{lane}-w{rank}",
+                                   quant=None, read_replica=0)
+    fc.create_array("w_repl", REPL["size"], updater="default")
+
+    # rendezvous (lane-suffixed barrier table: each lane re-gathers)
+    bar = fc.create_array(f"repl_bar_{lane}", max(workers, fc.n),
+                          updater="default")
+    mark = np.zeros(max(workers, fc.n), np.float32)
+    mark[rank] = 1.0
+    bar.add(mark, sync=True)
+    while not (bar.get()[:workers] > 0).all():
+        time.sleep(0.005)
+
+    def read_lane(i: int) -> None:
+        c = router.connect_fleet_file(
+            fleet_file, client=f"{lane}-w{rank}-r{i}", quant=None,
+            read_replica=pick)
+        t = c.create_array("w_repl", REPL["size"], updater="default")
+        got = None
+        for _ in range(2):      # warm: arm replicas + connections
+            got = t.get(staleness=REPL["staleness"])
+        for _ in range(REPL["reads"]):
+            got = t.get(staleness=REPL["staleness"])
+        assert got is not None and got.shape == (REPL["size"],), \
+            f"replica read returned {None if got is None else got.shape}"
+        c.close()
+
+    lanes = [threading.Thread(target=read_lane, args=(i,))
+             for i in range(REPL["read_threads"])]
+    t0 = time.perf_counter()
+    for th in lanes:
+        th.start()
+    for th in lanes:
+        th.join()
+    window = time.perf_counter() - t0
+    reads = REPL["reads"] * REPL["read_threads"]
+    out = {"rank": rank, "lane": lane, "adds": reads,
+           "add_wall_s": window, "reads": reads,
+           "writes": 0, "servers": fc.n,
+           "tx_bytes": fc.tx_bytes, "rx_bytes": fc.rx_bytes}
     fc.close()
     print(json.dumps(out), flush=True)
 
@@ -903,10 +1026,16 @@ def _emit_fleet(line: Dict[str, object]) -> None:
     print(json.dumps(line), flush=True)
 
 
-def _start_fleet(tmpdir: str, tag: str, n: int):
-    """Spawn ``python -m multiverso_tpu.server --fleet n`` and wait
-    for its fleet file (written atomically once every member is up).
-    Returns (launcher proc, fleet file path, parsed fleet doc)."""
+def _start_fleet(tmpdir: str, tag: str, n: int, replicas: int = 1,
+                 fuse: int = FUSE_K):
+    """Spawn ``python -m multiverso_tpu.server --fleet n [--replicas
+    R]`` and wait for its fleet file (written atomically once every
+    member AND follower is up). Returns (launcher proc, fleet file
+    path, parsed fleet doc). ``fuse`` defaults to the benchmark's
+    fused config; the replica lanes pass ``fuse=1`` (the server
+    default) so generations advance per applied add rather than per
+    ~100ms fused commit — bounded-staleness reads then exercise the
+    dispatch queue instead of almost always hitting a lag-0 snapshot."""
     fleet_file = os.path.join(tmpdir, f"fleet-{tag}.json")
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                PYTHONPATH=REPO + os.pathsep
@@ -916,9 +1045,11 @@ def _start_fleet(tmpdir: str, tag: str, n: int):
            "--address",
            "unix:" + os.path.join(tmpdir, f"fl-{tag}.sock"),
            "--name", f"fleet-{tag}", "--fleet-file", fleet_file,
-           "--fuse", str(FUSE_K)]
+           "--fuse", str(fuse)]
+    if replicas > 1:
+        cmd += ["--replicas", str(replicas)]
     proc = subprocess.Popen(cmd, env=env, cwd=REPO)
-    deadline = time.monotonic() + STARTUP_S * max(n, 1)
+    deadline = time.monotonic() + STARTUP_S * max(n * replicas, 1)
     while time.monotonic() < deadline:
         doc = None
         if os.path.exists(fleet_file):
@@ -927,7 +1058,9 @@ def _start_fleet(tmpdir: str, tag: str, n: int):
                     doc = json.load(f)
             except ValueError:
                 doc = None
-        if doc and len(doc.get("members", ())) == n:
+        if doc and len(doc.get("members", ())) == n \
+                and all(len(m.get("replicas", ())) == replicas - 1
+                        for m in doc["members"]):
             return proc, fleet_file, doc
         if proc.poll() is not None:
             raise SystemExit(
@@ -1084,6 +1217,287 @@ def fleet_main(n_servers: int) -> None:
     line["partial"] = False
     line.pop("fleet_stage", None)
     _emit_fleet(line)
+
+
+def _emit_repl(line: Dict[str, object]) -> None:
+    out = os.environ.get("MVTPU_REPLICA_BENCH_JSON",
+                         "serving_mp_replica.json")
+    with open(out, "w") as f:
+        json.dump(line, f, indent=1)
+    print(json.dumps(line), flush=True)
+
+
+def _repl_status(fc) -> dict:
+    """Rank-0 primary's replication tap counters (bytes on the repl
+    wire vs what a full-precision sync would have cost)."""
+    repl = fc.server_status()[0].get("replication") or {}
+    return {"bytes": int(repl.get("bytes") or 0),
+            "full_bytes": int(repl.get("full_bytes") or 0)}
+
+
+def _replica_bytes_probe(line: Dict[str, object], router,
+                         fleet_file: str) -> None:
+    """Phase A1: the tap forwards the ORIGINAL encoded frames, so a
+    1-bit-quantized write stream replicates at quantized cost — the
+    delta stream must beat full-precision sync by the bytes-ratio
+    gate (this is the 'delta-streamed' half of the tentpole claim)."""
+    fcq = router.connect_fleet_file(fleet_file, client="repl-bytes",
+                                    quant="1bit", seed=5,
+                                    read_replica=0)
+    tq = fcq.create_array("w_repl_q", REPL["size"], updater="default")
+    tq.add(np.zeros(REPL["size"], np.float32), sync=True)  # settle
+    before = _repl_status(fcq)
+    rng = np.random.default_rng(17)
+    for _ in range(REPL["quant_adds"]):
+        # sync adds: pipelined adds would FUSE on the primary, and a
+        # fused group forwards its pre-summed delta as raw fp32 —
+        # this probe measures the per-frame encoded-forwarding cost
+        tq.add(rng.standard_normal(REPL["size"]).astype(np.float32),
+               sync=True)
+    after = _repl_status(fcq)
+    fcq.close()
+    d_bytes = after["bytes"] - before["bytes"]
+    d_full = after["full_bytes"] - before["full_bytes"]
+    assert d_bytes > 0 and d_full > 0, \
+        f"replication tap counted no bytes ({before} -> {after}) — " \
+        "the quantized adds never hit the repl wire"
+    ratio = d_full / d_bytes
+    line["replication_bytes_ratio"] = round(ratio, 3)
+    assert ratio >= REPLICA_BYTES_RATIO, \
+        f"replication streamed {d_bytes} B for {d_full} B of state " \
+        f"({ratio:.2f}x), below the {REPLICA_BYTES_RATIO:g}x gate " \
+        "(MVTPU_REPLICA_BYTES_RATIO overrides) — the tap is " \
+        "re-encoding instead of forwarding encoded frames"
+
+
+def _replica_read_lanes(line: Dict[str, object], router,
+                        fleet_file: str) -> None:
+    """Phase A2: same fleet, same table, same write storm — readers
+    pinned to the primary (off) vs the follower (on). With the tight
+    staleness bound the primary's snapshot path misses under the
+    storm and bounded reads queue behind write frames; the follower
+    is always within bound for acked writes (sync-before-ack
+    barrier) and its queue carries only fused repl frames. The
+    speedup is read/write isolation, not parallelism — it holds on
+    one core. Two structural choices keep the measurement honest on
+    that one core: the storm runs in THIS process, not the reader
+    workers (readers hogging their GIL must not starve the writers —
+    that drains the primary's queue and hands its snapshot path the
+    reads the off lane is supposed to queue behind the storm), and
+    the lanes ALTERNATE off/on for ``REPL["passes"]`` rounds under
+    the one continuous storm with the gate comparing medians —
+    adjacent passes see the same machine."""
+    stop = threading.Event()
+    n_storm = REPL["storm_threads"]
+    n_writes = [0] * n_storm
+
+    def storm(j: int) -> None:
+        # pipelined with a SLIDING window, own connection per thread
+        # (independent pipelines — several independent writers is the
+        # honest shape of "write-heavy"). Unbounded pipelining decays
+        # (the un-acked backlog grows without bound and the storm
+        # slows pass over pass); a periodic full drain is worse (the
+        # queue empties, the snapshot catches up, and the off lane
+        # rides the fast path). Waiting only the OLDEST in-flight add
+        # once ``storm_window`` are outstanding keeps the dispatch
+        # queue at a steady depth with no drain points.
+        wc = router.connect_fleet_file(
+            fleet_file, client=f"repl-storm-{j}", quant=None,
+            read_replica=0)
+        wt = wc.create_array("w_repl", REPL["size"],
+                             updater="default")
+        delta = repl_delta(j)
+        inflight: "collections.deque" = collections.deque()
+        while not stop.is_set():
+            inflight.append(wt.add(delta))
+            n_writes[j] += 1
+            if len(inflight) >= REPL["storm_window"]:
+                inflight.popleft().wait()
+        wt.wait()               # every counted write is acked
+        wc.close()
+
+    writers = [threading.Thread(target=storm, args=(j,))
+               for j in range(n_storm)]
+    for th in writers:
+        th.start()
+    offs, ons = [], []
+    try:
+        for p in range(REPL["passes"]):
+            # pass-unique lane names keep the rendezvous barrier
+            # table fresh each pass (the -off/-on suffix picks the
+            # routing)
+            off = _run_lane(fleet_file, f"replica-p{p}-off", None,
+                            mode="replica", workers=REPL["workers"])
+            on = _run_lane(fleet_file, f"replica-p{p}-on", None,
+                           mode="replica", workers=REPL["workers"])
+            offs.append(float(off["ops_per_sec"]))
+            ons.append(float(on["ops_per_sec"]))
+    finally:
+        stop.set()
+        for th in writers:
+            th.join()
+    rate_off = sorted(offs)[len(offs) // 2]
+    rate_on = sorted(ons)[len(ons) // 2]
+    ratio = rate_on / max(rate_off, 1e-9)
+    line.update({
+        "value": round(rate_on, 1),
+        "replica_read_ops_per_sec": round(rate_on, 1),
+        "replica_baseline_ops_per_sec": round(rate_off, 1),
+        "replica_read_speedup": round(ratio, 3),
+        "replica_read_passes": REPL["passes"],
+        "replica_off_passes": [round(x, 1) for x in offs],
+        "replica_on_passes": [round(x, 1) for x in ons],
+        "replica_workers": REPL["workers"],
+        "replica_read_threads": REPL["read_threads"],
+        "replica_staleness": REPL["staleness"],
+    })
+
+    # bit-exactness: the storm threads wrote the one shared table;
+    # the integer-grid final must match their exact write counts, on
+    # the primary AND via a follower-routed bounded read (every
+    # counted write was acked => replicated).
+    expected = np.zeros(REPL["size"], np.float32)
+    for j in range(n_storm):
+        expected += n_writes[j] * repl_delta(j)
+    pri = router.connect_fleet_file(fleet_file, client="repl-score-p",
+                                    quant=None, read_replica=0)
+    tp = pri.create_array("w_repl", REPL["size"], updater="default")
+    via_pri = tp.get()
+    fol = router.connect_fleet_file(fleet_file, client="repl-score-f",
+                                    quant=None, read_replica=1)
+    tf = fol.create_array("w_repl", REPL["size"], updater="default")
+    via_fol = tf.get(staleness=0)
+    pri.close()
+    fol.close()
+    assert via_pri.tobytes() == expected.tobytes(), \
+        "primary final != exact integer-grid expectation — a storm " \
+        "write was lost or double-applied"
+    assert via_fol.tobytes() == via_pri.tobytes(), \
+        "follower-routed read != primary bytes — the delta stream " \
+        "diverged"
+    assert ratio >= REPLICA_RATIO, \
+        f"follower-routed reads served {rate_on:.1f}/s vs " \
+        f"{rate_off:.1f}/s on the primary — {ratio:.2f}x, below the " \
+        f"{REPLICA_RATIO:g}x gate (MVTPU_REPLICA_RATIO overrides)"
+
+
+def _replica_failover(line: Dict[str, object], router,
+                      tmpdir: str) -> None:
+    """Phase B: SIGKILL the rank-0 primary mid-write-storm on a
+    2-rank R=2 fleet. The router must promote the follower (map
+    v -> v+1), replay the unacked window exactly once, and keep
+    serving every range — the final table is bit-exact against the
+    analytic write count, i.e. zero acked-or-issued writes lost."""
+    saved = {k: os.environ.get(k) for k in
+             ("MVTPU_RETRY_ATTEMPTS", "MVTPU_RETRY_DEADLINE_S")}
+    os.environ["MVTPU_RETRY_ATTEMPTS"] = "3"
+    os.environ["MVTPU_RETRY_DEADLINE_S"] = "2"
+    proc, fleet_file, doc = _start_fleet(tmpdir, "repl-fo", 2,
+                                         replicas=2)
+    try:
+        line["repl_stage"] = "failover-quiet"
+        fc = router.connect_fleet_file(fleet_file, client="repl-fo-w",
+                                       quant=None, read_replica=0)
+        t = fc.create_array("w_fo", REPL["size"], updater="default")
+        d = repl_delta(0)
+        n = 0
+        for _ in range(REPL["quiet_adds"]):
+            t.add(d, sync=True)
+            n += 1
+        line["repl_stage"] = "failover-storm"
+        for i in range(REPL["storm_adds"]):
+            t.add(d)
+            n += 1
+            if i == REPL["kill_after"]:
+                os.kill(int(doc["members"][0]["pid"]), signal.SIGKILL)
+            if n % REPL["write_every"] == 0:
+                t.wait()        # may land mid-failover: guard path
+        t.wait()
+        line["repl_stage"] = "failover-score"
+        assert fc.pmap.version == 2, \
+            f"router never adopted the promoted map " \
+            f"(version {fc.pmap.version})"
+        final = t.get()
+        assert final.tobytes() == (n * d).tobytes(), \
+            f"final after SIGKILL failover != {n} x delta — an acked " \
+            "write was lost or the replay window double-applied"
+        # every range still serves, shard by shard
+        bounds = fc.pmap.dense_bounds(REPL["size"])
+        for r in range(2):
+            shard = t.get_shard(r).get()
+            assert shard.tobytes() == \
+                final[bounds[r]:bounds[r + 1]].tobytes(), \
+                f"rank {r} range dark or corrupt after failover"
+        # the promoted ex-follower reports its new role
+        repl0 = fc.server_status()[0].get("replication") or {}
+        assert repl0.get("role") == "primary", repl0
+        fc.close()
+        # the rewritten fleet file arms FUTURE clients with the v2 map
+        fc2 = router.connect_fleet_file(fleet_file,
+                                        client="repl-fo-late",
+                                        quant=None, read_replica=0)
+        assert fc2.pmap.version == 2, \
+            "fleet file on disk still claims the pre-failover map"
+        t2 = fc2.create_array("w_fo", REPL["size"], updater="default")
+        t2.add(d, sync=True)    # the promoted primary takes writes
+        assert t2.get().tobytes() == ((n + 1) * d).tobytes()
+        fc2.close()
+        line.update({
+            "failover_map_version": 2,
+            "failover_writes": n + 1,
+            "failover_kill_after": REPL["kill_after"],
+        })
+    finally:
+        _stop_server(proc)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _replica_run(line: Dict[str, object]) -> None:
+    """The replica scenario body; fills ``line`` incrementally so a
+    give-up at any stage still has every field measured so far."""
+    router = _load_router()
+    with tempfile.TemporaryDirectory(prefix="mvtpu_repl_") as tmpdir:
+        line["repl_stage"] = "start"
+        proc, fleet_file, _doc = _start_fleet(tmpdir, "repl", 1,
+                                              replicas=2, fuse=1)
+        try:
+            line["repl_stage"] = "bytes-ratio"
+            _replica_bytes_probe(line, router, fleet_file)
+            line["repl_stage"] = "read-lanes"
+            _replica_read_lanes(line, router, fleet_file)
+        finally:
+            _stop_server(proc)
+        _replica_failover(line, router, tmpdir)
+
+
+def replica_main() -> None:
+    """``--replicas``: the replicated-shard lane. R=2 follower-routed
+    read throughput vs primary-pinned baseline on the same fleet
+    (bit-exact both ways), the delta-stream bytes-ratio gate, and the
+    SIGKILL-primary failover gate. Same partial-JSON contract as the
+    flood/fleet lanes."""
+    line: Dict[str, object] = {
+        "metric": "replica_read_ops_per_sec",
+        "value": -1.0,          # -1 = not measured (partial give-up)
+        "unit": "ops/s",
+        "tiny": TINY,
+        "partial": True,
+        "replica_ratio_gate": REPLICA_RATIO,
+        "replica_bytes_ratio_gate": REPLICA_BYTES_RATIO,
+    }
+    try:
+        _replica_run(line)
+    except BaseException as e:
+        line["giveup"] = f"{type(e).__name__}: {e}"
+        _emit_repl(line)
+        raise
+    line["partial"] = False
+    line.pop("repl_stage", None)
+    _emit_repl(line)
 
 
 def main() -> None:
@@ -1338,11 +1752,16 @@ if __name__ == "__main__":
                         help="run the sharded-fleet scaling lane: N "
                              "partitioned servers vs the implicit "
                              "single-server baseline")
+    parser.add_argument("--replicas", action="store_true",
+                        help="run the replicated-shard lane: "
+                             "follower-routed reads vs the primary "
+                             "baseline, plus the SIGKILL-primary "
+                             "failover gate")
     parser.add_argument("--address")
     parser.add_argument("--lane", default="dense")
     parser.add_argument("--mode", default="train",
                         choices=("train", "ops", "prot", "flood",
-                                 "fleet"))
+                                 "fleet", "replica"))
     parser.add_argument("--rank", type=int, default=0)
     parser.add_argument("--workers", type=int, default=N_WORKERS)
     parser.add_argument("--quant", default=None)
@@ -1361,6 +1780,10 @@ if __name__ == "__main__":
             # --address carries the fleet FILE, not a dial string
             run_fleet_worker(args.address, args.lane, args.rank,
                              args.workers)
+        elif args.mode == "replica":
+            # --address carries the fleet FILE, not a dial string
+            run_replica_worker(args.address, args.lane, args.rank,
+                               args.workers)
         else:
             run_worker(args.address, args.lane, args.rank,
                        args.workers, args.quant)
@@ -1368,5 +1791,7 @@ if __name__ == "__main__":
         flood_main()
     elif args.servers:
         fleet_main(args.servers)
+    elif args.replicas:
+        replica_main()
     else:
         main()

@@ -43,6 +43,32 @@ one client's standard reconnect/replay loop and resume exactly-once
 when the member returns. ``get_shard(rank)`` exposes the per-rank
 subtable for exactly that kind of surviving-partition work.
 
+Replicated ranks (``--replicas R``, ``server/replication.py``) add two
+client-side behaviours on top, both read-path-only by construction:
+
+* **Follower read routing.** When the PartitionMap carries ``replicas
+  > 1`` and the fleet file lists follower addresses, bounded-staleness
+  reads (``staleness=K``) are served by a STICKY replica pick —
+  ``crc32(client_id) % R`` so a worker fleet spreads itself across the
+  replica set while each worker keeps one warm connection — with
+  fallback to the primary when the follower refuses (lag past the
+  bound, structured ``stale`` refusal) or is unreachable. Unbounded
+  reads (``staleness=None``) and every mutation always go to the
+  primary; follower table ids are valid verbatim because followers
+  build tables from the primary's forced-tid replicated creates.
+
+* **Failover.** A shard call that exhausts its retry budget (dead
+  primary) or is hello-refused with a NEWER map (someone else already
+  failed over) triggers :meth:`FleetClient._recover`: re-read the
+  fleet file, ``promote`` the rank's first live follower (idempotent —
+  a second promote just reports the bumped map), adopt the v+1 map,
+  ``rebind`` the rank's WireClient at the successor (the unacked
+  pipeline window survives and replays — the follower's
+  origin-(client, rid) dedup records keep the replay exactly-once),
+  and broadcast ``adopt`` to the survivors so their next hellos are
+  not refused. In-flight mutations that already sat in the pending
+  window are NOT resubmitted — the rebind replay is their redelivery.
+
 jax-free and file-path loadable (:func:`load_router`) like the
 transport — this is worker-process code.
 """
@@ -51,6 +77,9 @@ from __future__ import annotations
 
 import os
 import sys
+import threading
+import time
+import zlib
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -86,6 +115,54 @@ _trace = _dep("multiverso_tpu.telemetry.trace", "telemetry",
               "trace.py")
 
 
+#: faults that mean "the peer may be gone", not "the request is bad":
+#: connection-level errors and an exhausted retry budget trigger the
+#: failover path; RemoteError (an application refusal) never does
+_DEAD = (ConnectionError, OSError, transport._retry.RetryError)
+#: a hello refusal — carries the server's CURRENT map on ``.header``
+_REFUSED = transport.wire.WireProtocolError
+#: how long a follower stays benched after a hard (transport) miss
+#: before reads probe it again
+_REPLICA_RETRY_S = 5.0
+
+
+def _count(name: str, n: float = 1, **labels) -> None:
+    m = sys.modules.get("multiverso_tpu.telemetry.metrics")
+    if m is not None:
+        try:
+            m.counter(name, **labels).inc(n)
+        except Exception:
+            pass
+
+
+def _pick_addr(addrs: Sequence[str],
+               scheme: Optional[str] = None) -> Optional[str]:
+    """First address, or the first matching ``scheme`` when given."""
+    addrs = list(addrs or [])
+    if not addrs:
+        return None
+    if scheme:
+        for a in addrs:
+            if a.split(":", 1)[0].rstrip("/") == scheme \
+                    or a.startswith(scheme + "://"):
+                return a
+    return addrs[0]
+
+
+def _clone_sub(sub: Any, client: "transport.WireClient") -> Any:
+    """A follower-facing twin of a primary subtable: same table id
+    (forced-tid replicated creates keep follower id spaces aligned),
+    same dtype/geometry, different connection."""
+    meta: Dict[str, Any] = {"table": sub.table_id, "name": sub.name,
+                            "kind": sub.kind,
+                            "dtype": np.dtype(sub.dtype).str}
+    if hasattr(sub, "value_dim"):
+        meta["value_dim"] = sub.value_dim
+        return transport.RemoteKVTable(client, meta)
+    meta["size"] = sub.size
+    return transport.RemoteArrayTable(client, meta)
+
+
 def load_router(package_dir: str):
     """File-path load this module (canonical name, no package import)
     from a bare worker script. ``package_dir`` is the
@@ -106,17 +183,29 @@ def load_router(package_dir: str):
 class FleetHandle:
     """Handle-compatible future over the per-shard handles of one
     logical mutation. ``done()``/``wait()`` quantify over every shard
-    the op actually touched."""
+    the op actually touched. When built by a fleet table the wait path
+    runs through the fleet's failover guard, so waiting out a window
+    that straddles a primary death completes against the promoted
+    follower instead of raising."""
 
-    def __init__(self, handles: Sequence[Any]) -> None:
+    def __init__(self, handles: Sequence[Any],
+                 fleet: Optional["FleetClient"] = None,
+                 ranks: Optional[Sequence[int]] = None) -> None:
         self._handles = list(handles)
+        self._fleet = fleet
+        self._ranks = list(ranks) if ranks is not None \
+            else list(range(len(self._handles)))
 
     def done(self) -> bool:
         return all(h.done() for h in self._handles)
 
     def wait(self) -> None:
-        for h in self._handles:
-            h.wait()
+        if self._fleet is None:
+            for h in self._handles:
+                h.wait()
+            return
+        for rank, h in zip(self._ranks, self._handles):
+            self._fleet._guard_wait(rank, h)
 
     def result(self) -> None:
         return self.wait()
@@ -153,8 +242,34 @@ class _FleetTable:
             buf.flush()
 
     def wait(self) -> None:
-        for sub in self.subs:
-            sub.wait()
+        for rank in range(len(self.subs)):
+            self.fleet._guard_drain(rank)
+
+    def _shard_get(self, rank: int, *args: Any,
+                   staleness: Optional[int] = None) -> Any:
+        """One shard's read, replica-routed: try the sticky follower
+        when the read is bounded-staleness and the rank has one, fall
+        back to the primary on a structured ``stale`` refusal (lag past
+        the bound) or any transport fault — a lagging or dead follower
+        costs one extra hop, never an error. The primary leg runs under
+        the failover guard."""
+        fleet = self.fleet
+        rsub = fleet._replica_sub(self, rank, staleness)
+        if rsub is not None:
+            try:
+                out = rsub.get(*args, staleness=staleness)
+                fleet._replica_served(rank)
+                return out
+            except transport.RemoteError as exc:
+                header = getattr(exc, "header", None) or {}
+                if not (header.get("stale") or header.get("follower")):
+                    raise       # a real application error, not routing
+                fleet._replica_miss(rank, soft=True)
+            except (_REFUSED,) + _DEAD:
+                fleet._replica_miss(rank, soft=False)
+        return fleet._guard(
+            rank,
+            lambda: self.subs[rank].get(*args, staleness=staleness))
 
 
 class FleetArrayTable(_FleetTable):
@@ -175,8 +290,8 @@ class FleetArrayTable(_FleetTable):
         zero-index-math payoff of contiguous ownership)."""
         with _trace.request("fleet.get", table=self.name):
             parts = self.fleet._fanout(
-                [lambda s=s: s.get(staleness=staleness)
-                 for s in self.subs])
+                [lambda r=r: self._shard_get(r, staleness=staleness)
+                 for r in range(len(self.subs))])
             return np.concatenate(parts)
 
     def get_range(self, lo: int, hi: int,
@@ -196,7 +311,7 @@ class FleetArrayTable(_FleetTable):
         with _trace.request("fleet.get_range", table=self.name,
                             lo=lo, hi=hi):
             parts = self.fleet._fanout(
-                [lambda s=self.subs[r]: s.get(staleness=staleness)
+                [lambda r=r: self._shard_get(r, staleness=staleness)
                  for r in ranks])
         if len(parts) == 1:
             r = ranks[0]
@@ -215,9 +330,13 @@ class FleetArrayTable(_FleetTable):
                 f"({self.size},), got {delta.shape}")
         b = self._bounds
         with _trace.request("fleet.add", table=self.name):
-            handles = [sub.add(delta[b[r]:b[r + 1]], option)
-                       for r, sub in enumerate(self.subs)]
-        handle = FleetHandle(handles)
+            handles = [
+                self.fleet._guard_add(
+                    r, lambda sub=sub, lo=b[r], hi=b[r + 1]:
+                    sub.add(delta[lo:hi], option))
+                for r, sub in enumerate(self.subs)]
+        handle = FleetHandle(handles, self.fleet,
+                             range(len(self.subs)))
         if sync:
             handle.wait()
         return handle
@@ -259,8 +378,8 @@ class FleetKVTable(_FleetTable):
         routed = self._route(keys)
         with _trace.request("fleet.kv_get", table=self.name):
             replies = self.fleet._fanout(
-                [lambda r=r, idx=idx: self.subs[r].get(
-                    keys[idx], staleness=staleness)
+                [lambda r=r, idx=idx: self._shard_get(
+                    r, keys[idx], staleness=staleness)
                  for r, idx in routed])
         for (r, idx), (vals, fnd) in zip(routed, replies):
             values[idx] = vals
@@ -276,6 +395,7 @@ class FleetKVTable(_FleetTable):
         keys = np.ascontiguousarray(np.asarray(keys, np.uint64))
         deltas = np.asarray(deltas, self.dtype)
         handles = []
+        ranks = []
         with _trace.request("fleet.kv_add", table=self.name):
             for r, idx in self._route(keys):
                 sub_keys = keys[idx]
@@ -287,9 +407,11 @@ class FleetKVTable(_FleetTable):
                         sub_deltas.dtype)
                     np.add.at(acc, inv, sub_deltas)
                     sub_keys, sub_deltas = uniq, acc
-                handles.append(self.subs[r].add(sub_keys, sub_deltas,
-                                                option))
-        handle = FleetHandle(handles)
+                handles.append(self.fleet._guard_add(
+                    r, lambda r=r, k=sub_keys, d=sub_deltas:
+                    self.subs[r].add(k, d, option)))
+                ranks.append(r)
+        handle = FleetHandle(handles, self.fleet, ranks)
         if sync:
             handle.wait()
         return handle
@@ -305,23 +427,33 @@ class FleetClient:
                  pmap: Optional["partition.PartitionMap"] = None,
                  version: int = 1,
                  kv_buckets: Optional[int] = None,
+                 replicas: int = 1,
                  client: Optional[str] = None,
                  quant: Optional[str] = "env",
                  seed: Optional[int] = None,
-                 deadline_s="env") -> None:
+                 deadline_s="env",
+                 fleet_file: Optional[str] = None,
+                 scheme: Optional[str] = None,
+                 replica_addrs: Optional[
+                     Sequence[Sequence[str]]] = None,
+                 read_replica="env") -> None:
         addresses = list(addresses)
         if not addresses:
             raise ValueError("fleet needs at least one server address")
         if pmap is None:
             pmap = partition.PartitionMap(
-                len(addresses), version=version, kv_buckets=kv_buckets)
+                len(addresses), version=version, kv_buckets=kv_buckets,
+                replicas=replicas)
         if pmap.n != len(addresses):
             raise ValueError(
                 f"partition map is for {pmap.n} servers, got "
                 f"{len(addresses)} addresses")
         self.pmap = pmap
         self.client_id = client or f"pid{os.getpid()}"
-        claim = pmap.to_wire()
+        self._claim = pmap.to_wire()
+        self._deadline_s = deadline_s
+        self._fleet_file = fleet_file
+        self._scheme = scheme
         # one client per member: its OWN pipeline window, dedup stream,
         # residual store, and reconnect/replay loop — shard isolation
         # on the client side mirrors process isolation on the server's
@@ -329,10 +461,63 @@ class FleetClient:
             transport.WireClient(
                 addr, client=self.client_id, quant=quant,
                 seed=None if seed is None else int(seed) + rank,
-                deadline_s=deadline_s, partition=claim)
+                deadline_s=deadline_s, partition=self._claim)
             for rank, addr in enumerate(addresses)]
+        # ONE persistent pool per fleet client (never a thread per
+        # get): sub-requests outlive none of these workers, and the
+        # replica fallback is a second sequential hop on the same
+        # worker, so pmap.n workers cover every fan-out shape
         self._pool = ThreadPoolExecutor(
             max_workers=pmap.n, thread_name_prefix="mvtpu-fleet")
+        # -- replica read routing state --
+        # rank -> [follower addresses]; static override (tests) wins,
+        # else the launcher fleet file's per-member "replicas" rows
+        if replica_addrs is not None:
+            self._replica_addrs = [list(a) for a in replica_addrs]
+        else:
+            self._replica_addrs = self._load_replica_addrs()
+        self._replica_clients: Dict[int, Any] = {}
+        self._replica_subs: Dict[Tuple[int, int], Any] = {}
+        self._replica_down: Dict[int, float] = {}
+        self._rlock = threading.Lock()
+        self._folock = threading.Lock()
+        reads_on = os.environ.get(
+            "MVTPU_REPLICA_READS", "1").strip().lower() \
+            not in ("0", "false", "off", "no")
+        if read_replica == "env":
+            raw = os.environ.get("MVTPU_REPLICA_PICK", "").strip()
+            if raw:
+                pick = int(raw)
+            else:
+                # sticky per client: worker fleets hash themselves
+                # uniformly across the replica set (0 = primary)
+                pick = zlib.crc32(self.client_id.encode()) \
+                    % max(int(pmap.replicas), 1)
+        else:
+            pick = int(read_replica or 0)
+        self._replica_pick = pick if reads_on else 0
+
+    def _load_replica_addrs(self) -> List[List[str]]:
+        doc = partition.read_fleet_file(self._fleet_file) \
+            if self._fleet_file else None
+        if doc is None:
+            return [[] for _ in range(self.pmap.n)]
+        return self._replica_addrs_from(doc)
+
+    def _replica_addrs_from(self, doc: Dict[str, Any]
+                            ) -> List[List[str]]:
+        members = sorted(doc.get("members", []),
+                         key=lambda m: int(m.get("rank", 0)))
+        out: List[List[str]] = [[] for _ in range(self.pmap.n)]
+        for m in members:
+            rank = int(m.get("rank", 0))
+            if not 0 <= rank < self.pmap.n:
+                continue
+            for rep in (m.get("replicas") or []):
+                a = _pick_addr(rep.get("addresses"), self._scheme)
+                if a:
+                    out[rank].append(a)
+        return out
 
     def _fanout(self, thunks: Sequence[Any]) -> List[Any]:
         """Run per-server sub-requests concurrently; surface the first
@@ -356,6 +541,249 @@ class FleetClient:
                    for shard, t in enumerate(thunks)]
         return [f.result() for f in futures]
 
+    # -- replica read routing ----------------------------------------------
+
+    def _replica_sub(self, table: _FleetTable, rank: int,
+                     staleness: Optional[int]) -> Optional[Any]:
+        """The follower subtable a read on ``rank`` should try first,
+        or None when the read must go to the primary: unbounded reads
+        (a follower cannot serve read-your-writes honestly), a pick of
+        0 (this client is sticky-primary), no followers for the rank,
+        or a follower benched after a recent hard miss."""
+        if staleness is None or self._replica_pick <= 0:
+            return None
+        addrs = self._replica_addrs[rank] \
+            if rank < len(self._replica_addrs) else []
+        if not addrs:
+            return None
+        if time.monotonic() < self._replica_down.get(rank, 0.0):
+            return None
+        key = (id(table), rank)
+        sub = self._replica_subs.get(key)
+        if sub is not None:
+            return sub
+        with self._rlock:
+            sub = self._replica_subs.get(key)
+            if sub is not None:
+                return sub
+            c = self._replica_clients.get(rank)
+            if c is None:
+                idx = min(self._replica_pick, len(addrs)) - 1
+                try:
+                    c = transport.WireClient(
+                        addrs[idx], client=self.client_id,
+                        quant=None, deadline_s=self._deadline_s,
+                        partition=dict(self._claim))
+                except Exception:   # noqa: BLE001 — dead follower:
+                    # bench it, reads fall back to the primary
+                    self._replica_down[rank] = \
+                        time.monotonic() + _REPLICA_RETRY_S
+                    _count("fleet.replica.down", rank=rank)
+                    return None
+                self._replica_clients[rank] = c
+            sub = _clone_sub(table.subs[rank], c)
+            self._replica_subs[key] = sub
+            return sub
+
+    def _replica_served(self, rank: int) -> None:
+        _count("fleet.replica.reads", rank=rank)
+
+    def _replica_miss(self, rank: int, *, soft: bool) -> None:
+        """A follower read that fell back to the primary. Soft (stale
+        refusal) keeps the connection — lag is transient; hard
+        (transport fault) benches the follower and drops its client so
+        the next probe redials."""
+        _count("fleet.replica.fallbacks", rank=rank,
+               kind="stale" if soft else "down")
+        if soft:
+            return
+        with self._rlock:
+            c = self._replica_clients.pop(rank, None)
+            for key in [k for k in self._replica_subs
+                        if k[1] == rank]:
+                self._replica_subs.pop(key, None)
+            self._replica_down[rank] = \
+                time.monotonic() + _REPLICA_RETRY_S
+        if c is not None:
+            try:
+                c.abort()
+            except Exception:   # noqa: BLE001 — already dead
+                pass
+
+    # -- failover ----------------------------------------------------------
+
+    def _guard(self, rank: int, thunk: Any) -> Any:
+        """Run a shard request; on a dead-peer fault or a newer-map
+        hello refusal, recover the rank (promotion or adoption) and
+        re-run it once. Application errors pass through untouched."""
+        try:
+            return thunk()
+        except (_REFUSED,) + _DEAD as exc:
+            if not self._recover(rank, exc):
+                raise
+            return thunk()
+
+    def _guard_add(self, rank: int, thunk: Any) -> Any:
+        """Failover guard for PIPELINED mutations. The failed submit's
+        frame already sits in the rank client's pending window, so
+        re-running the thunk would double-submit it under a fresh rid;
+        the rebind replay is the redelivery — hand back a handle over
+        the surviving window instead."""
+        try:
+            return thunk()
+        except (_REFUSED,) + _DEAD as exc:
+            if not self._recover(rank, exc):
+                raise
+            c = self.clients[rank]
+            rid = c._pending[-1].rid if c._pending else c._acked_rid
+            return transport.RemoteHandle(c, rid)
+
+    def _guard_wait(self, rank: int, handle: Any) -> None:
+        try:
+            handle.wait()
+        except (_REFUSED,) + _DEAD as exc:
+            if not self._recover(rank, exc):
+                raise
+            handle.wait()
+
+    def _guard_drain(self, rank: int) -> None:
+        try:
+            self.clients[rank].drain()
+        except (_REFUSED,) + _DEAD as exc:
+            if not self._recover(rank, exc):
+                raise
+            self.clients[rank].drain()
+
+    def _recover(self, rank: int, exc: BaseException) -> bool:
+        """Client half of shard failover. Serialized: concurrent shard
+        threads that hit the same dead primary queue here, the first
+        one promotes, the rest find the map already bumped and just
+        re-run their request against the rebound client. Returns True
+        when the rank is routable again."""
+        with self._folock:
+            start_v = self.pmap.version
+            header = getattr(exc, "header", None) or {}
+            wmap = header.get("partition")
+            if isinstance(wmap, dict) \
+                    and int(wmap.get("version", 0)) > start_v:
+                # refused BECAUSE someone already failed over: the
+                # refusal carries the new map — adopt, no promote
+                return self._adopt_map(wmap, rank)
+            doc = partition.read_fleet_file(self._fleet_file) \
+                if self._fleet_file else None
+            if doc is not None:
+                dmap = doc.get("map") or {}
+                if int(dmap.get("version", 0)) > start_v:
+                    # another worker promoted and rewrote the file
+                    return self._adopt_map(dmap, rank, doc=doc)
+            if self.pmap.version > start_v:
+                return True     # a queued thread behind the promoter
+            addrs = self._follower_addrs(rank, doc)
+            if not addrs:
+                return False
+            for addr in addrs:
+                try:
+                    c = transport.WireClient(
+                        addr, client=self.client_id + ".fo",
+                        quant=None, deadline_s=None,
+                        partition=dict(self._claim))
+                except Exception:   # noqa: BLE001 — follower dead too
+                    continue
+                try:
+                    try:
+                        h, _ = c.call("promote")
+                    finally:
+                        try:
+                            c.abort()
+                        except Exception:   # noqa: BLE001
+                            pass
+                except _REFUSED as refusal:
+                    rh = getattr(refusal, "header", None) or {}
+                    wm = rh.get("partition")
+                    if isinstance(wm, dict) \
+                            and int(wm.get("version", 0)) > start_v:
+                        # the follower is ALREADY the new primary
+                        return self._adopt_map(wm, rank,
+                                               fallback=addr)
+                    continue
+                except _DEAD:
+                    continue
+                wm = h.get("partition")
+                if isinstance(wm, dict):
+                    return self._adopt_map(wm, rank, fallback=addr)
+            return False
+
+    def _follower_addrs(self, rank: int,
+                        doc: Optional[Dict[str, Any]]) -> List[str]:
+        if doc is not None:
+            fresh = self._replica_addrs_from(doc)
+            if rank < len(fresh) and fresh[rank]:
+                return fresh[rank]
+        return list(self._replica_addrs[rank]) \
+            if rank < len(self._replica_addrs) else []
+
+    def _adopt_map(self, wmap: Dict[str, Any], rank: int,
+                   doc: Optional[Dict[str, Any]] = None,
+                   fallback: Optional[str] = None) -> bool:
+        """Swing the fleet onto a newer map: rebind the dead rank's
+        client at its successor (pending window replays there), point
+        every future hello at the new claim, and best-effort broadcast
+        ``adopt`` so survivors bump before their next refused hello."""
+        new = partition.PartitionMap.from_wire(wmap)
+        if new.version <= self.pmap.version:
+            return True     # lost a race to an even newer adoption
+        claim = new.to_wire()
+        addr = fallback
+        if self._fleet_file:
+            d = doc
+            if d is None or int((d.get("map") or {})
+                                .get("version", -1)) < new.version:
+                d = partition.read_fleet_file(self._fleet_file)
+            if d is not None and int((d.get("map") or {})
+                                     .get("version", -1)) \
+                    >= new.version:
+                members = sorted(d.get("members", []),
+                                 key=lambda m: int(m.get("rank", 0)))
+                if rank < len(members):
+                    picked = _pick_addr(
+                        members[rank].get("addresses"), self._scheme)
+                    if picked:
+                        addr = picked
+                self._replica_addrs = self._replica_addrs_from(d)
+        if addr is None:
+            return False
+        self.pmap = new
+        self._claim = claim
+        self.clients[rank].rebind(addr, partition=claim)
+        for c in self.clients:
+            c.partition = dict(claim)
+        # this rank's follower read path is void: its follower may BE
+        # the new primary; reads route primary until addrs say else
+        with self._rlock:
+            dead_rc = self._replica_clients.pop(rank, None)
+            for key in [k for k in self._replica_subs
+                        if k[1] == rank]:
+                self._replica_subs.pop(key, None)
+        if dead_rc is not None:
+            try:
+                dead_rc.abort()
+            except Exception:   # noqa: BLE001
+                pass
+        _count("fleet.failover", rank=rank)
+        for r, c in enumerate(self.clients):
+            if r == rank:
+                continue    # the promoted server already holds v+1
+            try:
+                c.call("adopt", {"map": dict(claim)})
+            except Exception:   # noqa: BLE001 — their next refused
+                pass            # hello self-heals via err.header
+        for c in list(self._replica_clients.values()):
+            try:
+                c.call("adopt", {"map": dict(claim)})
+            except Exception:   # noqa: BLE001
+                pass
+        return True
+
     # -- table surface -----------------------------------------------------
 
     def create_array(self, name: str, size: int, *,
@@ -366,11 +794,14 @@ class FleetClient:
         only its local slice (rank r holds bounds[r+1]-bounds[r]
         elements) from the same spec."""
         self.pmap.dense_bounds(size)    # validate split up front
+        # guarded: creates are idempotent by name server-side, so the
+        # post-failover re-run attaches instead of re-building
         subs = self._fanout(
-            [lambda c=c: c.create_array(name, size, dtype=dtype,
-                                        updater=updater,
-                                        init_value=init_value)
-             for c in self.clients])
+            [lambda c=c, r=r: self._guard(
+                r, lambda: c.create_array(name, size, dtype=dtype,
+                                          updater=updater,
+                                          init_value=init_value))
+             for r, c in enumerate(self.clients)])
         return FleetArrayTable(self, subs, size)
 
     def create_kv(self, name: str, capacity: int, *, value_dim: int = 0,
@@ -378,10 +809,12 @@ class FleetClient:
                   updater: Optional[str] = None,
                   tiered: bool = False) -> FleetKVTable:
         subs = self._fanout(
-            [lambda c=c: c.create_kv(name, capacity,
-                                     value_dim=value_dim, dtype=dtype,
-                                     updater=updater, tiered=tiered)
-             for c in self.clients])
+            [lambda c=c, r=r: self._guard(
+                r, lambda: c.create_kv(name, capacity,
+                                       value_dim=value_dim,
+                                       dtype=dtype, updater=updater,
+                                       tiered=tiered))
+             for r, c in enumerate(self.clients)])
         return FleetKVTable(self, subs)
 
     # -- fleet plumbing ----------------------------------------------------
@@ -400,8 +833,8 @@ class FleetClient:
         return self._fanout([c.server_status for c in self.clients])
 
     def drain(self) -> None:
-        for c in self.clients:
-            c.drain()
+        for rank in range(len(self.clients)):
+            self._guard_drain(rank)
 
     @property
     def tx_bytes(self) -> int:
@@ -426,6 +859,15 @@ class FleetClient:
                 c.close()
             except Exception as exc:    # noqa: BLE001 — close them all
                 errors.append(exc)
+        with self._rlock:
+            rclients = list(self._replica_clients.values())
+            self._replica_clients.clear()
+            self._replica_subs.clear()
+        for c in rclients:
+            try:    # read-only connections: nothing pending to drain
+                c.abort()
+            except Exception:   # noqa: BLE001
+                pass
         self._pool.shutdown(wait=False)
         if errors:
             raise errors[0]
@@ -440,16 +882,26 @@ class FleetClient:
 def connect_fleet(addresses: Sequence[str], *,
                   version: int = 1,
                   kv_buckets: Optional[int] = None,
+                  replicas: int = 1,
                   client: Optional[str] = None,
                   quant: Optional[str] = "env",
                   seed: Optional[int] = None,
-                  deadline_s="env") -> FleetClient:
+                  deadline_s="env",
+                  replica_addrs: Optional[
+                      Sequence[Sequence[str]]] = None,
+                  read_replica="env") -> FleetClient:
     """Dial every member of a fleet. ``addresses`` is rank-ordered;
     the map claimed at each hello is ``PartitionMap(len(addresses),
-    version, kv_buckets)`` — member ranks refuse a mismatch."""
+    version, kv_buckets, replicas)`` — member ranks refuse a mismatch.
+    ``replica_addrs`` (rank-ordered lists of follower addresses) opts
+    bounded-staleness reads into follower routing without a fleet
+    file."""
     return FleetClient(addresses, version=version,
-                       kv_buckets=kv_buckets, client=client,
-                       quant=quant, seed=seed, deadline_s=deadline_s)
+                       kv_buckets=kv_buckets, replicas=replicas,
+                       client=client, quant=quant, seed=seed,
+                       deadline_s=deadline_s,
+                       replica_addrs=replica_addrs,
+                       read_replica=read_replica)
 
 
 def fleet_addresses(fleet_file: str,
@@ -465,18 +917,31 @@ def fleet_addresses(fleet_file: str,
                      key=lambda m: int(m.get("rank", 0)))
     out = []
     for m in members:
-        addrs = list(m.get("addresses") or [])
-        if not addrs:
+        picked = _pick_addr(m.get("addresses"), scheme)
+        if picked is None:
             raise ValueError(f"fleet member {m.get('rank')} has no "
                              "addresses")
-        picked = addrs[0]
-        if scheme:
-            for a in addrs:
-                if a.split(":", 1)[0].rstrip("/") == scheme \
-                        or a.startswith(scheme + "://"):
-                    picked = a
-                    break
         out.append(picked)
+    return out
+
+
+def replica_addresses(fleet_file: str,
+                      scheme: Optional[str] = None
+                      ) -> List[List[str]]:
+    """Rank-ordered follower address lists out of a launcher fleet
+    file (``[]`` for a rank with no followers)."""
+    doc = partition.read_fleet_file(fleet_file)
+    if doc is None:
+        raise FileNotFoundError(
+            f"fleet file {fleet_file!r} missing or malformed")
+    members = sorted(doc.get("members", []),
+                     key=lambda m: int(m.get("rank", 0)))
+    out = []
+    for m in members:
+        out.append([a for a in
+                    (_pick_addr(rep.get("addresses"), scheme)
+                     for rep in (m.get("replicas") or []))
+                    if a])
     return out
 
 
@@ -485,9 +950,11 @@ def connect_fleet_file(fleet_file: str, *,
                        client: Optional[str] = None,
                        quant: Optional[str] = "env",
                        seed: Optional[int] = None,
-                       deadline_s="env") -> FleetClient:
-    """Dial a fleet straight from its launcher fleet file (addresses
-    AND the authoritative map come from the file)."""
+                       deadline_s="env",
+                       read_replica="env") -> FleetClient:
+    """Dial a fleet straight from its launcher fleet file (addresses,
+    the authoritative map, AND the replica sets come from the file —
+    keeping the file name around is what arms failover)."""
     doc = partition.read_fleet_file(fleet_file)
     if doc is None:
         raise FileNotFoundError(
@@ -495,4 +962,6 @@ def connect_fleet_file(fleet_file: str, *,
     pmap = partition.PartitionMap.from_wire(doc["map"])
     return FleetClient(fleet_addresses(fleet_file, scheme),
                        pmap=pmap, client=client, quant=quant,
-                       seed=seed, deadline_s=deadline_s)
+                       seed=seed, deadline_s=deadline_s,
+                       fleet_file=fleet_file, scheme=scheme,
+                       read_replica=read_replica)

@@ -326,9 +326,14 @@ class WireClient:
             if not header.get("ok") or header.get("rid") != hello_rid:
                 # includes a fleet member refusing a partition-map
                 # mismatch: WireProtocolError is not in the retryable
-                # set, so the refusal propagates loudly, unretried
-                raise wire.WireProtocolError(
+                # set, so the refusal propagates loudly, unretried.
+                # The reply header rides on the exception — a refusal
+                # carries the server's CURRENT map, which is how a
+                # stale router refreshes itself (client/router.py)
+                err = wire.WireProtocolError(
                     f"bad hello reply: {header}")
+                err.header = header
+                raise err
         except BaseException:
             try:
                 chan.close()
@@ -455,9 +460,11 @@ class WireClient:
                 self._acked_rid = max(self._acked_rid, self._max_ack)
             self._shed_wait_s = 0.0     # an ack = shed-wait progress
             if not header.get("ok"):
-                raise RemoteError(
+                err = RemoteError(
                     f"remote add rid={rid} failed: "
                     f"{header.get('error')}")
+                err.header = header
+                raise err
             return
 
     def _honor_shed(self, rid, header: Dict[str, Any]) -> None:
@@ -526,8 +533,10 @@ class WireClient:
                 # the target itself may also be a pending mutation
                 self._consume_ack(header)
                 if not header.get("ok"):
-                    raise RemoteError(f"remote op rid={rid} failed: "
+                    err = RemoteError(f"remote op rid={rid} failed: "
                                       f"{header.get('error')}")
+                    err.header = header     # structured refusals
+                    raise err               # (stale follower, ...)
                 return header, arrays
             self._consume_ack(header)
 
@@ -642,6 +651,43 @@ class WireClient:
                     except OSError:
                         pass
                     self._chan = None
+
+    def abort(self) -> None:
+        """Close WITHOUT draining: for a peer known to be dead (a
+        SIGKILLed primary, a dropped replication follower) where
+        :meth:`close`'s drain would burn the whole retry budget
+        against a corpse. Pending mutations stay pending — a
+        :meth:`rebind` to a successor replays them."""
+        with self._lock:
+            self._closed = True
+            if self._chan is not None:
+                try:
+                    self._chan.close()
+                except OSError:
+                    pass
+                self._chan = None
+
+    def rebind(self, address: str,
+               partition: Optional[Dict[str, Any]] = None) -> None:
+        """Repoint this client at a successor server (failover: the
+        promoted follower inherits the dead primary's range). The
+        pending window survives: the next request redials ``address``,
+        hellos with the NEW partition claim, and replays every unacked
+        mutation — the successor's dedup (fed by the replication
+        stream's origin records) keeps the exactly-once effect."""
+        with self._lock:
+            self.address = address
+            if partition is not None:
+                self.partition = dict(partition)
+            self._closed = False
+            if self._chan is not None:
+                try:
+                    self._chan.close()
+                except OSError:
+                    pass
+                self._chan = None
+            for p in self._pending:
+                p.sent = False
 
     def __enter__(self) -> "WireClient":
         return self

@@ -108,6 +108,12 @@ SPECS: Dict[str, Knob] = {k.name: k for k in (
           owner="server",
           doc="extra generations a replica may serve past the "
               "client-requested staleness bound"),
+    _spec("server.repl.slack", env="MVTPU_REPL_SLACK",
+          kind="int", default=0, lo=0, hi=1 << 20, step=1,
+          owner="server",
+          doc="extra generations a cross-process FOLLOWER read may "
+              "lag past the client bound before it bounces to the "
+              "primary"),
     _spec("client.staleness", env="MVTPU_STALENESS", kind="int",
           default=0, lo=0, hi=1024, step=1, owner="client",
           doc="cached-view max staleness, generations"),

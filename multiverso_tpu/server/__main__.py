@@ -62,6 +62,16 @@ Fleet flags:
     of N).
 ``--fleet-rank R`` / ``--fleet-n N``
     internal: member mode (set by the launcher).
+``--replicas R``
+    replication factor per rank (default 1 = no followers). R-1
+    FOLLOWER processes spawn next to each rank's primary (unix/shm
+    paths gain a ``fJ`` suffix; explicit tcp ports offset by ``n*J``),
+    listed under the member's ``replicas`` row in the fleet file. The
+    primary streams applied deltas to them (``server/replication.py``)
+    and the router load-balances bounded-staleness reads across the
+    replica set, promoting a follower if the primary dies.
+``--replica-of RANK`` / ``--replica-idx J``
+    internal: follower member mode (set by the launcher).
 """
 
 from __future__ import annotations
@@ -84,6 +94,18 @@ def _rank_address(addr: str, rank: int) -> str:
     return f"{addr}.{rank}"
 
 
+def _replica_address(addr: str, rank: int, n: int, idx: int) -> str:
+    """Follower idx (1-based) of rank's listen address: path suffix
+    ``.RfJ``; explicit tcp ports offset by ``n*J`` past the primary
+    block so primaries and followers never collide."""
+    addr = addr.strip()
+    if addr.startswith("tcp:"):
+        host, _, port = addr[4:].rpartition(":")
+        p = int(port or 0)
+        return f"tcp:{host}:{p + rank + n * idx if p else 0}"
+    return f"{addr}.{rank}f{idx}"
+
+
 def _write_ready(path: str, content: str) -> None:
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
@@ -100,12 +122,16 @@ def _member_main(args, server_cls, partition) -> int:
     if args.fleet_n:
         pmap = partition.PartitionMap(args.fleet_n,
                                       version=args.fleet_version,
-                                      kv_buckets=args.kv_buckets)
+                                      kv_buckets=args.kv_buckets,
+                                      replicas=args.replicas or 1)
         member = partition.PartitionMember(pmap, args.fleet_rank)
     core.init()
+    follower = args.replica_idx is not None
     server = server_cls(args.address, name=args.name, fuse=args.fuse,
                         qos=args.qos, queue_bound=args.queue,
-                        partition=member, fleet_file=args.fleet_file)
+                        partition=member, fleet_file=args.fleet_file,
+                        follower=follower,
+                        replica_idx=args.replica_idx)
     bound = server.start()
 
     if args.ready_file:
@@ -134,8 +160,10 @@ def _member_main(args, server_cls, partition) -> int:
 def _fleet_main(args, partition) -> int:
     """Launcher: N member processes + one fleet file."""
     n = int(args.fleet)
+    r = max(int(args.replicas or 1), 1)
     pmap = partition.PartitionMap(n, version=args.fleet_version,
-                                  kv_buckets=args.kv_buckets)
+                                  kv_buckets=args.kv_buckets,
+                                  replicas=r)
     addresses = [a.strip() for a in str(args.address).split(",")
                  if a.strip()]
     fleet_file = args.fleet_file or args.ready_file
@@ -147,22 +175,39 @@ def _fleet_main(args, partition) -> int:
 
     env = dict(os.environ)
     env.setdefault("MVTPU_STATUSZ_PORT", "0")
-    procs, ready_files = [], []
+    # one spec per process: rank's primary (idx None) then its
+    # followers (idx 1..R-1), all partition-member rank — a follower
+    # sizes its shard exactly like its primary
+    specs = []
     for rank in range(n):
-        ready = f"{fleet_file}.r{rank}.ready"
+        specs.append((rank, None,
+                      [_rank_address(a, rank) for a in addresses]))
+        for idx in range(1, r):
+            specs.append((rank, idx,
+                          [_replica_address(a, rank, n, idx)
+                           for a in addresses]))
+    procs, ready_files = [], []
+    for rank, idx, addrs in specs:
+        tag = f"r{rank}" if idx is None else f"r{rank}f{idx}"
+        ready = f"{fleet_file}.{tag}.ready"
         try:
             os.unlink(ready)
         except OSError:
             pass
         ready_files.append(ready)
+        name = f"{args.name}-{rank}" if idx is None \
+            else f"{args.name}-{rank}f{idx}"
         cmd = [sys.executable, "-m", "multiverso_tpu.server",
-               "--address", ",".join(_rank_address(a, rank)
-                                     for a in addresses),
-               "--name", f"{args.name}-{rank}",
+               "--address", ",".join(addrs),
+               "--name", name,
                "--ready-file", ready,
                "--fleet-rank", str(rank), "--fleet-n", str(n),
                "--fleet-version", str(args.fleet_version),
-               "--fleet-file", fleet_file]
+               "--fleet-file", fleet_file,
+               "--replicas", str(r)]
+        if idx is not None:
+            cmd += ["--replica-of", str(rank),
+                    "--replica-idx", str(idx)]
         if args.kv_buckets:
             cmd += ["--kv-buckets", str(args.kv_buckets)]
         if args.fuse is not None:
@@ -181,20 +226,24 @@ def _fleet_main(args, partition) -> int:
                 except OSError:
                     pass
 
-    # every member ready (or one dead before ready = startup failure)
-    members = []
+    # every process ready — primaries AND followers — before the
+    # fleet file exists (clients and the primaries' replication taps
+    # both gate on it, so nothing dials a follower that isn't up)
+    members = {}
     deadline = time.monotonic() + float(
         os.environ.get("MVTPU_FLEET_STARTUP_S", "") or 60.0)
-    for rank, ready in enumerate(ready_files):
+    for i, (rank, idx, _addrs) in enumerate(specs):
+        ready = ready_files[i]
+        tag = f"{rank}" if idx is None else f"{rank} follower {idx}"
         while not os.path.exists(ready):
-            rc = procs[rank].poll()
+            rc = procs[i].poll()
             if rc is not None:
-                print(f"fleet member {rank} exited rc={rc} before "
+                print(f"fleet member {tag} exited rc={rc} before "
                       "ready", file=sys.stderr)
                 _kill_all()
                 return 1
             if time.monotonic() > deadline:
-                print(f"fleet member {rank} not ready in time",
+                print(f"fleet member {tag} not ready in time",
                       file=sys.stderr)
                 _kill_all()
                 return 1
@@ -204,17 +253,26 @@ def _fleet_main(args, partition) -> int:
         statusz_port = next(
             (int(p.split(":", 1)[1]) for p in parts
              if p.startswith("statusz:")), None)
-        members.append({
-            "rank": rank, "name": f"{args.name}-{rank}",
-            "addresses": [p for p in parts
-                          if not p.startswith("statusz:")],
-            "statusz_port": statusz_port, "pid": procs[rank].pid})
+        row = {"name": f"{args.name}-{rank}" if idx is None
+               else f"{args.name}-{rank}f{idx}",
+               "addresses": [p for p in parts
+                             if not p.startswith("statusz:")],
+               "statusz_port": statusz_port, "pid": procs[i].pid}
+        if idx is None:
+            row["rank"] = rank
+            row["replicas"] = []
+            members[rank] = row
+        else:
+            row["idx"] = idx
+            members[rank]["replicas"].append(row)
+    members = [members[rank] for rank in range(n)]
 
     partition.write_fleet_file(fleet_file, pmap, members)
     if args.ready_file and args.ready_file != fleet_file:
         with open(fleet_file) as f:
             _write_ready(args.ready_file, f.read())
-    print(f"fleet of {n} up; fleet file {fleet_file}", flush=True)
+    print(f"fleet of {n} x{r} up; fleet file {fleet_file}",
+          flush=True)
 
     stopping = []
 
@@ -250,6 +308,9 @@ def main(argv=None) -> int:
     parser.add_argument("--kv-buckets", type=int, default=None)
     parser.add_argument("--fleet-rank", type=int, default=0)
     parser.add_argument("--fleet-n", type=int, default=0)
+    parser.add_argument("--replicas", type=int, default=1)
+    parser.add_argument("--replica-of", type=int, default=None)
+    parser.add_argument("--replica-idx", type=int, default=None)
     args = parser.parse_args(argv)
 
     from multiverso_tpu.server import partition

@@ -86,8 +86,13 @@ QOS_ENV = "MVTPU_SERVER_QOS"
 QUEUE_ENV = "MVTPU_SERVER_QUEUE"
 
 #: ops that bypass admission and ride the priority lane (a flooded
-#: server must still handshake / health-check / shut down)
-CONTROL_OPS = ("hello", "ping", "stats", "shutdown")
+#: server must still handshake / health-check / shut down). The
+#: replication plane rides here too: ``repl`` frames must keep their
+#: stream order (a shed-then-resent repl create racing a later repl
+#: add would misapply), and ``promote``/``adopt`` are the failover
+#: path — exactly when the fleet is least healthy.
+CONTROL_OPS = ("hello", "ping", "stats", "shutdown",
+               "repl", "promote", "adopt")
 
 #: ops whose shed flips the server into degraded mode (reads are
 #: diverted to replicas while WRITES are being shed)
@@ -109,7 +114,7 @@ class QosClass:
     """One parsed QoS class (see module docstring for the grammar)."""
 
     __slots__ = ("name", "match", "weight", "_rate", "burst",
-                 "__weakref__")
+                 "_auto_burst", "__weakref__")
 
     def __init__(self, name: str, match: str = "*",
                  weight: float = 1.0, rate: float = 0.0,
@@ -121,6 +126,7 @@ class QosClass:
         self.name = name
         self.match = match
         self.weight = float(weight)
+        self._auto_burst = burst is None
         self._rate = float(rate)
         self.burst = float(burst) if burst is not None \
             else max(self.rate, 1.0)
@@ -133,10 +139,18 @@ class QosClass:
 
     @rate.setter
     def rate(self, v: float) -> None:
-        # runtime-mutable (control-plane binding): when the rate is
-        # raised past the bucket capacity, grow the burst with it —
-        # otherwise a starved class stays starved by its old burst
+        # runtime-mutable (control-plane binding). An auto-derived
+        # burst (no explicit ``burst=`` in the spec) tracks the rate
+        # BOTH ways: raising the rate must not stay starved by the old
+        # capacity, and lowering it must not be masked for thousands
+        # of requests by a bucket grown under the old rate. An
+        # explicit burst is an operator pin: it only grows when the
+        # rate is raised past it (a bucket smaller than one second of
+        # refill makes no sense), never shrinks.
         self._rate = float(v)
+        if getattr(self, "_auto_burst", False):
+            self.burst = max(self._rate, 1.0)
+            return
         burst = getattr(self, "burst", None)
         if burst is not None and self._rate > burst:
             self.burst = self._rate

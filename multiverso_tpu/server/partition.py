@@ -73,8 +73,11 @@ hashing = _dep("multiverso_tpu.tables.hashing", "tables", "hashing.py")
 #: granularity for item 3's range moves without bloating the map.
 DEFAULT_KV_BUCKETS = 8192
 
-#: hello/statusz wire fields of a partition claim
-_WIRE_FIELDS = ("n", "version", "kv_buckets")
+#: hello/statusz wire fields of a partition claim; ``replicas`` joined
+#: the geometry in the replication PR, so claims from older routers
+#: (no ``replicas`` key) read as the pre-replication default of 1
+_WIRE_FIELDS = ("n", "version", "kv_buckets", "replicas")
+_WIRE_DEFAULTS = {"replicas": 1}
 
 
 class PartitionMap:
@@ -84,18 +87,24 @@ class PartitionMap:
     ``(n, version, kv_buckets)`` triple — any change to the geometry
     must bump ``version`` (item 3's reshard contract)."""
 
-    __slots__ = ("n", "version", "kv_buckets")
+    __slots__ = ("n", "version", "kv_buckets", "replicas")
 
     def __init__(self, n: int, *, version: int = 1,
-                 kv_buckets: Optional[int] = None) -> None:
+                 kv_buckets: Optional[int] = None,
+                 replicas: int = 1) -> None:
         n = int(n)
         if n < 1:
             raise ValueError(f"partition map needs n >= 1, got {n}")
+        replicas = int(replicas)
+        if replicas < 1:
+            raise ValueError(f"partition map needs replicas >= 1, "
+                             f"got {replicas}")
         base = int(kv_buckets) if kv_buckets else DEFAULT_KV_BUCKETS
         if base < n:
             base = n
         self.n = n
         self.version = int(version)
+        self.replicas = replicas
         # round UP to a multiple of n: equal contiguous blocks per rank
         self.kv_buckets = -(-base // n) * n
 
@@ -142,19 +151,22 @@ class PartitionMap:
 
     def to_wire(self) -> Dict[str, int]:
         return {"n": self.n, "version": self.version,
-                "kv_buckets": self.kv_buckets}
+                "kv_buckets": self.kv_buckets,
+                "replicas": self.replicas}
 
     @classmethod
     def from_wire(cls, doc: Dict[str, Any]) -> "PartitionMap":
         return cls(int(doc["n"]), version=int(doc.get("version", 1)),
-                   kv_buckets=int(doc["kv_buckets"]))
+                   kv_buckets=int(doc["kv_buckets"]),
+                   replicas=int(doc.get("replicas", 1)))
 
     def mismatch(self, claim: Optional[Dict[str, Any]]) -> Optional[str]:
         """None when ``claim`` (a to_wire dict off the hello header)
         names this exact map, else the human-readable refusal."""
         if not isinstance(claim, dict):
             return f"partition claim is not a map: {claim!r}"
-        theirs = tuple(claim.get(k) for k in _WIRE_FIELDS)
+        theirs = tuple(claim.get(k, _WIRE_DEFAULTS.get(k))
+                       for k in _WIRE_FIELDS)
         ours = tuple(getattr(self, k) for k in _WIRE_FIELDS)
         if theirs != ours:
             return ("partition map mismatch: server has "
@@ -215,9 +227,20 @@ class PartitionMember:
 # LAZILY (first /statusz?fleet=1 scrape) so startup has no ordering
 # cycle. Shape:
 #
-#   {"kind": "mvtpu.fleet.v1", "map": {n, version, kv_buckets},
+#   {"kind": "mvtpu.fleet.v1",
+#    "map": {n, version, kv_buckets, replicas},
 #    "members": [{"rank", "name", "addresses": [...],
-#                 "statusz_port": int|null, "pid": int}, ...]}
+#                 "statusz_port": int|null, "pid": int,
+#                 "replicas": [{"idx", "name", "addresses": [...],
+#                               "statusz_port": int|null, "pid": int},
+#                              ...]},
+#                ...]}
+#
+# ``replicas`` lists rank r's FOLLOWER processes (``--replicas R``
+# spawns R-1 of them per rank); a follower promotion rewrites the doc
+# through :func:`promote_in_doc` — the promoted follower becomes the
+# member row and the map version bumps, so routers that re-read the
+# file route to the new primary while stale claims refuse at hello.
 
 FLEET_FILE_KIND = "mvtpu.fleet.v1"
 
@@ -241,6 +264,32 @@ def read_fleet_file(path: str) -> Optional[Dict[str, Any]]:
     if doc.get("kind") != FLEET_FILE_KIND:
         return None
     return doc
+
+
+def promote_in_doc(doc: Dict[str, Any], rank: int,
+                   idx: int) -> Dict[str, Any]:
+    """A fleet doc after follower ``idx`` of ``rank`` is promoted to
+    primary: the follower's row replaces the member row, it leaves the
+    replica list, and the map version bumps v→v+1 (stale routers now
+    refuse at hello and refresh). Pure function — the caller owns the
+    atomic rewrite through :func:`write_fleet_file`."""
+    out = json.loads(json.dumps(doc))
+    m = out.setdefault("map", {})
+    m["version"] = int(m.get("version", 1)) + 1
+    for member in out.get("members", []):
+        if member.get("rank") != rank:
+            continue
+        reps = member.get("replicas") or []
+        rep = next((r for r in reps if r.get("idx") == idx), None)
+        if rep is not None:
+            member["name"] = rep.get("name", member.get("name"))
+            member["addresses"] = rep.get("addresses",
+                                          member.get("addresses"))
+            member["statusz_port"] = rep.get("statusz_port")
+            member["pid"] = rep.get("pid")
+            member["promoted_from"] = idx
+        member["replicas"] = [r for r in reps if r.get("idx") != idx]
+    return out
 
 
 # -- fleet-aggregated introspection ----------------------------------------

@@ -89,6 +89,8 @@ from multiverso_tpu.control import knobs as _knobs
 from multiverso_tpu.ft import chaos as _chaos
 from multiverso_tpu.io import wiresock
 from multiverso_tpu.server import admission as _admission_mod
+from multiverso_tpu.server import partition as _partition_mod
+from multiverso_tpu.server import replication as _replication
 from multiverso_tpu.server import wire
 from multiverso_tpu.server.replica import TableReplica
 from multiverso_tpu.telemetry import attribution as _attribution
@@ -236,7 +238,10 @@ class TableServer:
                  qos: Optional[str] = None,
                  queue_bound: Optional[int] = None,
                  partition: Optional[Any] = None,
-                 fleet_file: Optional[str] = None) -> None:
+                 fleet_file: Optional[str] = None,
+                 follower: bool = False,
+                 replica_idx: Optional[int] = None,
+                 replicate_to: Optional[List[str]] = None) -> None:
         self.name = name
         # fleet membership: a server/partition.PartitionMember makes
         # this process rank r of an N-server fleet — every create
@@ -306,6 +311,28 @@ class TableServer:
         # usage attribution: who (client, table, op) and where (range
         # heat) — None when killed via MVTPU_TOPK_K=0
         self._attr = _attribution.plane()
+        # -- cross-process shard replication (server/replication.py) --
+        # follower=True makes this process a read-only replica of its
+        # rank's primary: mutations arrive only as op="repl" stream
+        # frames, client reads are staleness-gated against the stream,
+        # and "promote" flips it to primary on failover. A PRIMARY in
+        # a fleet with replicas>1 (or with an explicit replicate_to
+        # override) owns a ReplicationTap that forwards every applied
+        # mutation and drains follower acks before client acks.
+        self._follower = bool(follower)
+        self._replica_idx = replica_idx
+        self._repl_slack = _knobs.initial("server.repl.slack")
+        _knobs.bind("server.repl.slack", self, "_repl_slack",
+                    label=self.name)
+        self._fstate = _replication.FollowerState(self.name) \
+            if self._follower else None
+        self._tap: Optional[_replication.ReplicationTap] = None
+        if not self._follower and (replicate_to or
+                                   (fleet_file is not None
+                                    and partition is not None)):
+            self._tap = _replication.ReplicationTap(
+                self.name, member=partition, fleet_file=fleet_file,
+                replicate_to=replicate_to)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -357,6 +384,8 @@ class TableServer:
             conn.close()
         for rep in self._replicas.values():
             rep.stop()
+        if self._tap is not None:
+            self._tap.close()
         self._dispatchq.put(None)
         for t in self._threads:
             if t is not threading.current_thread():
@@ -377,6 +406,18 @@ class TableServer:
         if self._partition is not None:
             part = self._partition.describe()
             part["tables"] = list(self._table_parts.values())
+        repl = None
+        if self._tap is not None:
+            repl = self._tap.status()
+        elif self._fstate is not None:
+            repl = self._fstate.status()
+        if repl is not None:
+            repl["follower"] = self._follower
+            repl["slack"] = int(self._repl_slack)
+            if not self._follower:
+                # a promoted ex-follower reports its NEW role (its
+                # FollowerState survives as the apply history)
+                repl["role"] = "primary"
         return {"name": self.name, "address": self.address,
                 "connections": n_conns, "tables": len(self._tables),
                 "ops": self._ops, "fuse": self._fuse,
@@ -384,6 +425,7 @@ class TableServer:
                           "frames": int(self._c_fuse_frames.value)},
                 "queued": self._dispatchq.qsize(),
                 "partition": part,
+                "replication": repl,
                 "admission": self._admission.status(),
                 "replicas": [rep.status()
                              for rep in self._replicas.values()],
@@ -485,6 +527,20 @@ class TableServer:
                     log.debug("conn %d reader closing: %s",
                               conn.conn_id, exc)
                 break
+            if self._fstate is not None \
+                    and header.get("op") == "repl":
+                # follower staleness reference advances at INTAKE: repl
+                # frames ride the strict-FIFO control lane, so by the
+                # time a read dispatches, every frame noted ahead of it
+                # is already applied
+                self._fstate.note(header)
+            # a follower answers on the reader thread too: its
+            # replicas carry the FollowerState stream, so the
+            # snapshot's staleness is measured against the newest
+            # primary generation the stream has announced at intake
+            # (never the local one). Unbounded reads (staleness None)
+            # still go to dispatch, where a follower refuses them
+            # structurally.
             if header.get("staleness") is not None \
                     and header.get("op") in ("get", "kv_get"):
                 t_rep = time.time()
@@ -617,6 +673,11 @@ class TableServer:
                 op = str(header.get("op", "?"))
                 t0 = time.monotonic()
                 reply = self._safe_execute(conn, op, header, arrays)
+                # zero-loss invariant: follower acks drain BEFORE the
+                # client's ack is queued, so an acked write is on
+                # every live follower (no-op without a tap)
+                if self._tap is not None:
+                    self._tap.barrier()
                 self._finish(conn, op, header, reply, t0,
                              h_dispatch, enq_ts,
                              n_bytes=sum(int(a.nbytes)
@@ -742,6 +803,10 @@ class TableServer:
                                                       arrays)
             else:
                 replies.update(self._execute_group(unit))
+        # sync-before-ack (see _dispatch_loop): one barrier per fusion
+        # cycle covers every forwarded frame in it
+        if self._tap is not None:
+            self._tap.barrier()
         for idx, (conn, header, arrays, enq_ts) in enumerate(batch):
             self._finish(conn, str(header.get("op", "?")),
                          header, replies.get(idx), t0,
@@ -761,7 +826,11 @@ class TableServer:
             op = str(header.get("op", "?"))
             item = (idx, conn, header, arrays)
             tid = header.get("table")
-            if op in _FUSABLE and tid is not None:
+            # follower reads stay singleton units: each carries its
+            # own staleness bound, checked (and annotated) per frame
+            if op in _FUSABLE and tid is not None \
+                    and not (self._follower
+                             and op in ("get", "kv_get")):
                 try:
                     tid = int(tid)
                     key = self._group_key(op, tid, header)
@@ -878,6 +947,16 @@ class TableServer:
                     total += delta
             self._heat_touch_dense(header0, table, weight=float(k))
             handle = table.add(total, option, sync=sync)
+            if self._tap is not None:
+                # a fused group forwards as its ONE pre-summed apply:
+                # K original frames would desync generation counts and
+                # float rounding on the follower
+                self._tap.forward_fused(
+                    "add", int(header0["table"]), [total],
+                    origins=[(c.client_id, h.get("rid"))
+                             for _i, c, h, _a in items],
+                    pgen=handle.generation,
+                    option=header0.get("option"))
             reply = {"ok": True, "gen": handle.generation, "fused": k}
             return {idx: (dict(reply), []) for idx, *_ in items}
         if op == "kv_add":
@@ -910,6 +989,15 @@ class TableServer:
             # truthful reply for every request in it (the raise lands
             # in _execute_group's fallback, which re-runs per frame)
             table._check_overflow()
+            if self._tap is not None:
+                # forwarded AFTER the overflow check: a batch the
+                # primary dropped must never reach a follower
+                self._tap.forward_fused(
+                    "kv_add", int(header0["table"]), [uniq, summed],
+                    origins=[(c.client_id, h.get("rid"))
+                             for _i, c, h, _a in items],
+                    pgen=handle.generation,
+                    option=header0.get("option"))
             reply = {"ok": True, "gen": handle.generation, "fused": k}
             return {idx: (dict(reply), []) for idx, *_ in items}
         if op == "get":
@@ -992,9 +1080,27 @@ class TableServer:
             threading.Thread(target=self.stop, daemon=True).start()
             return None
 
+        if op == "promote":
+            return self._op_promote(header)
+        if op == "adopt":
+            return self._op_adopt(header)
+        # a follower is read-only to clients: its state is the primary's
+        # delta stream, verbatim — a direct client mutation would fork it
+        if self._follower and op in ("create", "add", "kv_add"):
+            return ({"ok": False, "follower": True,
+                     "server": self.name,
+                     "error": "follower replica is read-only: "
+                              "mutations go to the primary"}, [])
+        follower_lag: Optional[int] = None
+        if self._follower and op in ("get", "kv_get"):
+            refused, follower_lag = self._follower_read_check(header)
+            if refused is not None:
+                return refused
+
         # mutating ops replay from the dedup cache: a resend after a
-        # reconnect must not re-apply
-        mutating = op in ("create", "add", "kv_add")
+        # reconnect must not re-apply ("repl" included: the tap's link
+        # replays its unacked window after a reconnect like any client)
+        mutating = op in ("create", "add", "kv_add", "repl")
         if mutating:
             cached = self._dedup_get(conn.client_id, header.get("rid"))
             if cached is not None:
@@ -1012,8 +1118,18 @@ class TableServer:
         elif op == "kv_add":
             reply = self._op_kv_add(header, arrays,
                                     force_sync=force_sync)
+        elif op == "repl":
+            reply = self._op_repl(header, arrays)
         else:
             raise ValueError(f"unknown wire op {op!r}")
+        if follower_lag is not None and reply[0].get("ok"):
+            # a follower-served read names its real lag so clients
+            # (and tests) can hold the staleness bound to account
+            reply[0]["follower"] = True
+            reply[0]["lag"] = follower_lag
+        if self._tap is not None and reply[0].get("ok") \
+                and op in ("create", "add", "kv_add"):
+            self._tap.forward(conn.client_id, header, arrays, reply[0])
         if mutating:
             self._dedup_put(conn.client_id, header.get("rid"), reply)
         return reply
@@ -1046,6 +1162,164 @@ class TableServer:
         cache[int(rid)] = reply
         while len(cache) > self._dedup_depth:
             cache.popitem(last=False)
+
+    # -- replication ops (see server/replication.py) -------------------------
+
+    def _follower_read_check(self, header: Dict[str, Any]
+                             ) -> Tuple[Optional[tuple], int]:
+        """Staleness gate for a client read on a FOLLOWER: serve iff
+        this table lags the stream's newest primary generation by at
+        most ``staleness + server.repl.slack``. Returns
+        ``(refusal_reply | None, lag)``."""
+        try:
+            tid = int(header.get("table", -1))
+        except (TypeError, ValueError):
+            tid = -1
+        table = self._tables.get(tid)
+        local_gen = int(getattr(table, "generation", 0) or 0) \
+            if table is not None else 0
+        lag = self._fstate.lag(tid, local_gen) \
+            if self._fstate is not None else 0
+        staleness = header.get("staleness")
+        if staleness is None:
+            # an unbounded (read-your-writes) read cannot be answered
+            # honestly here: structured refusal, router uses the primary
+            return ({"ok": False, "stale": True, "follower": True,
+                     "server": self.name,
+                     "error": "follower serves bounded-staleness "
+                              "reads only"}, []), lag
+        bound = max(int(staleness), 0) + max(int(self._repl_slack), 0)
+        if lag > bound:
+            telemetry.counter("replication.stale_refusals",
+                              server=self.name).inc()
+            return ({"ok": False, "stale": True, "follower": True,
+                     "lag": lag, "server": self.name,
+                     "error": f"follower lags {lag} generations, "
+                              f"past the bound {bound}"}, []), lag
+        return None, lag
+
+    def _op_repl(self, header: Dict[str, Any],
+                 arrays: List[np.ndarray]) -> tuple:
+        """Apply one replicated mutation: the original frame's bytes,
+        decoded and applied exactly as the primary did (bit parity),
+        then recorded under every ORIGINATING (client, rid) — the
+        promotion replay window that keeps a post-failover client
+        resend exactly-once."""
+        if not self._follower:
+            raise ValueError("repl frame at a non-follower server")
+        orig, origins, pgen, tid = wire.repl_unwrap(header)
+        op = str(orig.get("op", "?"))
+        t0 = time.time()
+        if op == "create":
+            reply = self._op_create(orig, force_tid=tid)
+        elif op == "add":
+            reply = self._op_add(orig, arrays)
+        elif op == "kv_add":
+            reply = self._op_kv_add(orig, arrays)
+        else:
+            raise ValueError(f"unknown replicated op {op!r}")
+        # FRESH dicts per replay key: _finish bakes the STREAMER's rid
+        # into the reply object it returns, and a shared dict would
+        # leak that rid into the origin-keyed replay entries
+        for oc, orid in origins:
+            if orid is not None:
+                self._dedup_put(oc, orid,
+                                (dict(reply[0]), list(reply[1])))
+        t = tid
+        if t is None:
+            try:
+                t = int(orig.get("table"))
+            except (TypeError, ValueError):
+                t = None
+        if self._fstate is not None and t is not None:
+            self._fstate.applied(t, int(reply[0].get("gen") or 0))
+        ctx = wire.trace_ctx(orig)
+        if ctx is not None and _trace.active():
+            # the apply span chains under the ORIGINATING client
+            # request, so a traced write shows its replication hop
+            with _trace.adopt_remote(ctx):
+                _trace.emit_span("server.repl.apply", t0,
+                                 time.time() - t0, server=self.name,
+                                 op=op, origins=len(origins))
+        return reply
+
+    def _op_promote(self, header: Dict[str, Any]) -> tuple:
+        """Flip this FOLLOWER to primary for its rank (failover). Bumps
+        the partition map version — the hello-refusal machinery then
+        refuses every router still claiming the old map, whose refresh
+        (via the refusal's map + the rewritten fleet file) lands on
+        this server. Idempotent: a second promote reports the map."""
+        if not self._follower:
+            wire_map = self._partition.map.to_wire() \
+                if self._partition is not None else None
+            return ({"ok": True, "already": True,
+                     "partition": wire_map, "server": self.name}, [])
+        self._follower = False
+        # the snapshot replicas' staleness reference reverts to the
+        # LOCAL generation: the repl stream is over, and a frozen
+        # stream high-water mark would clamp their lag to zero while
+        # direct writes advance the table underneath them
+        for rep in self._replicas.values():
+            rep.stream = None
+        wire_map = None
+        if self._partition is not None:
+            old = self._partition.map
+            new_map = _partition_mod.PartitionMap(
+                old.n, version=old.version + 1,
+                kv_buckets=old.kv_buckets, replicas=old.replicas)
+            self._partition = _partition_mod.PartitionMember(
+                new_map, self._partition.rank)
+            wire_map = new_map.to_wire()
+            if self._fleet_file:
+                try:
+                    doc = _partition_mod.read_fleet_file(
+                        self._fleet_file)
+                    if doc is not None:
+                        new_doc = _partition_mod.promote_in_doc(
+                            doc, self._partition.rank,
+                            self._replica_idx or 0)
+                        _partition_mod.write_fleet_file(
+                            self._fleet_file, new_map,
+                            new_doc["members"])
+                except Exception as exc:    # noqa: BLE001 — promotion
+                    log.warn("server %r: fleet-file rewrite failed "
+                             "on promote: %s", self.name, exc)
+            # R>2: the new primary keeps streaming to the remaining
+            # followers of this rank (the rewritten fleet file no
+            # longer lists us; with none left the tap stays dormant)
+            if self._tap is None and self._fleet_file:
+                self._tap = _replication.ReplicationTap(
+                    self.name, member=self._partition,
+                    fleet_file=self._fleet_file)
+        telemetry.counter("replication.promotions",
+                          server=self.name).inc()
+        log.info("server %r PROMOTED to primary (map v%s)", self.name,
+                 self._partition.map.version
+                 if self._partition is not None else "-")
+        return ({"ok": True, "promoted": True, "server": self.name,
+                 "partition": wire_map}, [])
+
+    def _op_adopt(self, header: Dict[str, Any]) -> tuple:
+        """Adopt a newer partition map in place (broadcast to the
+        surviving members after a promotion): monotonic and idempotent;
+        live connections are untouched — the version only gates future
+        hellos."""
+        wire_map = header.get("map")
+        if self._partition is None or not isinstance(wire_map, dict):
+            return ({"ok": True, "ignored": True}, [])
+        new = _partition_mod.PartitionMap.from_wire(wire_map)
+        cur = self._partition.map
+        if new.version > cur.version:
+            self._partition = _partition_mod.PartitionMember(
+                new, self._partition.rank)
+            if self._tap is not None:
+                self._tap.update_claim(new.to_wire())
+            telemetry.counter("wire.map.adopted",
+                              server=self.name).inc()
+            log.info("server %r adopted partition map v%d", self.name,
+                     new.version)
+        return ({"ok": True,
+                 "version": self._partition.map.version}, [])
 
     # -- table ops ---------------------------------------------------------
 
@@ -1115,7 +1389,8 @@ class TableServer:
         for b in np.nonzero(counts)[0]:
             heat.counts[int(b)] += float(counts[b])
 
-    def _op_create(self, header: Dict[str, Any]) -> tuple:
+    def _op_create(self, header: Dict[str, Any],
+                   force_tid: Optional[int] = None) -> tuple:
         name = str(header["name"])
         kind = str(header.get("kind", "array"))
         spec = dict(header.get("spec") or {})
@@ -1123,11 +1398,21 @@ class TableServer:
             # idempotent by name: N workers all issue the same creates
             # at startup; first one builds, the rest attach
             tid = self._by_name[name]
+            if force_tid is not None and force_tid != tid:
+                raise ValueError(
+                    f"replicated create {name!r}: primary id "
+                    f"{force_tid} != local id {tid}")
             table = self._tables[tid]
         else:
             table = self._build_table(name, kind, spec)
-            tid = self._next_table
-            self._next_table += 1
+            # a replicated create carries the PRIMARY's table id so the
+            # follower's id space stays aligned (clients reuse their
+            # primary handles against followers verbatim)
+            tid = self._next_table if force_tid is None \
+                else int(force_tid)
+            if tid in self._tables:
+                raise ValueError(f"table id {tid} already in use")
+            self._next_table = max(self._next_table, tid + 1)
             self._tables[tid] = table
             self._by_name[name] = tid
             if self._partition is not None:
@@ -1136,9 +1421,13 @@ class TableServer:
             if kind in ("array", "kv"):
                 # dormant until the first staleness-tolerant read;
                 # tiered tables excluded (device arrays are one tier,
-                # a snapshot of them would serve partial data)
-                self._replicas[tid] = TableReplica(table, kind,
-                                                   server=self.name)
+                # a snapshot of them would serve partial data). On a
+                # follower the snapshot's staleness is measured
+                # against the repl stream's noted primary generation,
+                # not the local one.
+                self._replicas[tid] = TableReplica(
+                    table, kind, server=self.name, tid=tid,
+                    stream=self._fstate if self._follower else None)
             log.info("server %r created table %d %r kind=%s", self.name,
                      tid, name, kind)
         meta = {"ok": True, "table": tid, "name": name, "kind": kind,

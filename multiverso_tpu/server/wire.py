@@ -691,3 +691,71 @@ def decode_delta(meta: Optional[Dict[str, Any]],
         raise WireProtocolError(f"unknown delta encoding {mode!r}")
     return out.astype(np.dtype(str(meta.get("dtype", "<f4"))),
                       copy=False)
+
+
+def decoded_nbytes(meta: Optional[Dict[str, Any]],
+                   arrays: Sequence[np.ndarray]) -> int:
+    """Byte size of the DECODED delta a payload carries — what a
+    full-state/full-precision sync would have shipped. The replication
+    tap uses decoded/encoded as its compression ratio without paying
+    for an actual dequantize."""
+    mode = (meta or {}).get("mode", "raw")
+    if mode == "raw":
+        return sum(int(np.asarray(a).nbytes) for a in arrays)
+    n = 1
+    for s in meta.get("shape", ()):
+        n *= int(s)
+    return n * np.dtype(str(meta.get("dtype", "<f4"))).itemsize
+
+
+# -- replication frames ----------------------------------------------------
+#
+# A primary forwards each APPLIED mutation to its followers as one
+# ``op="repl"`` frame: the original header rides verbatim under
+# ``orig`` (same quant metadata, same option — the arrays pass through
+# untouched, so the follower's dequant+apply is bit-identical to the
+# primary's), plus the bookkeeping a follower needs for exactly-once
+# promotion replay:
+#
+#   origin   original client id (single-frame forwards)
+#   origins  [[client, rid], ...] for a FUSED group forwarded as one
+#            pre-summed frame (1 apply = 1 generation on both sides)
+#   pgen     the primary's table generation AFTER the apply — the
+#            follower's staleness reference
+#   tid      server-assigned table id for streamed creates (follower
+#            creates with the SAME id so table-id spaces stay aligned)
+
+REPL_OP = "repl"
+
+
+def repl_wrap(orig_header: Dict[str, Any], *, origin: str,
+              pgen: Optional[int] = None,
+              origins: Optional[Sequence[Tuple[str, Any]]] = None,
+              tid: Optional[int] = None) -> Dict[str, Any]:
+    """Wrap one applied op's header as a replication frame header."""
+    out: Dict[str, Any] = {"op": REPL_OP, "orig": dict(orig_header),
+                           "origin": str(origin)}
+    if pgen is not None:
+        out["pgen"] = int(pgen)
+    if origins:
+        out["origins"] = [[str(c), r] for c, r in origins]
+    if tid is not None:
+        out["tid"] = int(tid)
+    return out
+
+
+def repl_unwrap(header: Dict[str, Any]) -> Tuple[
+        Dict[str, Any], List[Tuple[str, Any]], Optional[int],
+        Optional[int]]:
+    """``(orig_header, origins, pgen, tid)`` off a replication frame.
+    ``origins`` is always a list of (client, rid) pairs — the single-
+    frame ``origin`` collapses into a one-entry list."""
+    orig = dict(header.get("orig") or {})
+    origins = [(str(c), r) for c, r in (header.get("origins") or [])]
+    if not origins and header.get("origin") is not None:
+        origins = [(str(header["origin"]), orig.get("rid"))]
+    pgen = header.get("pgen")
+    tid = header.get("tid")
+    return (orig, origins,
+            int(pgen) if pgen is not None else None,
+            int(tid) if tid is not None else None)

@@ -1,0 +1,276 @@
+"""Replicated shards end to end: the primary's delta stream applying
+bit-exactly on a follower (dense + KV, exact and 1-bit-EF-quantized),
+fused batches forwarding as ONE pre-summed frame, the follower's
+staleness gate (bound + ``server.repl.slack`` knob, structured stale
+refusals, unbounded reads bounced to the primary), promotion-replay
+exactly-once across a failover under a chaos wire storm, and the
+map v -> v+1 hello-refusal refresh round-trip."""
+
+import contextlib
+import threading
+
+import numpy as np
+import pytest
+
+from multiverso_tpu import core
+from multiverso_tpu.client import router
+from multiverso_tpu.client import transport
+from multiverso_tpu.control import knobs
+from multiverso_tpu.ft import chaos
+from multiverso_tpu.server import partition
+from multiverso_tpu.server import wire
+from multiverso_tpu.server.table_server import TableServer
+from multiverso_tpu.tables import reset_tables
+
+
+@contextlib.contextmanager
+def _pair(tmp_path, **pri_kw):
+    """One replicated rank, in process: a follower and the primary
+    streaming to it (static ``replicate_to`` — no fleet file)."""
+    pmap = partition.PartitionMap(1, replicas=2)
+    fol = TableServer(f"unix:{tmp_path}/fol.sock", name="trepl-f",
+                      partition=partition.PartitionMember(pmap, 0),
+                      follower=True, replica_idx=1)
+    servers = [fol]
+    try:
+        fol_addr = fol.start()
+        pri = TableServer(f"unix:{tmp_path}/pri.sock", name="trepl-p",
+                          partition=partition.PartitionMember(pmap, 0),
+                          replicate_to=[fol_addr], **pri_kw)
+        servers.append(pri)
+        pri_addr = pri.start()
+        yield pri, fol, pri_addr, fol_addr
+    finally:
+        chaos.uninstall_chaos()
+        for s in servers:
+            s.stop()
+        reset_tables()
+        core.shutdown()
+
+
+def _fleet1(pri_addr, fol_addr, **kw):
+    """A 1-rank fleet client routing bounded reads to the follower."""
+    kw.setdefault("quant", None)
+    kw.setdefault("read_replica", 1)
+    return router.connect_fleet([pri_addr], replicas=2,
+                                replica_addrs=[[fol_addr]], **kw)
+
+
+class TestDeltaStreamParity:
+    def test_dense_exact_bit_parity(self, tmp_path):
+        """Unquantized dense adds: the follower's table is the
+        primary's, bit for bit — same frames, same decode, same
+        apply order (the repl stream rides the strict-FIFO control
+        lane)."""
+        with _pair(tmp_path) as (pri, fol, pri_addr, fol_addr):
+            fc = _fleet1(pri_addr, fol_addr, client="w0")
+            t = fc.create_array("rp_dense", 97)
+            rng = np.random.default_rng(7)
+            total = np.zeros(97, np.float32)
+            for _ in range(8):
+                d = rng.standard_normal(97).astype(np.float32)
+                total += d
+                t.add(d)
+            t.wait()
+            via_pri = t.get_shard(0).get()
+            via_fol = t.get(staleness=0)    # barrier => lag 0 here
+            assert via_fol.tobytes() == via_pri.tobytes()
+            assert via_fol.tobytes() == total.tobytes()
+            fc.close()
+
+    def test_dense_1bit_ef_bit_parity(self, tmp_path):
+        """1-bit EF-quantized adds: the tap forwards the ORIGINAL
+        encoded frames (never re-encodes), so the follower dequantizes
+        the identical bytes the primary did — bit parity even though
+        quantization is lossy vs the raw deltas."""
+        with _pair(tmp_path) as (pri, fol, pri_addr, fol_addr):
+            fc = _fleet1(pri_addr, fol_addr, client="w0",
+                         quant="1bit", seed=11)
+            t = fc.create_array("rp_1bit", 256)
+            rng = np.random.default_rng(3)
+            for _ in range(6):
+                t.add(rng.standard_normal(256).astype(np.float32))
+            t.wait()
+            via_pri = t.get_shard(0).get()
+            via_fol = t.get(staleness=0)
+            assert via_fol.tobytes() == via_pri.tobytes()
+            fc.close()
+
+    def test_kv_parity_with_presummed_duplicates(self, tmp_path):
+        """KV adds (int8 stateless quant path) with duplicate keys in
+        one batch: one apply per distinct key on BOTH ends."""
+        with _pair(tmp_path) as (pri, fol, pri_addr, fol_addr):
+            fc = _fleet1(pri_addr, fol_addr, client="w0",
+                         quant=None)
+            kt = fc.create_kv("rp_kv", 512, value_dim=3)
+            keys = np.array([1, 2, 3, 2, 1, 9], np.uint64)
+            vals = np.arange(18, dtype=np.float32).reshape(6, 3)
+            kt.add(keys, vals, sync=True)
+            uniq = np.unique(keys)
+            vp, fp = kt.get_shard(0).get(uniq)
+            vf, ff = kt.get(uniq, staleness=0)
+            assert fp.all() and ff.all()
+            assert vf.tobytes() == vp.tobytes()
+            fc.close()
+
+    def test_fused_batch_forwards_one_presummed_frame(self, tmp_path):
+        """Under fusion the primary applies K frames as ONE summed
+        delta and forwards exactly that sum as ONE repl frame — the
+        follower's generation count and bits match the primary's."""
+        with _pair(tmp_path, fuse=8) as (pri, fol, pri_addr, fol_addr):
+            fc = _fleet1(pri_addr, fol_addr, client="w0")
+            fc2 = _fleet1(pri_addr, fol_addr, client="w1")
+            t = fc.create_array("rp_fuse", 64)
+            t2 = fc2.create_array("rp_fuse", 64)    # attach by name
+            grid = (np.arange(64) % 5 + 1).astype(np.float32)
+
+            def storm(tab, n):
+                for _ in range(n):
+                    tab.add(grid)
+                tab.wait()
+            th = [threading.Thread(target=storm, args=(t, 20)),
+                  threading.Thread(target=storm, args=(t2, 20))]
+            for x in th:
+                x.start()
+            for x in th:
+                x.join()
+            via_pri = t.get_shard(0).get()
+            via_fol = t.get(staleness=0)
+            assert via_pri.tobytes() == (40 * grid).tobytes()
+            assert via_fol.tobytes() == via_pri.tobytes()
+            # primary and follower agree on the generation count too
+            # (one fused apply = one generation on both ends)
+            pgen = pri._tables[t.table_id].generation
+            fgen = fol._tables[t.table_id].generation
+            assert pgen == fgen
+            fc.close()
+            fc2.close()
+
+
+class TestStalenessGate:
+    def test_bound_slack_and_unbounded_refusal(self, tmp_path):
+        """The follower serves a bounded read iff its lag fits within
+        ``staleness + server.repl.slack``; the reply names its real
+        lag; unbounded reads are structurally refused."""
+        with _pair(tmp_path) as (pri, fol, pri_addr, fol_addr):
+            fc = _fleet1(pri_addr, fol_addr, client="w0")
+            t = fc.create_array("rp_gate", 32)
+            t.add(np.ones(32, np.float32), sync=True)
+            c = transport.WireClient(
+                fol_addr, client="probe", quant=None,
+                partition=partition.PartitionMap(
+                    1, replicas=2).to_wire())
+            tid = t.table_id
+            h, _ = c.call("get", {"table": tid, "staleness": 0})
+            assert h["follower"] and h["lag"] == 0
+            # pretend the stream announced 5 generations not yet
+            # applied: reads past the bound must bounce
+            local = fol._tables[tid].generation
+            fol._fstate.note(wire.repl_wrap(
+                {"op": "add", "table": tid}, origin="x",
+                pgen=local + 5))
+            with pytest.raises(transport.RemoteError) as ei:
+                c.call("get", {"table": tid, "staleness": 2})
+            assert ei.value.header.get("stale")
+            assert ei.value.header.get("lag") == 5
+            # within the bound: served, lag annotated
+            h, _ = c.call("get", {"table": tid, "staleness": 8})
+            assert h["follower"] and h["lag"] == 5
+            # the read-slack knob widens the bound live
+            assert knobs.set("server.repl.slack", 5,
+                             label=fol.name)
+            h, _ = c.call("get", {"table": tid, "staleness": 2})
+            assert h["lag"] == 5    # 5 <= 2 + slack 5
+            # unbounded (read-your-writes) is never a follower's to
+            # answer
+            with pytest.raises(transport.RemoteError) as ei:
+                c.call("get", {"table": tid})
+            assert ei.value.header.get("stale")
+            c.close()
+            fc.close()
+
+    def test_router_falls_back_to_primary_on_stale(self, tmp_path):
+        """The fleet router turns a stale refusal into one extra hop,
+        never an error — and the answer is the primary's."""
+        with _pair(tmp_path) as (pri, fol, pri_addr, fol_addr):
+            fc = _fleet1(pri_addr, fol_addr, client="w0")
+            t = fc.create_array("rp_fb", 32)
+            d = np.ones(32, np.float32)
+            t.add(d, sync=True)
+            fol._fstate.note(wire.repl_wrap(
+                {"op": "add", "table": t.table_id}, origin="x",
+                pgen=fol._tables[t.table_id].generation + 99))
+            got = t.get(staleness=0)    # follower refuses -> primary
+            assert got.tobytes() == d.tobytes()
+            # mutations are refused outright on a follower
+            probe = transport.WireClient(
+                fol_addr, client="probe", quant=None,
+                partition=partition.PartitionMap(
+                    1, replicas=2).to_wire())
+            with pytest.raises(transport.RemoteError,
+                               match="read-only"):
+                probe.call("create", {"name": "nope", "kind": "array",
+                                      "spec": {"size": 4}})
+            probe.close()
+            fc.close()
+
+
+class TestFailover:
+    def test_promotion_replay_exactly_once_under_storm(
+            self, tmp_path, monkeypatch):
+        """Kill the primary with a mutation still unacked in the
+        pipeline window, under a chaos wire storm: the router promotes
+        the follower, rebinds, and the replayed window applies exactly
+        once — the final table is the quiet-run answer, not a
+        double-apply."""
+        monkeypatch.setenv("MVTPU_RETRY_ATTEMPTS", "3")
+        monkeypatch.setenv("MVTPU_RETRY_DEADLINE_S", "2")
+        with _pair(tmp_path) as (pri, fol, pri_addr, fol_addr):
+            fc = _fleet1(pri_addr, fol_addr, client="w0")
+            t = fc.create_array("rp_fo", 64)
+            d = (np.arange(64) % 7 + 1).astype(np.float32)
+            t.add(d, sync=True)
+            chaos.install_chaos(
+                "seed=5;wire.send:drop:times=3;wire.recv:torn:times=2")
+            t.add(d)
+            fc.drain()              # acked => replicated (barrier)
+            h = t.add(d)            # rides the window across failover
+            pri.stop()
+            h.wait()                # exhaust retries -> promote ->
+            got = t.get()           # rebind -> replay, exactly once
+            assert got.tobytes() == (3 * d).tobytes()
+            assert fc.pmap.version == 2
+            chaos.uninstall_chaos()
+            # the promoted primary serves writes and unbounded reads
+            t.add(d, sync=True)
+            assert t.get().tobytes() == (4 * d).tobytes()
+            fc.close()
+
+    def test_hello_refusal_carries_bumped_map(self, tmp_path,
+                                              monkeypatch):
+        """Map v -> v+1 refresh round-trip: after a promotion, a
+        client claiming the old map is refused at hello, the refusal
+        carries the NEW map, and re-dialing with that map succeeds —
+        the stale-router refresh loop in one exchange."""
+        monkeypatch.setenv("MVTPU_RETRY_ATTEMPTS", "3")
+        monkeypatch.setenv("MVTPU_RETRY_DEADLINE_S", "2")
+        with _pair(tmp_path) as (pri, fol, pri_addr, fol_addr):
+            v1 = partition.PartitionMap(1, replicas=2).to_wire()
+            boot = transport.WireClient(fol_addr, client="boot",
+                                        quant=None, partition=v1)
+            h, _ = boot.call("promote")
+            assert h["promoted"] and h["partition"]["version"] == 2
+            boot.close()
+            with pytest.raises(wire.WireProtocolError) as ei:
+                transport.WireClient(fol_addr, client="stale",
+                                     quant=None, partition=v1)
+            refused = ei.value.header
+            assert refused["partition"]["version"] == 2
+            fresh = transport.WireClient(
+                fol_addr, client="stale", quant=None,
+                partition=refused["partition"])
+            assert fresh.ping()
+            # promote is idempotent: a second call just reports the map
+            h2, _ = fresh.call("promote")
+            assert h2["ok"] and h2["partition"]["version"] == 2
+            fresh.close()

@@ -116,7 +116,18 @@ DEFAULT_WATCH = ("value", "e2e_words_per_sec", "lda_doc_tokens_per_sec",
                  # server — a drop means the closed loop stopped
                  # recovering the hand-tuned operating point, while the
                  # mistuned starting floor rides along unwatched
-                 "autotune_converged_ops_per_sec")
+                 "autotune_converged_ops_per_sec",
+                 # replica lane (serving_mp --replicas): follower-routed
+                 # bounded-staleness read rate under a primary write
+                 # storm — a drop means follower reads fell back onto
+                 # the primary's dispatch queue (routing, the snapshot
+                 # fast path, or the staleness ledger broke)
+                 "replica_read_ops_per_sec",
+                 # ...and the delta-stream economy: full-precision
+                 # bytes per replicated byte — a drop toward 1.0 means
+                 # the tap started re-encoding (or raw-syncing) instead
+                 # of forwarding the original encoded frames
+                 "replication_bytes_ratio")
 
 # LOWER-is-better watches: a rise past the threshold regresses
 DEFAULT_WATCH_LOWER = ("serving_p99_ms",
@@ -573,6 +584,30 @@ def selftest() -> int:
         ab_doc2["serving_mp_unattributed_ops_per_sec"] = 900.0
         assert main([ab_old, put("ab_base.json", ab_doc2)]) == 0, \
             "the unattributed twin rides along unwatched"
+        # replica lane: the follower-routed read rate and the
+        # delta-stream bytes economy are both watched — either
+        # collapsing means the replication plane regressed, while the
+        # primary-pinned baseline and the speedup ride along unwatched
+        rp_old = put("rp_old.json", {
+            "metric": "replica_read_ops_per_sec", "value": 500.0,
+            "unit": "ops/s", "replica_read_ops_per_sec": 500.0,
+            "replica_baseline_ops_per_sec": 250.0,
+            "replica_read_speedup": 2.0,
+            "replication_bytes_ratio": 28.0})
+        rp_doc = json.loads(json.dumps(json.load(open(rp_old))))
+        rp_doc["replica_read_ops_per_sec"] = 150.0      # -70%
+        rp_doc["value"] = 150.0
+        assert main([rp_old, put("rp_bad.json", rp_doc)]) == 1, \
+            "follower read-rate drop must fail (replica routing broke)"
+        rp_doc2 = json.loads(json.dumps(json.load(open(rp_old))))
+        rp_doc2["replication_bytes_ratio"] = 1.1        # re-encoding
+        assert main([rp_old, put("rp_bytes.json", rp_doc2)]) == 1, \
+            "bytes-ratio collapse must fail (tap re-encoding frames)"
+        rp_doc3 = json.loads(json.dumps(json.load(open(rp_old))))
+        rp_doc3["replica_baseline_ops_per_sec"] = 80.0  # unwatched
+        rp_doc3["replica_read_speedup"] = 6.2
+        assert main([rp_old, put("rp_base.json", rp_doc3)]) == 0, \
+            "the primary-pinned baseline rides along unwatched"
         # windowed-series docs (/vars?window= captures): rates,
         # gauges, and windowed quantiles flatten with their own
         # prefixes and diff like any snapshot
