@@ -79,6 +79,18 @@
 #                   range serving, final bit-exact); emits
 #                   serving_mp_replica.json — a partial line on every
 #                   give-up path
+#   make reshard-smoke - elastic-fleet smoke: a 2-member fleet grows
+#                   to 3 under a parent-process write storm (--grow
+#                   admin wave: stream, forward, commit donors-first),
+#                   then shrinks back quiet; asserts the final tables
+#                   are BIT-EXACT against the counted acked adds
+#                   (integer-grid deltas — no write lost or doubled
+#                   across either flip), moved bytes match the
+#                   MapDiff closed form (migration cost ~ moved
+#                   ranges, never table size), and post-flip p99
+#                   recovers to <= 8x the quiet baseline; emits
+#                   serving_mp_reshard.json — a partial line on every
+#                   give-up path
 #   make trace-smoke - distributed-tracing smoke: a real 2-member
 #                   fleet + a traced client fleet get, then a
 #                   telemetry.report --fleet scrape-merge; asserts one
@@ -111,8 +123,8 @@ NEW ?= BENCH_r05.json
 
 .PHONY: test dryrun bench bench-dryrun bench-diff bench-diff-selftest \
 	client-bench ckpt-bench kernel-bench tier-bench serve-smoke \
-	mp-smoke flood-smoke fleet-smoke replica-smoke trace-smoke \
-	health-smoke autotune-smoke chaos fuzz lint native ci
+	mp-smoke flood-smoke fleet-smoke replica-smoke reshard-smoke \
+	trace-smoke health-smoke autotune-smoke chaos fuzz lint native ci
 
 fuzz:
 	$(PY) tests/deep_fuzz.py
@@ -159,6 +171,9 @@ fleet-smoke:
 replica-smoke:
 	MVTPU_SERVING_MP_TINY=1 $(PY) benchmarks/serving_mp.py --replicas
 
+reshard-smoke:
+	MVTPU_SERVING_MP_TINY=1 $(PY) benchmarks/serving_mp.py --reshard
+
 trace-smoke:
 	$(PY) tools/trace_smoke.py
 
@@ -203,5 +218,5 @@ native:
 
 ci: lint bench-diff-selftest native test dryrun bench-dryrun \
 	client-bench ckpt-bench kernel-bench tier-bench serve-smoke \
-	mp-smoke flood-smoke fleet-smoke replica-smoke trace-smoke \
-	health-smoke autotune-smoke chaos
+	mp-smoke flood-smoke fleet-smoke replica-smoke reshard-smoke \
+	trace-smoke health-smoke autotune-smoke chaos

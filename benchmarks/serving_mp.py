@@ -273,6 +273,29 @@ REPLICA_RATIO = float(os.environ.get("MVTPU_REPLICA_RATIO", "") or 1.5)
 REPLICA_BYTES_RATIO = float(
     os.environ.get("MVTPU_REPLICA_BYTES_RATIO", "") or 2.0)
 
+# reshard lane (--grow) geometry: a 2-member fleet grows to 3 (then
+# shrinks back) while writer threads storm sync dense adds through a
+# fleet-file router. Integer-grid deltas make the post-flip table an
+# EXACT function of the counted acked adds, whatever mix of direct
+# applies, pre-commit forwards, post-commit relays, and post-refresh
+# re-splits carried them — so "no write lost or double-applied across
+# the flip" is a byte-compare, not a tolerance. The moved-bytes gate
+# is the perf claim: migration cost ~ MapDiff's closed-form moved
+# set, never table bytes.
+RESHARD = ({"size": 1 << 18, "kv_capacity": 2048, "kv_keys": 256,
+            "kv_dim": 4, "quiet_steps": 24, "storm_threads": 2,
+            "read_every": 4, "recover_s": 1.0}
+           if TINY else
+           {"size": 1 << 20, "kv_capacity": 4096, "kv_keys": 512,
+            "kv_dim": 4, "quiet_steps": 40, "storm_threads": 3,
+            "read_every": 4, "recover_s": 1.5})
+# post-flip p99 must recover to within this factor of the quiet p99
+# (or the absolute floor, whichever is looser — CI boxes are noisy)
+RESHARD_RECOVER_RATIO = float(
+    os.environ.get("MVTPU_RESHARD_RECOVER_RATIO", "") or 8.0)
+RESHARD_STALL_FLOOR_MS = float(
+    os.environ.get("MVTPU_RESHARD_STALL_FLOOR_MS", "") or 75.0)
+
 
 def _load_transport():
     import importlib.util
@@ -1500,6 +1523,322 @@ def replica_main() -> None:
     _emit_repl(line)
 
 
+def _emit_reshard(line: Dict[str, object]) -> None:
+    out = os.environ.get("MVTPU_RESHARD_BENCH_JSON",
+                         "serving_mp_reshard.json")
+    with open(out, "w") as f:
+        json.dump(line, f, indent=1)
+    print(json.dumps(line), flush=True)
+
+
+def reshard_delta(idx: int) -> np.ndarray:
+    """Integer-grid dense delta for one storm thread (values in
+    [1+idx, 7+idx]): fp32 sums stay exact, so the final table equals
+    ``sum(adds[i] * reshard_delta(i))`` to the byte."""
+    size = RESHARD["size"]
+    return ((np.arange(size) % 7) + 1 + idx).astype(np.float32)
+
+
+def reshard_kv_keys() -> np.ndarray:
+    return np.arange(1, RESHARD["kv_keys"] + 1, dtype=np.uint64) * 31
+
+
+def reshard_kv_vals(keys: np.ndarray) -> np.ndarray:
+    vals = (keys % np.uint64(5)).astype(np.float32) + 1.0
+    cols = np.arange(RESHARD["kv_dim"], dtype=np.float32)
+    return vals[:, None] + cols[None, :]
+
+
+def _reshard_storm(router, fleet_file: str, tag: str,
+                   steps: Optional[int] = None,
+                   stop: Optional[threading.Event] = None
+                   ) -> List[dict]:
+    """Writer threads: sync dense adds (+ a range read every few
+    steps, which is what trips the remap→fleet-file-refresh path on a
+    stale router after the flip). Fixed ``steps`` for the quiet
+    baseline, run-until-``stop`` for the under-reshard storm. Returns
+    per-thread {adds, lat: [(t_done, ms)]}."""
+    n = RESHARD["storm_threads"]
+    out: List[dict] = [{} for _ in range(n)]
+    errs: List[BaseException] = []
+
+    def storm(idx: int) -> None:
+        try:
+            fc = router.connect_fleet_file(
+                fleet_file, client=f"rs-{tag}-w{idx}", quant=None)
+            t = fc.create_array("w_rs", RESHARD["size"],
+                                updater="default")
+            delta = reshard_delta(idx)
+            span = RESHARD["size"] // n
+            lo = idx * span
+            lat, adds, step = [], 0, 0
+            while (steps is None or step < steps) \
+                    and (stop is None or not stop.is_set()):
+                t0 = time.perf_counter()
+                t.add(delta, sync=True)
+                adds += 1
+                if step % RESHARD["read_every"] == 0:
+                    got = t.get_range(lo, lo + span)
+                    assert got.shape == (span,)
+                lat.append((time.perf_counter(),
+                            (time.perf_counter() - t0) * 1e3))
+                step += 1
+            out[idx] = {"adds": adds, "lat": lat, "n": fc.pmap.n}
+            fc.close()
+        except BaseException as exc:    # surfaced by the parent
+            errs.append(exc)
+
+    threads = [threading.Thread(target=storm, args=(i,))
+               for i in range(n)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    if errs:
+        raise AssertionError(
+            f"{len(errs)} {tag!r} storm thread(s) died; first: "
+            f"{type(errs[0]).__name__}: {errs[0]}") from errs[0]
+    assert all(r.get("adds") for r in out), \
+        f"a {tag!r} storm thread died before its first acked add"
+    return out
+
+
+def _reshard_admin(fleet_file: str, tmpdir: str, tag: str,
+                   mode: str) -> dict:
+    """Run ``python -m multiverso_tpu.server --grow/--shrink`` and
+    parse its one-line JSON summary (the admin's partial-output
+    contract: every exit path prints one)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    cmd = [sys.executable, "-m", "multiverso_tpu.server",
+           f"--{mode}", "--fleet-file", fleet_file,
+           "--address",
+           "unix:" + os.path.join(tmpdir, f"fl-{tag}.sock"),
+           "--name", f"fleet-{tag}"]
+    res = subprocess.run(cmd, env=env, cwd=REPO, text=True,
+                         capture_output=True,
+                         timeout=LANE_TIMEOUT_S)
+    summary = {}
+    for ln in (res.stdout or "").strip().splitlines()[::-1]:
+        try:
+            summary = json.loads(ln)
+            break
+        except ValueError:
+            continue
+    if res.returncode != 0 or not summary.get("ok"):
+        raise SystemExit(
+            f"serving_mp: --{mode} admin failed rc={res.returncode} "
+            f"summary={summary} stderr={res.stderr[-800:]}")
+    return summary
+
+
+def _percentile(lats_ms: List[float], q: float) -> float:
+    return float(np.percentile(np.asarray(lats_ms, np.float64), q)) \
+        if lats_ms else 0.0
+
+
+def _reshard_moved_gate(partition_mod, old_n: int, new_n: int,
+                        from_v: int, to_v: int,
+                        moved_bytes: int) -> Tuple[int, int]:
+    """moved_bytes must be the CLOSED-FORM moved set: exactly the
+    MapDiff dense ranges (4 bytes/elt), plus at most every KV row
+    (8-byte key + dim floats) — never O(table)."""
+    old = partition_mod.PartitionMap(old_n, version=from_v)
+    new = partition_mod.PartitionMap(new_n, version=to_v)
+    diff = partition_mod.map_diff(old, new)
+    dense = diff.moved_dense(RESHARD["size"]) * 4
+    kv_upper = RESHARD["kv_keys"] * (8 + RESHARD["kv_dim"] * 4)
+    assert dense <= moved_bytes <= dense + kv_upper, \
+        f"moved {moved_bytes} bytes; closed form says " \
+        f"[{dense}, {dense + kv_upper}] — the migration is not " \
+        "moved-bytes-proportional"
+    return dense, kv_upper
+
+
+def _reshard_run(line: Dict[str, object], tmpdir: str) -> None:
+    router = _load_router()
+    partition_mod = router.partition
+    tag = "rs"
+    line["reshard_stage"] = "start"
+    proc, fleet_file, _doc = _start_fleet(tmpdir, tag, 2)
+    joined_pid = None
+    try:
+        # seed the KV table (migrates by bucket segment) and warm the
+        # dense table's creation before any storm
+        fc = router.connect_fleet_file(fleet_file, client="rs-seed",
+                                       quant=None)
+        kv = fc.create_kv("kv_rs", RESHARD["kv_capacity"],
+                          value_dim=RESHARD["kv_dim"],
+                          updater="default")
+        keys = reshard_kv_keys()
+        kv_vals = reshard_kv_vals(keys)
+        kv.add(keys, kv_vals, sync=True)
+        fc.create_array("w_rs", RESHARD["size"], updater="default")
+        fc.close()
+
+        # -- quiet baseline: same storm, no reshard ---------------------
+        line["reshard_stage"] = "quiet"
+        quiet = _reshard_storm(router, fleet_file, "quiet",
+                               steps=RESHARD["quiet_steps"])
+        quiet_lats = [ms for r in quiet for _, ms in r["lat"]]
+        quiet_p99 = _percentile(quiet_lats, 99.0)
+        line["reshard_quiet_p99_ms"] = round(quiet_p99, 3)
+
+        # -- the grow, under storm --------------------------------------
+        line["reshard_stage"] = "grow"
+        stop = threading.Event()
+        storm_out: List[dict] = []
+        storm_err: List[BaseException] = []
+
+        def run_storm() -> None:
+            try:
+                storm_out.extend(_reshard_storm(
+                    router, fleet_file, "storm", stop=stop))
+            except BaseException as exc:
+                storm_err.append(exc)
+
+        storm_th = threading.Thread(target=run_storm)
+        storm_th.start()
+        try:
+            summary = _reshard_admin(fleet_file, tmpdir, tag, "grow")
+        except BaseException:
+            stop.set()
+            storm_th.join()
+            raise
+        t_flip = time.perf_counter()
+        time.sleep(RESHARD["recover_s"])   # post-flip recovery window
+        stop.set()
+        storm_th.join()
+        if storm_err:
+            raise storm_err[0]
+        joined_pid = summary.get("joined_pid")
+
+        storm_lats = [ms for r in storm_out for _, ms in r["lat"]]
+        recover = [ms for r in storm_out for t_done, ms in r["lat"]
+                   if t_done >= t_flip]
+        line.update({
+            "reshard_elapsed_s": summary.get("elapsed_s"),
+            "reshard_moved_bytes": summary.get("moved_bytes"),
+            "reshard_chunks": summary.get("chunks"),
+            "reshard_forwards": summary.get("forwards"),
+            "reshard_p999_stall_ms": round(
+                _percentile(storm_lats, 99.9), 3),
+            "reshard_recover_p99_ms": round(
+                _percentile(recover, 99.0), 3),
+            "reshard_storm_adds": sum(r["adds"] for r in storm_out),
+        })
+        moved = int(summary.get("moved_bytes") or 0)
+        line["reshard_moved_mb_per_sec"] = round(
+            moved / 2**20 / max(float(summary.get("elapsed_s") or 0),
+                                1e-9), 2)
+
+        # -- gates ------------------------------------------------------
+        line["reshard_stage"] = "gates"
+        # every storm router ended re-split onto the 3-member map
+        assert all(r["n"] == 3 for r in storm_out), \
+            f"a storm router never re-split: {[r['n'] for r in storm_out]}"
+        # moved bytes match the closed form
+        dense_moved, _ = _reshard_moved_gate(
+            partition_mod, 2, 3, int(summary["from_version"]),
+            int(summary["to_version"]), moved)
+        line["reshard_moved_bytes_closed_form_dense"] = dense_moved
+        # p99 recovers after the flip
+        assert recover, "no storm step completed after the flip"
+        gate = max(quiet_p99 * RESHARD_RECOVER_RATIO,
+                   RESHARD_STALL_FLOOR_MS)
+        assert line["reshard_recover_p99_ms"] <= gate, \
+            f"post-flip p99 {line['reshard_recover_p99_ms']}ms never " \
+            f"recovered (gate {gate:.1f}ms; " \
+            "MVTPU_RESHARD_RECOVER_RATIO overrides)"
+        # bit-exactness across the flip: quiet + storm adds, counted
+        # per thread, exactly once each — plus the seeded KV rows
+        line["reshard_stage"] = "score"
+        expected = np.zeros(RESHARD["size"], np.float32)
+        for idx in range(RESHARD["storm_threads"]):
+            n_adds = quiet[idx]["adds"] + storm_out[idx]["adds"]
+            expected += n_adds * reshard_delta(idx)
+        fc = router.connect_fleet_file(fleet_file, client="rs-score",
+                                       quant=None)
+        assert fc.pmap.n == 3, f"fleet file still lists n={fc.pmap.n}"
+        t = fc.create_array("w_rs", RESHARD["size"],
+                            updater="default")
+        got = t.get()
+        assert got.tobytes() == expected.tobytes(), \
+            "post-grow table != exact acked-adds expectation — a " \
+            "write was lost or double-applied across the flip"
+        kv = fc.create_kv("kv_rs", RESHARD["kv_capacity"],
+                          value_dim=RESHARD["kv_dim"],
+                          updater="default")
+        got_vals, found = kv.get(keys)
+        assert found.all(), \
+            f"{int((~found).sum())} KV keys lost in the grow"
+        assert got_vals.tobytes() == kv_vals.tobytes(), \
+            "post-grow KV values != seeded values"
+        fc.close()
+
+        # -- shrink back to 2, quiet (writers drained first: frames
+        # in flight at the evicted member's shutdown are the same
+        # at-least-once ambiguity as any crash without replicas)
+        line["reshard_stage"] = "shrink"
+        summary = _reshard_admin(fleet_file, tmpdir, tag, "shrink")
+        joined_pid = None       # the shrink retired the joined member
+        line.update({
+            "shrink_elapsed_s": summary.get("elapsed_s"),
+            "shrink_moved_bytes": summary.get("moved_bytes"),
+        })
+        _reshard_moved_gate(
+            partition_mod, 3, 2, int(summary["from_version"]),
+            int(summary["to_version"]),
+            int(summary.get("moved_bytes") or 0))
+        fc = router.connect_fleet_file(fleet_file, client="rs-score2",
+                                       quant=None)
+        assert fc.pmap.n == 2
+        t = fc.create_array("w_rs", RESHARD["size"],
+                            updater="default")
+        assert t.get().tobytes() == expected.tobytes(), \
+            "post-shrink table != expectation — the evicted share " \
+            "was lost or double-applied"
+        kv = fc.create_kv("kv_rs", RESHARD["kv_capacity"],
+                          value_dim=RESHARD["kv_dim"],
+                          updater="default")
+        got_vals, found = kv.get(keys)
+        assert found.all() and got_vals.tobytes() == kv_vals.tobytes()
+        fc.close()
+        line["value"] = line["reshard_moved_mb_per_sec"]
+    finally:
+        if joined_pid:
+            try:
+                os.kill(int(joined_pid), signal.SIGTERM)
+            except OSError:
+                pass
+        _stop_server(proc)
+
+
+def reshard_main() -> None:
+    """``--reshard``: the elastic-fleet lane (``make reshard-smoke``).
+    Same partial-JSON contract as the flood/fleet/replica lanes."""
+    line: Dict[str, object] = {
+        "metric": "reshard_moved_mb_per_sec",
+        "value": -1.0,          # -1 = not measured (partial give-up)
+        "unit": "MB/s",
+        "tiny": TINY,
+        "partial": True,
+        "reshard_recover_ratio_gate": RESHARD_RECOVER_RATIO,
+    }
+    try:
+        with tempfile.TemporaryDirectory(
+                prefix="mvtpu_reshard_") as tmpdir:
+            _reshard_run(line, tmpdir)
+    except BaseException as e:
+        line["giveup"] = f"{type(e).__name__}: {e}"
+        _emit_reshard(line)
+        raise
+    line["partial"] = False
+    line.pop("reshard_stage", None)
+    _emit_reshard(line)
+
+
 def main() -> None:
     x, y = make_dataset()
     transport = _load_transport()
@@ -1757,6 +2096,11 @@ if __name__ == "__main__":
                              "follower-routed reads vs the primary "
                              "baseline, plus the SIGKILL-primary "
                              "failover gate")
+    parser.add_argument("--reshard", action="store_true",
+                        help="run the elastic-fleet lane: grow 2->3 "
+                             "under a write storm (bit-exact, "
+                             "moved-bytes closed form, p99 recovery) "
+                             "then shrink back")
     parser.add_argument("--address")
     parser.add_argument("--lane", default="dense")
     parser.add_argument("--mode", default="train",
@@ -1793,5 +2137,7 @@ if __name__ == "__main__":
         fleet_main(args.servers)
     elif args.replicas:
         replica_main()
+    elif args.reshard:
+        reshard_main()
     else:
         main()

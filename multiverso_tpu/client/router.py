@@ -69,6 +69,19 @@ client-side behaviours on top, both read-path-only by construction:
   not refused. In-flight mutations that already sat in the pending
   window are NOT resubmitted — the rebind replay is their redelivery.
 
+**Elastic fleet (live resharding).** A reshard (``--grow``/``--shrink``)
+bumps the map v→v+1 with a DIFFERENT n. Committed members answer
+old-map reads with a structured ``remap`` refusal (carrying the new
+map) and RELAY old-map writes — applied locally where retained,
+forwarded to the new owner, exactly-once via the origin dedup — so
+nothing is lost while this router catches up. On the first ``remap``
+(or a hello refusal claiming a different n) the router re-reads the
+fleet file with jittered backoff (an N-worker fleet must not
+thundering-herd the file at the flip), rebinds surviving rank clients
+under the new claim, dials joining ranks, drops evicted ones, re-splits
+every fleet table's bounds, and retries the interrupted operation under
+the new ownership.
+
 jax-free and file-path loadable (:func:`load_router`) like the
 transport — this is worker-process code.
 """
@@ -76,6 +89,7 @@ transport — this is worker-process code.
 from __future__ import annotations
 
 import os
+import random
 import sys
 import threading
 import time
@@ -124,6 +138,11 @@ _REFUSED = transport.wire.WireProtocolError
 #: how long a follower stays benched after a hard (transport) miss
 #: before reads probe it again
 _REPLICA_RETRY_S = 5.0
+
+
+class _Remapped(Exception):
+    """Internal: the fleet changed SHAPE (n) under this operation; the
+    tables were re-split — re-run the whole op under the new map."""
 
 
 def _count(name: str, n: float = 1, **labels) -> None:
@@ -241,6 +260,27 @@ class _FleetTable:
         for buf in self._coalescers:
             buf.flush()
 
+    def _resplit(self) -> None:
+        """Rebind this table to the fleet's CURRENT client list after
+        a reshard: one subtable per new rank (same table id — forced-
+        tid manifests keep every member's id space aligned), bounds
+        recomputed by the subclass."""
+        head = self.subs[0]
+        self.subs = [_clone_sub(head, c) for c in self.fleet.clients]
+
+    def _retry_remap(self, thunk: Any) -> Any:
+        """Run one whole-table op, re-running it when a reshard
+        re-split the table underneath it (bounded — a second flip
+        mid-retry is a second re-split, not a loop)."""
+        for _ in range(3):
+            try:
+                return thunk()
+            except _Remapped:
+                _count("fleet.reshard.resplit", table=self.name)
+        raise RuntimeError(
+            f"fleet table {self.name!r}: partition map kept moving "
+            "across 3 re-splits — aborting this op")
+
     def wait(self) -> None:
         for rank in range(len(self.subs)):
             self.fleet._guard_drain(rank)
@@ -262,8 +302,12 @@ class _FleetTable:
                 return out
             except transport.RemoteError as exc:
                 header = getattr(exc, "header", None) or {}
-                if not (header.get("stale") or header.get("follower")):
+                if not (header.get("stale") or header.get("follower")
+                        or header.get("remap")):
                     raise       # a real application error, not routing
+                # remap: the follower committed a reshard this router
+                # hasn't seen — the primary leg will refuse too and
+                # drive the re-split through the guard
                 fleet._replica_miss(rank, soft=True)
             except (_REFUSED,) + _DEAD:
                 fleet._replica_miss(rank, soft=False)
@@ -284,15 +328,21 @@ class FleetArrayTable(_FleetTable):
         self.num_cols = 1
         self._bounds = fleet.pmap.dense_bounds(self.size)
 
+    def _resplit(self) -> None:
+        super()._resplit()
+        self._bounds = self.fleet.pmap.dense_bounds(self.size)
+
     def get(self, staleness: Optional[int] = None) -> np.ndarray:
         """Whole-table scatter-gather: each server returns its shard
         concurrently; concat in rank order is the inverse map (the
         zero-index-math payoff of contiguous ownership)."""
-        with _trace.request("fleet.get", table=self.name):
+        def attempt():
             parts = self.fleet._fanout(
                 [lambda r=r: self._shard_get(r, staleness=staleness)
                  for r in range(len(self.subs))])
             return np.concatenate(parts)
+        with _trace.request("fleet.get", table=self.name):
+            return self._retry_remap(attempt)
 
     def get_range(self, lo: int, hi: int,
                   staleness: Optional[int] = None) -> np.ndarray:
@@ -305,19 +355,22 @@ class FleetArrayTable(_FleetTable):
         if not 0 <= lo < hi <= self.size:
             raise ValueError(
                 f"range [{lo}, {hi}) out of bounds for size {self.size}")
-        b = self._bounds
-        ranks = [r for r in range(self.pmap.n)
-                 if b[r] < hi and b[r + 1] > lo]
-        with _trace.request("fleet.get_range", table=self.name,
-                            lo=lo, hi=hi):
+
+        def attempt():
+            b = self._bounds
+            ranks = [r for r in range(self.pmap.n)
+                     if b[r] < hi and b[r + 1] > lo]
             parts = self.fleet._fanout(
                 [lambda r=r: self._shard_get(r, staleness=staleness)
                  for r in ranks])
-        if len(parts) == 1:
-            r = ranks[0]
-            return parts[0][lo - b[r]:hi - b[r]]
-        first = ranks[0]
-        return np.concatenate(parts)[lo - b[first]:hi - b[first]]
+            if len(parts) == 1:
+                r = ranks[0]
+                return parts[0][lo - b[r]:hi - b[r]]
+            first = ranks[0]
+            return np.concatenate(parts)[lo - b[first]:hi - b[first]]
+        with _trace.request("fleet.get_range", table=self.name,
+                            lo=lo, hi=hi):
+            return self._retry_remap(attempt)
 
     def add(self, delta, option=None, sync: bool = False) -> FleetHandle:
         """Split the global delta by ownership; each slice is submitted
@@ -328,20 +381,49 @@ class FleetArrayTable(_FleetTable):
             raise ValueError(
                 f"fleet add to {self.name!r} expects shape "
                 f"({self.size},), got {delta.shape}")
-        b = self._bounds
+        subs, b = list(self.subs), self._bounds
+        handles, ranks = [], []
         with _trace.request("fleet.add", table=self.name):
-            handles = [
-                self.fleet._guard_add(
-                    r, lambda sub=sub, lo=b[r], hi=b[r + 1]:
-                    sub.add(delta[lo:hi], option))
-                for r, sub in enumerate(self.subs)]
-        handle = FleetHandle(handles, self.fleet,
-                             range(len(self.subs)))
+            for r, sub in enumerate(subs):
+                try:
+                    handles.append(self.fleet._guard_add(
+                        r, lambda sub=sub, lo=b[r], hi=b[r + 1]:
+                        sub.add(delta[lo:hi], option)))
+                    ranks.append(r)
+                except _Remapped:
+                    # the fleet changed shape under this op and rank
+                    # r's slice never landed (its member is gone):
+                    # redistribute JUST that slice by the new bounds —
+                    # slices already submitted to survivors are relayed
+                    # server-side, resubmitting them would double-apply
+                    _count("fleet.reshard.resplit", table=self.name)
+                    for r2, h2 in self._readd_range(
+                            delta, b[r], b[r + 1], option):
+                        handles.append(h2)
+                        ranks.append(r2)
+        handle = FleetHandle(handles, self.fleet, ranks)
         if sync:
             handle.wait()
         return handle
 
     add_async = add
+
+    def _readd_range(self, delta: np.ndarray, glo: int, ghi: int,
+                     option) -> List[Tuple[int, Any]]:
+        """Submit global elements [glo, ghi) of ``delta`` by CURRENT
+        ownership, zero-padded to each new owner's full local range."""
+        b = self._bounds
+        out = []
+        for r in range(self.pmap.n):
+            lo, hi = max(glo, b[r]), min(ghi, b[r + 1])
+            if lo >= hi:
+                continue
+            local = np.zeros(b[r + 1] - b[r], self.dtype)
+            local[lo - b[r]: hi - b[r]] = delta[lo:hi]
+            out.append((r, self.fleet._guard_add(
+                r, lambda sub=self.subs[r], d=local:
+                sub.add(d, option))))
+        return out
 
 
 class FleetKVTable(_FleetTable):
@@ -372,19 +454,22 @@ class FleetKVTable(_FleetTable):
         caller's key order via the inverse index."""
         keys = np.ascontiguousarray(np.asarray(keys, np.uint64))
         n = keys.shape[0]
-        shape = (n, self.value_dim) if self.value_dim else (n,)
-        values = np.zeros(shape, self.dtype)
-        found = np.zeros(n, bool)
-        routed = self._route(keys)
-        with _trace.request("fleet.kv_get", table=self.name):
+
+        def attempt():
+            shape = (n, self.value_dim) if self.value_dim else (n,)
+            values = np.zeros(shape, self.dtype)
+            found = np.zeros(n, bool)
+            routed = self._route(keys)
             replies = self.fleet._fanout(
                 [lambda r=r, idx=idx: self._shard_get(
                     r, keys[idx], staleness=staleness)
                  for r, idx in routed])
-        for (r, idx), (vals, fnd) in zip(routed, replies):
-            values[idx] = vals
-            found[idx] = fnd
-        return values, found
+            for (r, idx), (vals, fnd) in zip(routed, replies):
+                values[idx] = vals
+                found[idx] = fnd
+            return values, found
+        with _trace.request("fleet.kv_get", table=self.name):
+            return self._retry_remap(attempt)
 
     def add(self, keys, deltas, option=None,
             sync: bool = False) -> FleetHandle:
@@ -397,6 +482,7 @@ class FleetKVTable(_FleetTable):
         handles = []
         ranks = []
         with _trace.request("fleet.kv_add", table=self.name):
+            subs = list(self.subs)
             for r, idx in self._route(keys):
                 sub_keys = keys[idx]
                 sub_deltas = deltas[idx]
@@ -407,10 +493,24 @@ class FleetKVTable(_FleetTable):
                         sub_deltas.dtype)
                     np.add.at(acc, inv, sub_deltas)
                     sub_keys, sub_deltas = uniq, acc
-                handles.append(self.fleet._guard_add(
-                    r, lambda r=r, k=sub_keys, d=sub_deltas:
-                    self.subs[r].add(k, d, option)))
-                ranks.append(r)
+                try:
+                    handles.append(self.fleet._guard_add(
+                        r, lambda sub=subs[r], k=sub_keys,
+                        d=sub_deltas: sub.add(k, d, option)))
+                    ranks.append(r)
+                except _Remapped:
+                    # redistribute ONLY this rank's keys by the new
+                    # ownership (survivor submits relay server-side)
+                    _count("fleet.reshard.resplit", table=self.name)
+                    owner = self.pmap.kv_owner(sub_keys)
+                    for r2 in np.unique(owner):
+                        sel = owner == r2
+                        handles.append(self.fleet._guard_add(
+                            int(r2),
+                            lambda sub=self.subs[int(r2)],
+                            k=sub_keys[sel], d=sub_deltas[sel]:
+                            sub.add(k, d, option)))
+                        ranks.append(int(r2))
         handle = FleetHandle(handles, self.fleet, ranks)
         if sync:
             handle.wait()
@@ -454,6 +554,9 @@ class FleetClient:
         self._deadline_s = deadline_s
         self._fleet_file = fleet_file
         self._scheme = scheme
+        self._quant = quant
+        self._seed = seed
+        self._tables: List[_FleetTable] = []
         # one client per member: its OWN pipeline window, dedup stream,
         # residual store, and reconnect/replay loop — shard isolation
         # on the client side mirrors process isolation on the server's
@@ -480,7 +583,8 @@ class FleetClient:
         self._replica_subs: Dict[Tuple[int, int], Any] = {}
         self._replica_down: Dict[int, float] = {}
         self._rlock = threading.Lock()
-        self._folock = threading.Lock()
+        # reentrant: _recover may escalate to _restructure (reshard)
+        self._folock = threading.RLock()
         reads_on = os.environ.get(
             "MVTPU_REPLICA_READS", "1").strip().lower() \
             not in ("0", "false", "off", "no")
@@ -614,13 +718,23 @@ class FleetClient:
 
     def _guard(self, rank: int, thunk: Any) -> Any:
         """Run a shard request; on a dead-peer fault or a newer-map
-        hello refusal, recover the rank (promotion or adoption) and
-        re-run it once. Application errors pass through untouched."""
+        hello refusal, recover the rank (promotion, adoption, or — on
+        a shape change — a full re-split, surfaced as ``_Remapped`` so
+        the table re-runs the whole op) and re-run it once.
+        Application errors pass through untouched — except a reshard
+        ``remap`` refusal, which IS the re-split trigger."""
         try:
             return thunk()
+        except transport.RemoteError as exc:
+            if not self._maybe_remap(exc):
+                raise
+            raise _Remapped() from exc
         except (_REFUSED,) + _DEAD as exc:
+            n0 = self.pmap.n
             if not self._recover(rank, exc):
                 raise
+            if self.pmap.n != n0 or rank >= len(self.clients):
+                raise _Remapped() from exc
             return thunk()
 
     def _guard_add(self, rank: int, thunk: Any) -> Any:
@@ -628,12 +742,21 @@ class FleetClient:
         frame already sits in the rank client's pending window, so
         re-running the thunk would double-submit it under a fresh rid;
         the rebind replay is the redelivery — hand back a handle over
-        the surviving window instead."""
+        the surviving window instead. A shape-change recovery raises
+        ``_Remapped``: the rank may not exist any more, the table
+        redistributes the slice."""
         try:
             return thunk()
+        except transport.RemoteError as exc:
+            if not self._maybe_remap(exc):
+                raise
+            raise _Remapped() from exc
         except (_REFUSED,) + _DEAD as exc:
+            n0 = self.pmap.n
             if not self._recover(rank, exc):
                 raise
+            if self.pmap.n != n0 or rank >= len(self.clients):
+                raise _Remapped() from exc
             c = self.clients[rank]
             rid = c._pending[-1].rid if c._pending else c._acked_rid
             return transport.RemoteHandle(c, rid)
@@ -641,18 +764,138 @@ class FleetClient:
     def _guard_wait(self, rank: int, handle: Any) -> None:
         try:
             handle.wait()
+        except transport.RemoteError as exc:
+            if not self._maybe_remap(exc):
+                raise
+            # resharded mid-wait: survivors' windows replayed at the
+            # rebind; an evicted rank's acked writes were relayed
         except (_REFUSED,) + _DEAD as exc:
             if not self._recover(rank, exc):
                 raise
+            if rank >= len(self.clients):
+                return
             handle.wait()
 
     def _guard_drain(self, rank: int) -> None:
+        if rank >= len(self.clients):
+            return      # evicted mid-wait by a reshard
         try:
             self.clients[rank].drain()
+        except transport.RemoteError as exc:
+            if not self._maybe_remap(exc):
+                raise
         except (_REFUSED,) + _DEAD as exc:
             if not self._recover(rank, exc):
                 raise
+            if rank >= len(self.clients):
+                return
             self.clients[rank].drain()
+
+    # -- elastic fleet (live resharding) ------------------------------------
+
+    def _maybe_remap(self, exc: BaseException) -> bool:
+        """True iff ``exc`` is a reshard ``remap`` refusal AND the
+        router successfully re-split onto the new map."""
+        header = getattr(exc, "header", None) or {}
+        wmap = header.get("partition")
+        if not header.get("remap") or not isinstance(wmap, dict):
+            return False
+        return self._restructure(int(wmap.get("version", 0)))
+
+    def _refresh_fleet(self, min_version: int) -> Dict[str, Any]:
+        """Re-read the fleet file until it reaches ``min_version``,
+        with JITTERED exponential backoff — at a map flip every worker
+        of an N-worker fleet lands here at once, and the jitter (seeded
+        per client id, so it is deterministic per worker but spread
+        across the fleet) keeps them from thundering-herding the file
+        while the admin's atomic rewrite is still in flight."""
+        if not self._fleet_file:
+            raise RuntimeError(
+                f"fleet resharded to v{min_version} but this client "
+                "was not connected via a fleet file — reconnect with "
+                "connect_fleet_file to follow elastic fleets")
+        tries = int(os.environ.get(
+            "MVTPU_FLEET_REFRESH_TRIES", "") or 12)
+        rng = random.Random(zlib.crc32(self.client_id.encode()))
+        delay = 0.05
+        for attempt in range(tries):
+            doc = partition.read_fleet_file(self._fleet_file)
+            got = int((doc.get("map") or {}).get("version", 0)) \
+                if doc is not None else None
+            if got is not None and got >= min_version:
+                return doc
+            _count("fleet.refresh.retry")
+            time.sleep(delay * (0.5 + rng.random()))
+            delay = min(delay * 2.0, 1.0)
+        raise RuntimeError(
+            f"fleet file {self._fleet_file!r} is still at "
+            f"v{got} after {tries} re-reads but the fleet serves "
+            f"v{min_version}: the reshard's fleet-file flip never "
+            "landed (admin crashed mid-commit?) — raise "
+            "MVTPU_FLEET_REFRESH_TRIES or re-run the reshard")
+
+    def _restructure(self, min_version: int) -> bool:
+        """Swing this router onto a DIFFERENT-SHAPE map (reshard):
+        refresh the fleet file, rebind every surviving rank's client
+        under the new claim (pending windows replay — the members'
+        relay + origin dedup keep that exactly-once), dial joining
+        ranks, drop evicted ones, resize the fan-out pool, and
+        re-split every fleet table."""
+        with self._folock:
+            if self.pmap.version >= min_version:
+                return True     # raced: another thread re-split first
+            doc = self._refresh_fleet(min_version)
+            new = partition.PartitionMap.from_wire(doc["map"])
+            members = sorted(doc.get("members", []),
+                             key=lambda m: int(m.get("rank", 0)))
+            addrs = [_pick_addr(m.get("addresses"), self._scheme)
+                     for m in members]
+            if len(addrs) != new.n or any(a is None for a in addrs):
+                raise RuntimeError(
+                    f"fleet file {self._fleet_file!r} lists "
+                    f"{len(addrs)} member addresses for a map of "
+                    f"{new.n}")
+            claim = new.to_wire()
+            old_n = len(self.clients)
+            for r in range(min(old_n, new.n)):
+                self.clients[r].rebind(addrs[r],
+                                       partition=dict(claim))
+            for c in self.clients[new.n:]:
+                try:    # evicted member: acked writes were relayed
+                    c.abort()
+                except Exception:   # noqa: BLE001
+                    pass
+            self.clients = self.clients[:new.n] + [
+                transport.WireClient(
+                    addrs[r], client=self.client_id,
+                    quant=self._quant,
+                    seed=None if self._seed is None
+                    else int(self._seed) + r,
+                    deadline_s=self._deadline_s,
+                    partition=dict(claim))
+                for r in range(old_n, new.n)]
+            self.pmap = new
+            self._claim = claim
+            # replica routing: follower sets moved with their ranks
+            with self._rlock:
+                dead = list(self._replica_clients.values())
+                self._replica_clients.clear()
+                self._replica_subs.clear()
+                self._replica_down.clear()
+            for c in dead:
+                try:
+                    c.abort()
+                except Exception:   # noqa: BLE001
+                    pass
+            self._replica_addrs = self._replica_addrs_from(doc)
+            old_pool = self._pool
+            self._pool = ThreadPoolExecutor(
+                max_workers=new.n, thread_name_prefix="mvtpu-fleet")
+            old_pool.shutdown(wait=False)
+            for t in self._tables:
+                t._resplit()
+            _count("fleet.reshard.refresh")
+            return True
 
     def _recover(self, rank: int, exc: BaseException) -> bool:
         """Client half of shard failover. Serialized: concurrent shard
@@ -666,6 +909,11 @@ class FleetClient:
             wmap = header.get("partition")
             if isinstance(wmap, dict) \
                     and int(wmap.get("version", 0)) > start_v:
+                if int(wmap.get("n", self.pmap.n)) != self.pmap.n:
+                    # the fleet changed SHAPE (reshard), not just
+                    # leadership: full re-split, not a rank rebind
+                    return self._restructure(
+                        int(wmap.get("version", 0)))
                 # refused BECAUSE someone already failed over: the
                 # refusal carries the new map — adopt, no promote
                 return self._adopt_map(wmap, rank)
@@ -674,6 +922,10 @@ class FleetClient:
             if doc is not None:
                 dmap = doc.get("map") or {}
                 if int(dmap.get("version", 0)) > start_v:
+                    if int(dmap.get("n", self.pmap.n)) \
+                            != self.pmap.n:
+                        return self._restructure(
+                            int(dmap.get("version", 0)))
                     # another worker promoted and rewrote the file
                     return self._adopt_map(dmap, rank, doc=doc)
             if self.pmap.version > start_v:
@@ -802,7 +1054,9 @@ class FleetClient:
                                           updater=updater,
                                           init_value=init_value))
              for r, c in enumerate(self.clients)])
-        return FleetArrayTable(self, subs, size)
+        table = FleetArrayTable(self, subs, size)
+        self._tables.append(table)
+        return table
 
     def create_kv(self, name: str, capacity: int, *, value_dim: int = 0,
                   dtype: str = "float32",
@@ -815,7 +1069,9 @@ class FleetClient:
                                        dtype=dtype, updater=updater,
                                        tiered=tiered))
              for r, c in enumerate(self.clients)])
-        return FleetKVTable(self, subs)
+        table = FleetKVTable(self, subs)
+        self._tables.append(table)
+        return table
 
     # -- fleet plumbing ----------------------------------------------------
 

@@ -552,6 +552,14 @@ class WireClient:
             req = dict(header or {})
             req["op"] = op
             req["rid"] = self._next_rid()
+            if self.partition is not None:
+                # the map version this frame was built against: a
+                # committed reshard uses it to relay old-geometry
+                # writes instead of misapplying them. Stamped once —
+                # resends must claim the ORIGINAL version to hit the
+                # relay path (and its dedup) identically.
+                req.setdefault(
+                    "pv", int(self.partition.get("version", 0) or 0))
             if self.deadline_s:
                 # stamped ONCE: shed/reconnect resends keep the
                 # original expiry (a deadline is end-to-end)
@@ -590,6 +598,9 @@ class WireClient:
             rid = self._next_rid()
             req = dict(header)
             req["rid"] = rid
+            if self.partition is not None:
+                req.setdefault(
+                    "pv", int(self.partition.get("version", 0) or 0))
             if self.deadline_s:
                 wire.stamp_deadline(req, self.deadline_s)
             if wire.trace_enabled():

@@ -114,6 +114,12 @@ SPECS: Dict[str, Knob] = {k.name: k for k in (
           doc="extra generations a cross-process FOLLOWER read may "
               "lag past the client bound before it bounces to the "
               "primary"),
+    _spec("server.migrate.rate", env="MVTPU_MIGRATE_RATE",
+          kind="float", default=0.0, lo=0.0, hi=1e6, step=2.0,
+          mode="mul", owner="server",
+          doc="reshard donor stream rate, chunks/s (0 = unthrottled) "
+              "— the autotuner's reshard-speed vs serving-p999 "
+              "lever"),
     _spec("client.staleness", env="MVTPU_STALENESS", kind="int",
           default=0, lo=0, hi=1024, step=1, owner="client",
           doc="cached-view max staleness, generations"),

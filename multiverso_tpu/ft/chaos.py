@@ -113,6 +113,16 @@ Fault points in the codebase (grep ``chaos_point(`` for ground truth):
                       are contained (logged, the cycle proceeds) —
                       the dispatch thread never dies; ``crash`` still
                       models process death
+``reshard.handoff``   live-reshard handoff (`server/table_server.py`):
+                      fires per streamed migration chunk (donor stream
+                      thread, under the migration lock — an ``error``
+                      fails the stream, the admin sees ``failed`` and
+                      aborts the reshard fleet-wide, v keeps serving)
+                      and per forwarded in-flight write (CONTAINED:
+                      logged only — the forward is already on the
+                      link, and an error reply would be dedup-cached
+                      and replayed to every client resend as a
+                      permanent failure)
 ====================  =====================================================
 
 The injector is process-global and OFF unless installed: fault points

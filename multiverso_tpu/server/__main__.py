@@ -72,6 +72,27 @@ Fleet flags:
     replica set, promoting a follower if the primary dies.
 ``--replica-of RANK`` / ``--replica-idx J``
     internal: follower member mode (set by the launcher).
+``--replicate-to ADDR[,ADDR...]``
+    internal: static follower address override for this member's
+    replication tap (set by ``--grow`` for the joining member, whose
+    followers are not in the fleet file until the reshard commits).
+
+Admin ops (run against a LIVE fleet, addressed by ``--fleet-file``):
+
+``--grow``
+    online reshard v→v+1 with N+1 members: spawn the joining member
+    (rank N; addresses derive from ``--address`` exactly like the
+    launcher, so pass the same base), drive ``migrate_begin`` on every
+    existing member, poll until every donor has streamed its moved
+    ranges, commit donors-first, rewrite the fleet file atomically,
+    and print a one-line JSON summary. On any failure or timeout
+    (``MVTPU_RESHARD_TIMEOUT_S``, default 120) the abort wave rolls
+    every member back to v — the fleet keeps serving throughout.
+``--shrink``
+    the reverse: evict rank N-1 (its ranges stream to the survivors),
+    commit, rewrite the fleet file with N-1 members, linger
+    ``MVTPU_SHRINK_LINGER_S`` (default 2s) so stale clients get their
+    writes relayed + a remap hint, then shut the evicted member down.
 """
 
 from __future__ import annotations
@@ -127,11 +148,15 @@ def _member_main(args, server_cls, partition) -> int:
         member = partition.PartitionMember(pmap, args.fleet_rank)
     core.init()
     follower = args.replica_idx is not None
+    replicate_to = [a.strip() for a
+                    in str(args.replicate_to or "").split(",")
+                    if a.strip()] or None
     server = server_cls(args.address, name=args.name, fuse=args.fuse,
                         qos=args.qos, queue_bound=args.queue,
                         partition=member, fleet_file=args.fleet_file,
                         follower=follower,
-                        replica_idx=args.replica_idx)
+                        replica_idx=args.replica_idx,
+                        replicate_to=replicate_to)
     bound = server.start()
 
     if args.ready_file:
@@ -291,6 +316,289 @@ def _fleet_main(args, partition) -> int:
     return 0 if all(rc == 0 for rc in rcs) else 1
 
 
+def _reshard_summary(ok: bool, **fields) -> int:
+    import json
+    print(json.dumps({"ok": ok, **fields}), flush=True)
+    return 0 if ok else 1
+
+
+def _reshard_main(args, partition, grow: bool) -> int:
+    """Admin driver for one online reshard (``--grow``/``--shrink``):
+    begin on every existing member, poll donors to "shipped", commit
+    donors-first, rewrite the fleet file. Any failure or timeout turns
+    into an abort wave — v keeps serving, bit-exactly."""
+    import json
+
+    from multiverso_tpu.client import transport as _transport
+    from multiverso_tpu.telemetry import trace as _trace
+
+    mode = "grow" if grow else "shrink"
+    fleet_file = args.fleet_file or args.ready_file
+    if not fleet_file:
+        print("--grow/--shrink need --fleet-file", file=sys.stderr)
+        return 2
+    doc = partition.read_fleet_file(fleet_file)
+    if doc is None:
+        print(f"no fleet file at {fleet_file}", file=sys.stderr)
+        return 2
+    old_map = partition.PartitionMap.from_wire(doc["map"])
+    n, v = old_map.n, old_map.version
+    new_n = n + 1 if grow else n - 1
+    if new_n < 1:
+        print(f"cannot shrink a fleet of {n}", file=sys.stderr)
+        return 2
+    r = max(int(old_map.replicas or 1), 1)
+    new_map = partition.PartitionMap(
+        new_n, version=v + 1, kv_buckets=old_map.kv_buckets,
+        replicas=r)
+    rows = sorted(doc.get("members", ()),
+                  key=lambda m: int(m.get("rank", 0)))
+    if len(rows) != n:
+        print(f"fleet file lists {len(rows)} members for a map of "
+              f"{n}", file=sys.stderr)
+        return 2
+    plan = f"{mode}-v{v}to{v + 1}-{os.getpid()}-{int(time.time())}"
+    t0 = time.monotonic()
+    timeout_s = float(
+        os.environ.get("MVTPU_RESHARD_TIMEOUT_S", "") or 120.0)
+
+    # -- grow: spawn the joining member (+ its followers) first, so
+    # donors have somewhere to stream the moment begin lands
+    procs, new_row = [], None
+    addresses = [a.strip() for a in str(args.address).split(",")
+                 if a.strip()]
+    if grow:
+        env = dict(os.environ)
+        env.setdefault("MVTPU_STATUSZ_PORT", "0")
+        fol_addrs = [[_replica_address(a, n, new_n, idx)
+                      for a in addresses] for idx in range(1, r)]
+        specs = [(None, [_rank_address(a, n) for a in addresses])] \
+            + list(zip(range(1, r), fol_addrs))
+        ready_files = []
+        for idx, addrs in specs:
+            tag = f"r{n}" if idx is None else f"r{n}f{idx}"
+            ready = f"{fleet_file}.{tag}.ready"
+            try:
+                os.unlink(ready)
+            except OSError:
+                pass
+            ready_files.append(ready)
+            name = f"{args.name}-{n}" if idx is None \
+                else f"{args.name}-{n}f{idx}"
+            cmd = [sys.executable, "-m", "multiverso_tpu.server",
+                   "--address", ",".join(addrs),
+                   "--name", name, "--ready-file", ready,
+                   "--fleet-rank", str(n), "--fleet-n", str(new_n),
+                   "--fleet-version", str(v + 1),
+                   "--fleet-file", fleet_file,
+                   "--replicas", str(r),
+                   "--kv-buckets", str(old_map.kv_buckets)]
+            if idx is not None:
+                cmd += ["--replica-of", str(n),
+                        "--replica-idx", str(idx)]
+            elif fol_addrs:
+                # the fleet file is still at v (no rank-N row), so the
+                # joining member's tap would latch "no followers" —
+                # hand it its follower addresses explicitly
+                cmd += ["--replicate-to",
+                        ",".join(a[0] for a in fol_addrs)]
+            # the member outlives this admin: detach it from our
+            # stdio too, or a pipe-capturing caller of --grow waits
+            # forever for EOF the daemon never sends
+            mlog = open(f"{fleet_file}.{tag}.log", "ab")
+            try:
+                procs.append(subprocess.Popen(
+                    cmd, env=env, start_new_session=True,
+                    stdin=subprocess.DEVNULL, stdout=mlog,
+                    stderr=mlog))
+            finally:
+                mlog.close()
+        deadline = time.monotonic() + timeout_s
+        ready_parts = []
+        for i, ready in enumerate(ready_files):
+            while not os.path.exists(ready):
+                if procs[i].poll() is not None \
+                        or time.monotonic() > deadline:
+                    for p in procs:
+                        if p.poll() is None:
+                            p.terminate()
+                    return _reshard_summary(
+                        False, op=mode, plan=plan,
+                        error="joining member failed to start",
+                        elapsed_s=round(time.monotonic() - t0, 3))
+                time.sleep(0.02)
+            with open(ready) as f:
+                ready_parts.append(
+                    [p for p in f.read().strip().split(",") if p])
+
+        def _row(i, idx):
+            parts = ready_parts[i]
+            port = next((int(p.split(":", 1)[1]) for p in parts
+                         if p.startswith("statusz:")), None)
+            return {"name": f"{args.name}-{n}" if idx is None
+                    else f"{args.name}-{n}f{idx}",
+                    "addresses": [p for p in parts
+                                  if not p.startswith("statusz:")],
+                    "statusz_port": port, "pid": procs[i].pid}
+        new_row = _row(0, None)
+        new_row.update(rank=n, replicas=[
+            dict(_row(i, idx), idx=idx)
+            for i, (idx, _a) in enumerate(specs) if idx is not None])
+
+    # recipients every donor may dial: all ranks of the NEW map
+    member_addrs = {int(m["rank"]): str(m["addresses"][0])
+                    for m in rows if int(m["rank"]) < new_n}
+    if new_row is not None:
+        member_addrs[n] = str(new_row["addresses"][0])
+
+    links = {}
+
+    def _link(rank, addr):
+        if rank not in links:
+            links[rank] = _transport.WireClient(
+                addr, client="reshard-admin", quant=None)
+        return links[rank]
+
+    def _close_all():
+        for c in links.values():
+            try:
+                c.close()
+            except Exception:   # noqa: BLE001
+                pass
+
+    def _abort(reason, states=None):
+        for m in rows:
+            try:
+                _link(int(m["rank"]), str(m["addresses"][0])).call(
+                    "migrate_abort", {"plan": plan, "reason": reason})
+            except Exception:   # noqa: BLE001 — best-effort rollback
+                pass
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        _close_all()
+        return _reshard_summary(
+            False, op=mode, plan=plan, error=reason,
+            states=states or {},
+            elapsed_s=round(time.monotonic() - t0, 3))
+
+    with _trace.request(f"reshard.{mode}", plan=plan,
+                        from_version=v, to_version=v + 1):
+        # -- begin wave (existing members only: the joining member is
+        # born at v+1 and learns its tables from donor manifests)
+        donors = set()
+        for m in rows:
+            rank = int(m["rank"])
+            try:
+                reply, _ = _link(rank, str(m["addresses"][0])).call(
+                    "migrate_begin",
+                    {"plan": plan, "map": new_map.to_wire(),
+                     "members": member_addrs})
+            except Exception as exc:    # noqa: BLE001
+                return _abort(f"begin at rank {rank} failed: {exc}")
+            if reply.get("donor"):
+                donors.add(rank)
+
+        # -- poll donors until every moved range is streamed
+        deadline = time.monotonic() + timeout_s
+        while True:
+            states = {}
+            for m in rows:
+                rank = int(m["rank"])
+                try:
+                    st, _ = _link(rank,
+                                  str(m["addresses"][0])).call(
+                        "migrate_state", {"plan": plan})
+                except Exception as exc:    # noqa: BLE001
+                    return _abort(
+                        f"state poll at rank {rank} failed: {exc}")
+                states[rank] = st
+            if any(s.get("state") in ("failed", "aborted")
+                   for s in states.values()):
+                bad = {r_: s for r_, s in states.items()
+                       if s.get("state") in ("failed", "aborted")}
+                return _abort(
+                    "stream failed: " + "; ".join(
+                        f"rank {r_}: {s.get('error')}"
+                        for r_, s in bad.items()),
+                    {r_: s.get("state")
+                     for r_, s in states.items()})
+            if all(states[r_].get("state") == "shipped"
+                   for r_ in states):
+                break
+            if time.monotonic() > deadline:
+                return _abort(
+                    f"reshard timed out after {timeout_s}s",
+                    {r_: s.get("state") for r_, s in states.items()})
+            time.sleep(0.05)
+        moved_bytes = sum(int(s.get("moved_bytes") or 0)
+                          for s in states.values())
+        chunks = sum(int(s.get("chunks") or 0)
+                     for s in states.values())
+        forwards = sum(int(s.get("forwards") or 0)
+                       for s in states.values())
+
+        # -- commit wave: donors FIRST (sequential — each donor drains
+        # its links under the migration lock before flipping), then
+        # the rest, then the joining member if it staged anything
+        order = [r_ for r_ in sorted(states) if r_ in donors] \
+            + [r_ for r_ in sorted(states) if r_ not in donors]
+        for rank in order:
+            try:
+                reply, _ = _link(
+                    rank, member_addrs.get(
+                        rank, str(rows[rank]["addresses"][0]))).call(
+                    "migrate_commit", {"plan": plan})
+            except Exception as exc:    # noqa: BLE001
+                return _abort(f"commit at rank {rank} failed: {exc}")
+            if not reply.get("ok"):
+                return _abort(f"commit at rank {rank} refused: "
+                              f"{reply.get('error')}")
+        if grow:
+            try:
+                c = _link(n, member_addrs[n])
+                st, _ = c.call("migrate_state", {"plan": plan})
+                if st.get("state") not in ("idle",):
+                    c.call("migrate_commit", {"plan": plan})
+            except Exception as exc:    # noqa: BLE001
+                return _abort(f"commit at joining rank failed: "
+                              f"{exc}")
+
+    # -- flip the fleet file atomically to v+1
+    if grow:
+        members = rows + [new_row]
+    else:
+        members = [m for m in rows if int(m["rank"]) < new_n]
+    partition.write_fleet_file(fleet_file, new_map, members)
+
+    evicted_pid = None
+    if not grow:
+        # linger so stale clients hit the relay path (their writes
+        # forward to the survivors + they get the remap hint), then
+        # retire the evicted member and its followers
+        time.sleep(float(
+            os.environ.get("MVTPU_SHRINK_LINGER_S", "") or 2.0))
+        ev = rows[-1]
+        evicted_pid = ev.get("pid")
+        for addr in [str(ev["addresses"][0])] + [
+                str(rep["addresses"][0])
+                for rep in ev.get("replicas", ())
+                if rep.get("addresses")]:
+            try:
+                _transport.WireClient(
+                    addr, client="reshard-admin",
+                    quant=None).call("shutdown", {})
+            except Exception:   # noqa: BLE001 — already gone is fine
+                pass
+    _close_all()
+    return _reshard_summary(
+        True, op=mode, plan=plan, from_version=v, to_version=v + 1,
+        n_from=n, n_to=new_n, moved_bytes=moved_bytes, chunks=chunks,
+        forwards=forwards, evicted_pid=evicted_pid,
+        joined_pid=procs[0].pid if procs else None,
+        elapsed_s=round(time.monotonic() - t0, 3))
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m multiverso_tpu.server",
@@ -311,10 +619,15 @@ def main(argv=None) -> int:
     parser.add_argument("--replicas", type=int, default=1)
     parser.add_argument("--replica-of", type=int, default=None)
     parser.add_argument("--replica-idx", type=int, default=None)
+    parser.add_argument("--replicate-to", default=None)
+    parser.add_argument("--grow", action="store_true")
+    parser.add_argument("--shrink", action="store_true")
     args = parser.parse_args(argv)
 
     from multiverso_tpu.server import partition
 
+    if args.grow or args.shrink:
+        return _reshard_main(args, partition, grow=bool(args.grow))
     if args.fleet:
         return _fleet_main(args, partition)
 

@@ -90,9 +90,16 @@ QUEUE_ENV = "MVTPU_SERVER_QUEUE"
 #: replication plane rides here too: ``repl`` frames must keep their
 #: stream order (a shed-then-resent repl create racing a later repl
 #: add would misapply), and ``promote``/``adopt`` are the failover
-#: path — exactly when the fleet is least healthy.
+#: path — exactly when the fleet is least healthy. The reshard plane
+#: (``migrate_*``) joins for the same ordering reason: a donor's
+#: chunk→forward sequence on one link must apply in link order at the
+#: recipient — a shed-then-resent chunk overtaking a forward would
+#: resurrect the pre-forward bytes (lost update).
 CONTROL_OPS = ("hello", "ping", "stats", "shutdown",
-               "repl", "promote", "adopt")
+               "repl", "promote", "adopt",
+               "migrate_begin", "migrate_state", "migrate_commit",
+               "migrate_abort", "migrate_manifest", "migrate_chunk",
+               "migrate_fwd", "migrate_fin")
 
 #: ops whose shed flips the server into degraded mode (reads are
 #: diverted to replicas while WRITES are being shed)

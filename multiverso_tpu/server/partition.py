@@ -16,18 +16,22 @@ Ownership is **contiguous blocks**, the same invariant
   ranges — rank r owns ``[r*size//n, (r+1)*size//n)`` — so a scatter
   is a plain slice and a gather a plain concat, both zero-index-math;
 - a KV key hashes (splitmix64, the table layer's own mix) into a
-  fleet-wide **logical bucket space** of ``kv_buckets`` buckets (a
-  multiple of n, fixed at map creation), and rank r owns the
-  contiguous block ``[r*bps, (r+1)*bps)`` — the bucket→shard rule
-  ``KVTable`` already uses for its model-axis shards, lifted one
-  level up to processes.
+  fleet-wide **logical bucket space** of ``kv_buckets`` buckets
+  (fixed at map creation and held FIXED across reshards, so keys
+  never re-hash), and rank r owns the contiguous floor-division
+  block ``[r*kv_buckets//n, (r+1)*kv_buckets//n)`` — the same split
+  rule as the dense bounds, and bit-identical to the historical
+  equal-block rule whenever ``kv_buckets % n == 0`` (true for every
+  map the launcher ever wrote).
 
-Contiguity is not an aesthetic: it is the substrate ROADMAP item 3's
-live resharding assumes — moving ownership is "reassign a range, bump
-``version``", and the version handshake below is what makes a stale
-map refuse loudly instead of silently mis-routing. Every server
-process checks the client's claimed ``(n, version, kv_buckets)`` at
-``hello`` and refuses a mismatch before any data op flows.
+Contiguity is not an aesthetic: it is the substrate live resharding
+(:func:`map_diff`) stands on — moving ownership v→v+1 is "reassign a
+range, bump ``version``", the moved ranges are closed-form interval
+intersections of the old and new bounds, and the version handshake
+below is what makes a stale map refuse loudly instead of silently
+mis-routing. Every server process checks the client's claimed
+``(n, version, kv_buckets)`` at ``hello`` and refuses a mismatch
+before any data op flows.
 
 jax-free BY DESIGN (stdlib + numpy + the numpy-only hashing module):
 the client router runs in bare worker processes, and the fleet-statusz
@@ -68,9 +72,10 @@ def _dep(modname: str, *relpath: str):
 
 hashing = _dep("multiverso_tpu.tables.hashing", "tables", "hashing.py")
 
-#: logical KV bucket space floor; the map rounds it UP to a multiple of
-#: ``n`` so every rank owns an equal contiguous block. Plenty of
-#: granularity for item 3's range moves without bloating the map.
+#: logical KV bucket space floor. Plenty of granularity for reshard
+#: range moves without bloating the map; held fixed across v→v+1 so a
+#: grow/shrink never re-hashes keys — only contiguous bucket ranges
+#: change hands.
 DEFAULT_KV_BUCKETS = 8192
 
 #: hello/statusz wire fields of a partition claim; ``replicas`` joined
@@ -105,8 +110,11 @@ class PartitionMap:
         self.n = n
         self.version = int(version)
         self.replicas = replicas
-        # round UP to a multiple of n: equal contiguous blocks per rank
-        self.kv_buckets = -(-base // n) * n
+        # NOT rounded to a multiple of n: ownership is floor-division
+        # bounds (kv_bounds), so any kv_buckets >= n splits cleanly —
+        # the invariant that lets a reshard keep the bucket space
+        # fixed while n changes (keys never re-hash)
+        self.kv_buckets = base
 
     # -- dense ownership ---------------------------------------------------
 
@@ -129,7 +137,18 @@ class PartitionMap:
 
     @property
     def buckets_per_rank(self) -> int:
+        """Floor of the per-rank bucket share. With floor-division
+        bounds ranks may own this or this+1 buckets; kept as the
+        capacity-sizing heuristic and for the historical name."""
         return self.kv_buckets // self.n
+
+    def kv_bounds(self) -> List[int]:
+        """N+1 offsets into the logical bucket space: rank r owns
+        buckets [bounds[r], bounds[r+1]). Same floor-division rule as
+        :meth:`dense_bounds` — balanced to within one bucket, covering,
+        disjoint, and bit-identical to the historical equal-block rule
+        whenever ``kv_buckets % n == 0``."""
+        return [r * self.kv_buckets // self.n for r in range(self.n + 1)]
 
     def kv_bucket(self, keys: np.ndarray) -> np.ndarray:
         """Logical fleet bucket per key (splitmix64 mod kv_buckets) —
@@ -139,13 +158,16 @@ class PartitionMap:
                 % np.uint64(self.kv_buckets)).astype(np.int64)
 
     def kv_owner(self, keys: np.ndarray) -> np.ndarray:
-        """Owning rank per key: contiguous equal bucket blocks (rank r
-        owns [r*bps, (r+1)*bps) of the logical bucket space)."""
-        return self.kv_bucket(keys) // self.buckets_per_rank
+        """Owning rank per key: searchsorted over the contiguous
+        bucket bounds (identical to ``bucket // buckets_per_rank``
+        when the space divides evenly)."""
+        bounds = np.asarray(self.kv_bounds()[1:], np.int64)
+        return np.searchsorted(bounds, self.kv_bucket(keys),
+                               side="right").astype(np.int64)
 
     def bucket_range(self, rank: int) -> Tuple[int, int]:
-        bps = self.buckets_per_rank
-        return rank * bps, (rank + 1) * bps
+        b = self.kv_bounds()
+        return b[rank], b[rank + 1]
 
     # -- wire form ---------------------------------------------------------
 
@@ -206,10 +228,14 @@ class PartitionMember:
         return self.map.bucket_range(self.rank)
 
     def local_kv_capacity(self, capacity: int) -> int:
-        """This rank's slot budget: the global capacity split evenly
-        (ceil — a shard must never hold fewer slots than its share of
-        keys; KVTable rounds its bucket count up anyway)."""
-        return max(-(-int(capacity) // self.map.n), 1)
+        """This rank's slot budget: the global capacity split by owned
+        bucket share (ceil — a shard must never hold fewer slots than
+        its share of keys; KVTable rounds its bucket count up anyway).
+        Identical to ``ceil(capacity / n)`` when the bucket space
+        divides evenly."""
+        lo, hi = self.bucket_range()
+        return max(-(-int(capacity) * (hi - lo) // self.map.kv_buckets),
+                   1)
 
     def describe(self) -> Dict[str, Any]:
         lo, hi = self.bucket_range()
@@ -218,6 +244,92 @@ class PartitionMember:
 
     def __repr__(self) -> str:
         return f"PartitionMember(rank={self.rank}, map={self.map!r})"
+
+
+# -- reshard diff ----------------------------------------------------------
+#
+# What moves on a map change v→v+1 is computable in closed form: both
+# dense ranges and KV bucket ranges are contiguous floor-division
+# splits, so the moved set per (donor, recipient) pair is the interval
+# intersection of the old and new bounds — segments whose old owner
+# differs from their new owner. Migration cost is therefore
+# proportional to MOVED bytes, never table bytes: growing N→N+1 moves
+# ~1/(N+1) of each table, shrinking moves the evicted rank's share.
+
+
+def _bound_moves(old_bounds: List[int],
+                 new_bounds: List[int]) -> List[Tuple[int, int, int, int]]:
+    """``(donor, recipient, lo, hi)`` segments where ownership changes
+    between two bounds lists over the same total span. Closed form:
+    split the span at every old/new boundary; each piece has exactly
+    one old owner and one new owner."""
+    if old_bounds[-1] != new_bounds[-1] or old_bounds[0] != new_bounds[0]:
+        raise ValueError(
+            "bounds cover different spans: "
+            f"{old_bounds[0]}..{old_bounds[-1]} vs "
+            f"{new_bounds[0]}..{new_bounds[-1]}")
+    import bisect
+    edges = sorted(set(old_bounds) | set(new_bounds))
+    moves = []
+    for lo, hi in zip(edges[:-1], edges[1:]):
+        donor = bisect.bisect_right(old_bounds, lo) - 1
+        rcpt = bisect.bisect_right(new_bounds, lo) - 1
+        if donor != rcpt:
+            moves.append((donor, rcpt, lo, hi))
+    return moves
+
+
+class MapDiff:
+    """The exact moved ranges of a reshard ``old``→``new``.
+
+    ``bucket_moves`` is the list of ``(donor, recipient, lo, hi)``
+    logical-KV-bucket segments changing hands; :meth:`dense_moves`
+    computes the element-range counterpart for a dense table of a
+    given size. Both are disjoint, covering exactly the moved set."""
+
+    __slots__ = ("old", "new", "bucket_moves")
+
+    def __init__(self, old: PartitionMap, new: PartitionMap) -> None:
+        if new.kv_buckets != old.kv_buckets:
+            raise ValueError(
+                "reshard must keep the logical bucket space fixed "
+                f"(old kv_buckets={old.kv_buckets}, new "
+                f"{new.kv_buckets}) — changing it re-hashes every key")
+        if new.version <= old.version:
+            raise ValueError(
+                f"reshard must bump the map version (old "
+                f"{old.version}, new {new.version})")
+        self.old = old
+        self.new = new
+        self.bucket_moves = _bound_moves(old.kv_bounds(), new.kv_bounds())
+
+    def dense_moves(self, size: int) -> List[Tuple[int, int, int, int]]:
+        """``(donor, recipient, lo, hi)`` GLOBAL element ranges of a
+        dense table of ``size`` elements that change hands."""
+        return _bound_moves(self.old.dense_bounds(size),
+                            self.new.dense_bounds(size))
+
+    def moved_buckets(self) -> int:
+        return sum(hi - lo for _, _, lo, hi in self.bucket_moves)
+
+    def moved_dense(self, size: int) -> int:
+        return sum(hi - lo for _, _, lo, hi in self.dense_moves(size))
+
+    def donor_ranks(self) -> List[int]:
+        """Ranks that ship at least one range. Size-free: evaluated on
+        a synthetic large dense size (the floor-division rule makes
+        the donor set scale-invariant above ~n² elements) plus the
+        bucket moves."""
+        big = max(self.old.n, self.new.n) << 20
+        out = set(d for d, _, _, _ in self.dense_moves(big))
+        out.update(d for d, _, _, _ in self.bucket_moves)
+        return sorted(out)
+
+
+def map_diff(old: PartitionMap, new: PartitionMap) -> MapDiff:
+    """The exact moved element/bucket ranges of a reshard — see
+    :class:`MapDiff`."""
+    return MapDiff(old, new)
 
 
 # -- fleet file ------------------------------------------------------------

@@ -74,6 +74,7 @@ Fault containment is the design center, not an afterthought:
 from __future__ import annotations
 
 import collections
+import contextlib
 import heapq
 import os
 import queue
@@ -140,6 +141,16 @@ _FUSE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
 #: QoS class can target and shed them like any real flooder)
 _FLOOD_BURST = 32
 _FLOOD_CLIENT = "chaos-flood"
+
+#: live-reshard chunking: elements per dense ``migrate_chunk`` (1 MiB
+#: at fp32) and key rows per KV chunk — sized so the
+#: ``server.migrate.rate`` knob's unit (chunks/s) maps to a
+#: predictable wire rate
+_MIG_DENSE_CHUNK = 1 << 18
+_MIG_KV_CHUNK = 4096
+
+#: sentinel for :meth:`TableServer._build_table`'s member override
+_DEFAULT_MEMBER = object()
 
 
 class _FloodConn:
@@ -217,6 +228,72 @@ class _Unit:
     def __init__(self, key: Optional[tuple], item: tuple) -> None:
         self.key = key
         self.items = [item]     # (batch_idx, conn, header, arrays)
+
+
+class _Migration:
+    """Live state of one v→v+1 reshard on this member (the elastic-
+    fleet tentpole; frame contract in ``server/wire.py``).
+
+    One re-entrant lock serializes the donor's streaming thread
+    against the dispatch thread's apply+forward path. The exactly-once
+    invariant it buys: every write either lands BEFORE its range's
+    chunk is extracted (the chunk carries it) or is forwarded AFTER
+    the chunk, on the same FIFO link — never both, never neither."""
+
+    def __init__(self, plan: str, old_map, new_map,
+                 members: Dict[int, str], rank: int,
+                 ctx: Optional[Dict[str, Any]] = None) -> None:
+        self.plan = str(plan)
+        self.old = old_map          # None on a member born at v+1
+        self.new = new_map
+        self.members = dict(members)    # rank -> wire address (NEW fleet)
+        self.rank = int(rank)
+        self.ctx = ctx              # the begin frame's trace context
+        self.lock = threading.RLock()
+        # begin -> streaming|shipped -> committed, or failed/aborted
+        self.state = "begin"
+        self.error: Optional[str] = None
+        self.donor = False
+        self.staging: Dict[int, Any] = {}       # tid -> new-geometry shard
+        self.dense_segs: Dict[int, list] = {}   # tid -> [(rcpt, lo, hi)]
+        self.kv_segs: Dict[int, list] = {}      # tid -> [(rcpt, blo, bhi)]
+        self.shipped: Dict[int, list] = {}      # tid -> [(lo, hi)] handed off
+        self.links: Dict[int, Any] = {}         # recipient rank -> WireClient
+        self.seq = 0
+        self.chunks = 0
+        self.chunks_in = 0
+        self.forwards = 0
+        self.forwards_in = 0
+        self.moved_bytes = 0
+        self.t0 = time.time()
+
+    def next_seq(self) -> int:
+        self.seq += 1
+        return self.seq
+
+    def mark_shipped(self, tid: int, lo: int, hi: int) -> None:
+        self.shipped.setdefault(tid, []).append((int(lo), int(hi)))
+
+    def shipped_overlaps(self, tid: int, lo: int,
+                         hi: int) -> List[Tuple[int, int]]:
+        out = []
+        for a, b in self.shipped.get(tid, ()):
+            x, y = max(a, lo), min(b, hi)
+            if x < y:
+                out.append((x, y))
+        return out
+
+    def status(self) -> Dict[str, Any]:
+        return {"plan": self.plan, "state": self.state,
+                "from": self.old.version if self.old is not None
+                else None,
+                "to": self.new.version, "donor": self.donor,
+                "chunks": self.chunks, "chunks_in": self.chunks_in,
+                "forwards": self.forwards,
+                "forwards_in": self.forwards_in,
+                "moved_bytes": self.moved_bytes,
+                "elapsed_s": round(time.time() - self.t0, 3),
+                "error": self.error}
 
 
 class TableServer:
@@ -324,6 +401,23 @@ class TableServer:
         self._repl_slack = _knobs.initial("server.repl.slack")
         _knobs.bind("server.repl.slack", self, "_repl_slack",
                     label=self.name)
+        # -- live resharding (elastic fleet) ---------------------------
+        # one in-flight _Migration at most; _table_specs remembers each
+        # create's (name, kind, spec) so migrate_begin can build the
+        # new-geometry staging shard and manifest-create on recipients
+        self._migration: Optional[_Migration] = None
+        self._table_specs: Dict[int, Tuple[str, str, Dict[str, Any]]] = {}
+        self._migrate_rate = _knobs.initial("server.migrate.rate")
+        _knobs.bind("server.migrate.rate", self, "_migrate_rate",
+                    label=self.name)
+        self._c_mig_bytes = telemetry.counter("reshard.moved_bytes",
+                                              server=self.name)
+        self._c_mig_chunks = telemetry.counter("reshard.chunks",
+                                               server=self.name)
+        self._c_mig_fwds = telemetry.counter("reshard.forwards",
+                                             server=self.name)
+        self._c_mig_aborts = telemetry.counter("reshard.aborts",
+                                               server=self.name)
         self._fstate = _replication.FollowerState(self.name) \
             if self._follower else None
         self._tap: Optional[_replication.ReplicationTap] = None
@@ -386,6 +480,13 @@ class TableServer:
             rep.stop()
         if self._tap is not None:
             self._tap.close()
+        mig = self._migration
+        if mig is not None:
+            for link in list(mig.links.values()):
+                with contextlib.suppress(Exception):
+                    link.abort()
+                with contextlib.suppress(Exception):
+                    link.close()
         self._dispatchq.put(None)
         for t in self._threads:
             if t is not threading.current_thread():
@@ -418,8 +519,10 @@ class TableServer:
                 # a promoted ex-follower reports its NEW role (its
                 # FollowerState survives as the apply history)
                 repl["role"] = "primary"
+        mig = self._migration
         return {"name": self.name, "address": self.address,
                 "connections": n_conns, "tables": len(self._tables),
+                "migration": mig.status() if mig is not None else None,
                 "ops": self._ops, "fuse": self._fuse,
                 "fused": {"groups": int(self._c_fuse_groups.value),
                           "frames": int(self._c_fuse_frames.value)},
@@ -542,7 +645,8 @@ class TableServer:
             # still go to dispatch, where a follower refuses them
             # structurally.
             if header.get("staleness") is not None \
-                    and header.get("op") in ("get", "kv_get"):
+                    and header.get("op") in ("get", "kv_get") \
+                    and self._relay_mode(header) is None:
                 t_rep = time.time()
                 try:
                     # degraded-mode routing: while writes are being
@@ -830,7 +934,8 @@ class TableServer:
             # own staleness bound, checked (and annotated) per frame
             if op in _FUSABLE and tid is not None \
                     and not (self._follower
-                             and op in ("get", "kv_get")):
+                             and op in ("get", "kv_get")) \
+                    and self._relay_mode(header) is None:
                 try:
                     tid = int(tid)
                     key = self._group_key(op, tid, header)
@@ -946,7 +1051,20 @@ class TableServer:
                 else:
                     total += delta
             self._heat_touch_dense(header0, table, weight=float(k))
-            handle = table.add(total, option, sync=sync)
+            mig = self._mig_forwarding()
+            if mig is not None:
+                # donor mid-reshard: apply + forward under the
+                # migration lock so the fused delta can never fall
+                # between a shipped chunk and its forward
+                with mig.lock:
+                    handle = table.add(total, option, sync=sync)
+                    self._mig_forward_dense(
+                        mig, int(header0["table"]), total,
+                        header0.get("option"),
+                        [(c.client_id, h.get("rid"))
+                         for _i, c, h, _a in items])
+            else:
+                handle = table.add(total, option, sync=sync)
             if self._tap is not None:
                 # a fused group forwards as its ONE pre-summed apply:
                 # K original frames would desync generation counts and
@@ -983,12 +1101,24 @@ class TableServer:
             summed = np.zeros((len(uniq),) + cat_deltas.shape[1:],
                               cat_deltas.dtype)
             np.add.at(summed, inverse, cat_deltas)
-            handle = table.add(uniq, summed, option, sync=sync)
-            # per-request overflow verdict: the fused batch drops
-            # atomically on overflow, so ONE readback per cycle buys a
-            # truthful reply for every request in it (the raise lands
-            # in _execute_group's fallback, which re-runs per frame)
-            table._check_overflow()
+            mig = self._mig_forwarding()
+            if mig is not None:
+                with mig.lock:
+                    handle = table.add(uniq, summed, option, sync=sync)
+                    table._check_overflow()
+                    self._mig_forward_kv(
+                        mig, int(header0["table"]), uniq, summed,
+                        header0.get("option"),
+                        [(c.client_id, h.get("rid"))
+                         for _i, c, h, _a in items])
+            else:
+                handle = table.add(uniq, summed, option, sync=sync)
+                # per-request overflow verdict: the fused batch drops
+                # atomically on overflow, so ONE readback per cycle
+                # buys a truthful reply for every request in it (the
+                # raise lands in _execute_group's fallback, which
+                # re-runs per frame)
+                table._check_overflow()
             if self._tap is not None:
                 # forwarded AFTER the overflow check: a batch the
                 # primary dropped must never reach a follower
@@ -1099,8 +1229,12 @@ class TableServer:
 
         # mutating ops replay from the dedup cache: a resend after a
         # reconnect must not re-apply ("repl" included: the tap's link
-        # replays its unacked window after a reconnect like any client)
-        mutating = op in ("create", "add", "kv_add", "repl")
+        # replays its unacked window after a reconnect like any
+        # client; migrate chunk/fwd/manifest for the same reason — a
+        # donor's link redial replays its unacked window)
+        mutating = op in ("create", "add", "kv_add", "repl",
+                          wire.MIGRATE_CHUNK, wire.MIGRATE_FWD,
+                          wire.MIGRATE_MANIFEST)
         if mutating:
             cached = self._dedup_get(conn.client_id, header.get("rid"))
             if cached is not None:
@@ -1114,12 +1248,16 @@ class TableServer:
         elif op == "kv_get":
             reply = self._op_kv_get(header, arrays)
         elif op == "add":
-            reply = self._op_add(header, arrays, force_sync=force_sync)
+            reply = self._op_add(header, arrays, force_sync=force_sync,
+                                 origin=conn.client_id)
         elif op == "kv_add":
             reply = self._op_kv_add(header, arrays,
-                                    force_sync=force_sync)
+                                    force_sync=force_sync,
+                                    origin=conn.client_id)
         elif op == "repl":
             reply = self._op_repl(header, arrays)
+        elif op in wire.MIGRATE_OPS:
+            reply = self._op_migrate(op, header, arrays)
         else:
             raise ValueError(f"unknown wire op {op!r}")
         if follower_lag is not None and reply[0].get("ok"):
@@ -1128,7 +1266,13 @@ class TableServer:
             reply[0]["follower"] = True
             reply[0]["lag"] = follower_lag
         if self._tap is not None and reply[0].get("ok") \
-                and op in ("create", "add", "kv_add"):
+                and (op in ("create", "add", "kv_add")
+                     or (op in wire.MIGRATE_OPS
+                         and op != wire.MIGRATE_STATE)):
+            # migrate frames replicate too (state polls excepted): a
+            # follower builds/fills the same staging shard and swaps
+            # it in lockstep at commit, so failover composes with a
+            # mid-flight reshard
             self._tap.forward(conn.client_id, header, arrays, reply[0])
         if mutating:
             self._dedup_put(conn.client_id, header.get("rid"), reply)
@@ -1216,6 +1360,12 @@ class TableServer:
             reply = self._op_add(orig, arrays)
         elif op == "kv_add":
             reply = self._op_kv_add(orig, arrays)
+        elif op in wire.MIGRATE_OPS:
+            # a mid-reshard primary streams its migrate frames too: the
+            # follower mirrors begin/chunks/forwards into its own
+            # staging and swaps at commit in lockstep (it never donates
+            # or forwards itself — _mig_forwarding gates on donor)
+            reply = self._op_migrate(op, orig, arrays)
         else:
             raise ValueError(f"unknown replicated op {op!r}")
         # FRESH dicts per replay key: _finish bakes the STREAMER's rid
@@ -1321,6 +1471,869 @@ class TableServer:
         return ({"ok": True,
                  "version": self._partition.map.version}, [])
 
+    # -- live resharding (elastic fleet; frame contract in wire.py) --------
+
+    def _op_migrate(self, op: str, header: Dict[str, Any],
+                    arrays: List[np.ndarray]) -> tuple:
+        if op == wire.MIGRATE_BEGIN:
+            return self._op_migrate_begin(header)
+        if op == wire.MIGRATE_STATE:
+            return self._op_migrate_state(header)
+        if op == wire.MIGRATE_COMMIT:
+            return self._op_migrate_commit(header)
+        if op == wire.MIGRATE_ABORT:
+            return self._op_migrate_abort(header)
+        if op == wire.MIGRATE_MANIFEST:
+            return self._op_migrate_manifest(header)
+        if op == wire.MIGRATE_CHUNK:
+            return self._op_migrate_chunk(header, arrays)
+        if op == wire.MIGRATE_FWD:
+            return self._op_migrate_fwd(header, arrays)
+        if op == wire.MIGRATE_FIN:
+            return self._op_migrate_fin(header)
+        raise ValueError(f"unknown migrate op {op!r}")
+
+    def _op_migrate_begin(self, header: Dict[str, Any]) -> tuple:
+        plan = str(header.get("plan", ""))
+        mig = self._migration
+        if mig is not None and mig.plan != plan \
+                and mig.state not in ("committed", "aborted"):
+            return ({"ok": False, "server": self.name,
+                     "error": f"reshard {mig.plan!r} already in "
+                              "flight"}, [])
+        if self._partition is None:
+            return ({"ok": False, "server": self.name,
+                     "error": "reshard needs a fleet member "
+                              "(no partition)"}, [])
+        new_map = _partition_mod.PartitionMap.from_wire(header["map"])
+        cur = self._partition.map
+        if mig is not None and mig.plan == plan:
+            if mig.old is None or mig.state != "receiving":
+                # a redelivered begin (admin retry) is a no-op
+                return ({"ok": True, "already": True,
+                         "state": mig.state}, [])
+            # else: the donor's manifest beat the admin's begin here
+            # (streams start as soon as each donor hears begin) —
+            # upgrade the receive-only stub in place, keeping its
+            # staging and whatever chunks already landed
+        elif new_map.version != cur.version + 1:
+            return ({"ok": False, "server": self.name,
+                     "error": f"reshard targets v{new_map.version}, "
+                              f"this member serves v{cur.version}"},
+                    [])
+        else:
+            mig = _Migration(plan, cur, new_map, {},
+                             self._partition.rank,
+                             ctx=wire.trace_ctx(header))
+        mig.members = {int(r): str(a) for r, a
+                       in (header.get("members") or {}).items()}
+        diff = _partition_mod.map_diff(cur, new_map)
+        rank = mig.rank
+        mig.donor = rank in diff.donor_ranks() and not self._follower
+        if mig.donor:
+            for tid, (_name, kind, spec) in sorted(
+                    self._table_specs.items()):
+                if kind == "array":
+                    segs = [(r, lo, hi) for d, r, lo, hi
+                            in diff.dense_moves(int(spec["size"]))
+                            if d == rank]
+                    if segs:
+                        mig.dense_segs[tid] = segs
+                else:
+                    segs = [(r, lo, hi) for d, r, lo, hi
+                            in diff.bucket_moves if d == rank]
+                    if segs:
+                        mig.kv_segs[tid] = segs
+        if rank < new_map.n:
+            new_member = _partition_mod.PartitionMember(new_map, rank)
+            for tid in sorted(self._table_specs):
+                if tid not in mig.staging:
+                    mig.staging[tid] = self._mig_build_staging(
+                        tid, new_member)
+        self._migration = mig
+        mig.state = "streaming" if mig.donor else "shipped"
+        if mig.donor:
+            self._spawn(self._mig_stream, "mig-stream", mig)
+        log.info("server %r: reshard %r begin v%d→v%d donor=%s "
+                 "(%d dense segs, %d kv segs)", self.name, plan,
+                 cur.version, new_map.version, mig.donor,
+                 sum(len(v) for v in mig.dense_segs.values()),
+                 sum(len(v) for v in mig.kv_segs.values()))
+        return ({"ok": True, "plan": plan, "donor": mig.donor,
+                 "state": mig.state}, [])
+
+    def _op_migrate_manifest(self, header: Dict[str, Any]) -> tuple:
+        plan = str(header.get("plan", ""))
+        new_map = _partition_mod.PartitionMap.from_wire(header["map"])
+        mig = self._migration
+        if mig is None or mig.state in ("committed", "aborted"):
+            if self._partition is None:
+                return ({"ok": False, "server": self.name,
+                         "error": "manifest at a partitionless "
+                                  "server"}, [])
+            cur = self._partition.map
+            if cur.version == new_map.version:
+                # a member BORN at v+1: its live tables already have
+                # the new geometry; chunks/forwards apply directly
+                old = None
+            elif cur.version + 1 == new_map.version:
+                # existing member, donor's stream raced ahead of the
+                # admin's begin: stage now, merge when begin arrives
+                old = cur
+            else:
+                return ({"ok": False, "server": self.name,
+                         "error": f"manifest targets v"
+                                  f"{new_map.version}, this member "
+                                  f"serves v{cur.version}"}, [])
+            mig = _Migration(plan, old, new_map, {},
+                             self._partition.rank,
+                             ctx=wire.trace_ctx(header))
+            mig.state = "receiving"
+            self._migration = mig
+        elif mig.plan != plan:
+            return ({"ok": False, "server": self.name,
+                     "error": f"manifest for plan {plan!r} but "
+                              f"{mig.plan!r} is in flight"}, [])
+        new_member = _partition_mod.PartitionMember(mig.new, mig.rank)
+        for row in header.get("tables") or ():
+            tid = int(row["table"])
+            if mig.old is None:
+                # new member: create the live table itself (idempotent
+                # by name, force_tid keeps the id space aligned)
+                self._op_create({"name": row["name"],
+                                 "kind": row["kind"],
+                                 "spec": row["spec"]},
+                                force_tid=tid, staging_ok=True)
+            else:
+                self._table_specs.setdefault(
+                    tid, (str(row["name"]), str(row["kind"]),
+                          dict(row["spec"] or {})))
+                if tid not in mig.staging:
+                    mig.staging[tid] = self._mig_build_staging(
+                        tid, new_member)
+        return ({"ok": True, "plan": plan, "state": mig.state}, [])
+
+    def _op_migrate_chunk(self, header: Dict[str, Any],
+                          arrays: List[np.ndarray]) -> tuple:
+        mig = self._mig_of(header)
+        if int(header.get("crc", -1)) != wire.migrate_crc(arrays):
+            # torn chunk: abort LOUDLY — the donor's drain raises, its
+            # stream fails, and the admin's abort wave rolls back to v
+            raise ValueError(
+                f"reshard {mig.plan!r}: torn migrate chunk (crc "
+                f"mismatch) for table {header.get('table')}")
+        tid = int(header["table"])
+        lo, hi = (int(x) for x in header["range"])
+        target = self._mig_target(mig, tid)
+        if str(header.get("kind")) == "dense":
+            name, _kind, spec = self._table_specs[tid]
+            nlo, nhi = self._mig_new_member(mig).dense_range(
+                int(spec["size"]))
+            if lo < nlo or hi > nhi:
+                raise ValueError(
+                    f"reshard {mig.plan!r}: chunk [{lo},{hi}) outside "
+                    f"this rank's new range [{nlo},{nhi}) of "
+                    f"table {name!r}")
+            values = np.asarray(arrays[0])
+            # set semantics, idempotent: a replayed chunk (donor link
+            # redial) overwrites with the same bytes
+            host = np.asarray(target.raw()).copy()
+            host[lo - nlo: hi - nlo] = values.astype(host.dtype,
+                                                     copy=False)
+            target.put_raw(host)
+        else:
+            keys = np.ascontiguousarray(arrays[0]).astype(np.uint64,
+                                                          copy=False)
+            self._mig_kv_inject(target, keys, np.asarray(arrays[1]))
+        mig.chunks_in += 1
+        return ({"ok": True, "seq": header.get("seq")}, [])
+
+    def _op_migrate_fwd(self, header: Dict[str, Any],
+                        arrays: List[np.ndarray]) -> tuple:
+        mig = self._mig_of(header)
+        orig, origins = wire.migrate_fwd_unwrap(header)
+        op = str(orig.get("op"))
+        tid = int(orig["table"])
+        target = self._mig_target(mig, tid)
+        option = self._option(orig)
+        if op == "add":
+            glo, ghi = (int(x) for x in orig["range"])
+            _name, _kind, spec = self._table_specs[tid]
+            nlo, nhi = self._mig_new_member(mig).dense_range(
+                int(spec["size"]))
+            delta = np.asarray(arrays[0])
+            local = np.zeros(nhi - nlo, dtype=np.dtype(target.dtype))
+            local[glo - nlo: ghi - nlo] = delta
+            handle = target.add(local, option, sync=False)
+        elif op == "kv_add":
+            keys = np.ascontiguousarray(arrays[0]).astype(np.uint64,
+                                                          copy=False)
+            handle = target.add(keys, np.asarray(arrays[1]), option,
+                                sync=False)
+        else:
+            raise ValueError(f"unforwardable op {op!r}")
+        reply = ({"ok": True, "gen": handle.generation,
+                  "fwd": True}, [])
+        # exactly-once note: the ORIGIN (client, rid) pairs in the
+        # frame are trace breadcrumbs, NOT a dedup key here — rids are
+        # per-connection, so a client resend always replays at the
+        # DONOR (whose dedup caches the relay reply and never forwards
+        # twice), and the donor's link resends replay from this
+        # member's own wire dedup under the link's client id. Caching
+        # origin rids here would poison the client's direct rid space
+        # on this connection.
+        mig.forwards_in += 1
+        return reply
+
+    def _op_migrate_state(self, header: Dict[str, Any]) -> tuple:
+        mig = self._migration
+        if mig is None:
+            return ({"ok": True, "state": "idle"}, [])
+        return ({"ok": True, **mig.status()}, [])
+
+    def _op_migrate_commit(self, header: Dict[str, Any]) -> tuple:
+        mig = self._mig_of(header)
+        if mig.state == "committed":
+            return ({"ok": True, "already": True,
+                     "version": mig.new.version}, [])
+        if mig.state in ("failed", "aborted", "begin", "streaming"):
+            return ({"ok": False, "state": mig.state,
+                     "server": self.name, "error": mig.error
+                     or f"cannot commit from state {mig.state!r}"},
+                    [])
+        t0 = time.time()
+        with mig.lock:
+            # drain every outstanding chunk/forward ack first: an
+            # unacked frame at the swap could be lost — a dead link
+            # raises here, failing the commit (admin then aborts)
+            for link in mig.links.values():
+                link.drain()
+            if mig.rank < mig.new.n:
+                new_member = _partition_mod.PartitionMember(
+                    mig.new, mig.rank)
+                old_member = self._partition
+                for tid in sorted(mig.staging):
+                    self._mig_commit_table(mig, tid, mig.staging[tid],
+                                           old_member, new_member)
+                self._partition = new_member
+                for tid, (name, kind, spec) in \
+                        self._table_specs.items():
+                    self._table_parts[tid] = self._part_info(
+                        name, kind, spec)
+                if self._tap is not None:
+                    self._tap.update_claim(mig.new.to_wire())
+            # an EVICTED rank (shrink) never flips: it keeps relaying
+            # old-map frames by the new map until the admin shuts it
+            # down after the linger window
+            mig.staging.clear()
+            mig.state = "committed"
+        if mig.ctx is not None and _trace.active():
+            with _trace.adopt_remote(mig.ctx):
+                _trace.emit_span("server.migrate.commit", t0,
+                                 time.time() - t0, server=self.name,
+                                 plan=mig.plan,
+                                 version=mig.new.version)
+        log.info("server %r: reshard %r COMMITTED at v%d "
+                 "(%d chunks in, %d forwards in)", self.name,
+                 mig.plan, mig.new.version, mig.chunks_in,
+                 mig.forwards_in)
+        return ({"ok": True, "version": mig.new.version}, [])
+
+    def _op_migrate_abort(self, header: Dict[str, Any]) -> tuple:
+        mig = self._migration
+        plan = str(header.get("plan", ""))
+        if mig is None or mig.plan != plan:
+            return ({"ok": True, "idle": True}, [])
+        if mig.state == "committed":
+            return ({"ok": False, "server": self.name,
+                     "error": "cannot abort a committed reshard"}, [])
+        with mig.lock:
+            mig.state = "aborted"
+            # live tables were never touched by the migration (donors
+            # stream FROM them, recipients write STAGING) — dropping
+            # staging leaves v serving bit-exactly
+            mig.staging.clear()
+            links = list(mig.links.values())
+            mig.links.clear()
+        for link in links:
+            with contextlib.suppress(Exception):
+                link.abort()
+            with contextlib.suppress(Exception):
+                link.close()
+        self._c_mig_aborts.inc()
+        self._migration = None
+        log.warn("server %r: reshard %r ABORTED (%s)", self.name,
+                 plan, header.get("reason") or mig.error or "admin")
+        return ({"ok": True, "aborted": True}, [])
+
+    def _op_migrate_fin(self, header: Dict[str, Any]) -> tuple:
+        log.info("server %r: reshard %r stream from rank %s done "
+                 "(%s chunks, %s bytes)", self.name,
+                 header.get("plan"), header.get("from_rank"),
+                 header.get("chunks"), header.get("bytes"))
+        return ({"ok": True}, [])
+
+    # -- resharding internals ----------------------------------------------
+
+    def _mig_of(self, header: Dict[str, Any]) -> _Migration:
+        mig = self._migration
+        plan = str(header.get("plan", ""))
+        if mig is None or mig.plan != plan:
+            raise ValueError(
+                f"no reshard plan {plan!r} on server {self.name!r}")
+        return mig
+
+    def _mig_new_member(self, mig: _Migration):
+        if mig.rank >= mig.new.n:
+            raise ValueError(
+                f"rank {mig.rank} is evicted by v{mig.new.version} "
+                "and owns nothing under the new map")
+        return _partition_mod.PartitionMember(mig.new, mig.rank)
+
+    def _mig_build_staging(self, tid: int, new_member):
+        """A NEW-geometry shard for one table. The name gets a version
+        suffix so a tiered staging table never shares the live one's
+        disk spill path (the registry is a list — no name key to
+        collide on)."""
+        name, kind, spec = self._table_specs[tid]
+        return self._build_table(f"{name}.v{new_member.map.version}",
+                                 kind, dict(spec), member=new_member)
+
+    def _mig_target(self, mig: _Migration, tid: int):
+        """Where a chunk/forward lands: the staging shard, or (on a
+        member born at v+1, whose live tables ARE the new geometry)
+        the live table."""
+        st = mig.staging.get(tid)
+        if st is not None:
+            return st
+        table = self._tables.get(tid)
+        if table is None:
+            raise KeyError(
+                f"no table {tid} for reshard {mig.plan!r}")
+        return table
+
+    def _mig_link(self, mig: _Migration, rcpt: int):
+        """This donor's FIFO link to one recipient (caller holds
+        ``mig.lock``): dialed once, manifest first — so every chunk
+        and forward to that rank rides ONE ordered stream, which is
+        what makes chunk-then-forward ordering free."""
+        link = mig.links.get(int(rcpt))
+        if link is not None:
+            return link
+        addr = mig.members.get(int(rcpt))
+        if not addr:
+            raise ValueError(
+                f"reshard {mig.plan!r}: no address for rank {rcpt}")
+        from multiverso_tpu.client import transport as _transport
+        link = _transport.WireClient(
+            addr, client=f"mig:{self.name}", quant=None,
+            retry_policy=_replication.repl_retry_policy(
+                f"mig-{self.name}"),
+            deadline_s=None)
+        mig.links[int(rcpt)] = link
+        rows = [{"table": tid, "name": name, "kind": kind,
+                 "spec": spec}
+                for tid, (name, kind, spec)
+                in sorted(self._table_specs.items())]
+        link.submit({"op": wire.MIGRATE_MANIFEST, "plan": mig.plan,
+                     "from_rank": mig.rank,
+                     "map": mig.new.to_wire(), "tables": rows}, [])
+        return link
+
+    def _mig_rate_sleep(self, chunks: int = 1) -> None:
+        rate = float(self._migrate_rate or 0.0)
+        if rate > 0.0:
+            time.sleep(chunks / rate)
+
+    def _mig_forwarding(self) -> Optional[_Migration]:
+        """The in-flight migration IF this member must forward writes
+        alongside its applies (pre-commit donor primary)."""
+        mig = self._migration
+        if mig is not None and mig.donor \
+                and mig.state in ("streaming", "shipped"):
+            return mig
+        return None
+
+    def _relay_mode(self, header: Dict[str, Any]
+                    ) -> Optional[_Migration]:
+        """Post-commit old-map frame detection: clients stamp every
+        frame with the map version it was built against (``pv``,
+        frozen at build so reconnect replays stay identical); anything
+        below the committed TARGET version addresses geometry this
+        member no longer serves. Comparing against the target (not the
+        live partition) covers the evicted rank too, whose partition
+        never flips."""
+        mig = self._migration
+        if mig is None or mig.state != "committed" \
+                or mig.old is None:
+            return None
+        pv = header.get("pv")
+        if pv is None:
+            return None
+        return mig if int(pv) < mig.new.version else None
+
+    def _mig_remap_refusal(self, mig: _Migration) -> Dict[str, Any]:
+        return {"ok": False, "remap": True, "server": self.name,
+                "partition": mig.new.to_wire(),
+                "error": f"partition map advanced to "
+                         f"v{mig.new.version}: re-read the fleet "
+                         "file and re-split"}
+
+    def _mig_forward_dense(self, mig: _Migration, tid: int,
+                           delta: np.ndarray, option_raw,
+                           origins: List[Tuple[str, Any]],
+                           shipped_only: bool = True) -> None:
+        """Forward the moved slices of one APPLIED dense delta (caller
+        holds ``mig.lock``). Pre-commit: only already-shipped spans —
+        the not-yet-extracted rest rides its chunk. Post-commit relay
+        (``shipped_only=False``): every donated span."""
+        segs = mig.dense_segs.get(tid)
+        if not segs:
+            return
+        _name, _kind, spec = self._table_specs[tid]
+        olo, _ohi = _partition_mod.PartitionMember(
+            mig.old, mig.rank).dense_range(int(spec["size"]))
+        for rcpt, slo, shi in segs:
+            spans = [(slo, shi)] if not shipped_only \
+                else mig.shipped_overlaps(tid, slo, shi)
+            for lo, hi in spans:
+                sl = np.ascontiguousarray(
+                    np.asarray(delta)[lo - olo: hi - olo])
+                if sl.size == 0:
+                    continue
+                orig = {"op": "add", "table": tid,
+                        "range": [int(lo), int(hi)]}
+                if option_raw:
+                    orig["option"] = dict(option_raw)
+                link = self._mig_link(mig, rcpt)
+                link.submit(wire.migrate_fwd_wrap(
+                    orig, plan=mig.plan, from_rank=mig.rank,
+                    origins=origins), [sl])
+                mig.forwards += 1
+                self._c_mig_fwds.inc()
+                try:
+                    _chaos.chaos_point("reshard.handoff")
+                except _chaos.ChaosError as exc:
+                    # CONTAINED: the forward is already on the link;
+                    # an error reply here would be dedup-cached and
+                    # replayed to every client resend as a permanent
+                    # failure
+                    log.warn("reshard.handoff chaos (forward, "
+                             "contained): %s", exc)
+
+    def _mig_forward_kv(self, mig: _Migration, tid: int,
+                        keys: np.ndarray, delta: np.ndarray,
+                        option_raw, origins: List[Tuple[str, Any]],
+                        shipped_only: bool = True) -> None:
+        """KV counterpart of :meth:`_mig_forward_dense` (caller holds
+        ``mig.lock``); keys filter by OLD-map logical bucket, which is
+        version-invariant (the bucket space is pinned across a
+        reshard)."""
+        segs = mig.kv_segs.get(tid)
+        if not segs:
+            return
+        keys = np.ascontiguousarray(keys).astype(np.uint64,
+                                                 copy=False)
+        if len(keys) == 0:
+            return
+        kb = mig.old.kv_bucket(keys)
+        for rcpt, blo, bhi in segs:
+            spans = [(blo, bhi)] if not shipped_only \
+                else mig.shipped_overlaps(tid, blo, bhi)
+            for lo, hi in spans:
+                sel = (kb >= lo) & (kb < hi)
+                if not sel.any():
+                    continue
+                ck = np.ascontiguousarray(keys[sel])
+                cv = np.ascontiguousarray(np.asarray(delta)[sel])
+                orig = {"op": "kv_add", "table": tid}
+                if option_raw:
+                    orig["option"] = dict(option_raw)
+                link = self._mig_link(mig, rcpt)
+                link.submit(wire.migrate_fwd_wrap(
+                    orig, plan=mig.plan, from_rank=mig.rank,
+                    origins=origins), [ck, cv])
+                mig.forwards += 1
+                self._c_mig_fwds.inc()
+                try:
+                    _chaos.chaos_point("reshard.handoff")
+                except _chaos.ChaosError as exc:
+                    log.warn("reshard.handoff chaos (forward, "
+                             "contained): %s", exc)
+
+    def _mig_relay_add(self, mig: _Migration, header: Dict[str, Any],
+                       arrays: List[np.ndarray],
+                       origin: Optional[str],
+                       force_sync: bool) -> tuple:
+        """A post-commit dense write built against the OLD map:
+        dropping it loses an update the client already paid for, so
+        apply the retained overlap locally and forward the donated
+        slices — then tell the client to re-split (``remap``)."""
+        tid = int(header.get("table", -1))
+        if tid not in self._table_specs:
+            raise KeyError(f"no table {tid} on this server")
+        _name, _kind, spec = self._table_specs[tid]
+        size = int(spec["size"])
+        olo, ohi = _partition_mod.PartitionMember(
+            mig.old, mig.rank).dense_range(size)
+        delta = np.asarray(
+            wire.decode_delta(header.get("quant"), arrays))
+        if len(delta) != ohi - olo:
+            raise ValueError(
+                f"relayed add length {len(delta)} != old-map local "
+                f"range {ohi - olo}")
+        gen = 0
+        if mig.rank < mig.new.n:
+            nlo, nhi = _partition_mod.PartitionMember(
+                mig.new, mig.rank).dense_range(size)
+            table = self._tables[tid]
+            local = np.zeros(nhi - nlo, dtype=np.dtype(table.dtype))
+            x, y = max(olo, nlo), min(ohi, nhi)
+            if x < y:
+                local[x - nlo: y - nlo] = delta[x - olo: y - olo]
+            handle = table.add(
+                local, self._option(header),
+                sync=bool(header.get("sync")) or force_sync)
+            gen = handle.generation
+        if not self._follower:
+            with mig.lock:
+                self._mig_forward_dense(
+                    mig, tid, delta, header.get("option"),
+                    [(origin or "?", header.get("rid"))],
+                    shipped_only=False)
+                for link in mig.links.values():
+                    link.drain()
+        return ({"ok": True, "gen": gen, "relay": True,
+                 "remap": True,
+                 "partition": mig.new.to_wire()}, [])
+
+    def _mig_relay_kv_add(self, mig: _Migration,
+                          header: Dict[str, Any],
+                          arrays: List[np.ndarray],
+                          origin: Optional[str],
+                          force_sync: bool) -> tuple:
+        """KV counterpart of :meth:`_mig_relay_add`: split by NEW-map
+        ownership, apply mine, forward the rest."""
+        tid = int(header.get("table", -1))
+        keys = np.ascontiguousarray(arrays[0]).astype(np.uint64,
+                                                      copy=False)
+        delta = np.asarray(
+            wire.decode_delta(header.get("quant"), arrays[1:]))
+        gen = 0
+        mine = (mig.new.kv_owner(keys) == mig.rank) \
+            if mig.rank < mig.new.n and len(keys) \
+            else np.zeros(len(keys), bool)
+        if mine.any():
+            handle = self._tables[tid].add(
+                keys[mine], delta[mine], self._option(header),
+                sync=bool(header.get("sync")) or force_sync)
+            gen = handle.generation
+        if not self._follower and len(keys) and not mine.all():
+            with mig.lock:
+                self._mig_forward_kv(
+                    mig, tid, keys[~mine], delta[~mine],
+                    header.get("option"),
+                    [(origin or "?", header.get("rid"))],
+                    shipped_only=False)
+                for link in mig.links.values():
+                    link.drain()
+        return ({"ok": True, "gen": gen, "relay": True,
+                 "remap": True,
+                 "partition": mig.new.to_wire()}, [])
+
+    def _mig_stream(self, mig: _Migration) -> None:
+        """Donor streaming thread: walk every donated range, ship it
+        chunk by chunk (each chunk under ``mig.lock``, the rate sleep
+        outside), then FIN + drain and flip to "shipped". Any error —
+        dead recipient, chaos, torn-chunk reply — marks the migration
+        failed; the admin's poll sees it and aborts fleet-wide."""
+        t0 = time.time()
+        ctx = _trace.adopt_remote(mig.ctx) \
+            if mig.ctx is not None and _trace.active() \
+            else contextlib.nullcontext()
+        try:
+            with ctx:
+                self._mig_stream_ranges(mig)
+                with mig.lock:
+                    if mig.state != "streaming":
+                        return
+                    for link in mig.links.values():
+                        link.submit({"op": wire.MIGRATE_FIN,
+                                     "plan": mig.plan,
+                                     "from_rank": mig.rank,
+                                     "chunks": mig.chunks,
+                                     "bytes": mig.moved_bytes}, [])
+                    for link in mig.links.values():
+                        link.drain()
+                    mig.state = "shipped"
+                if _trace.active():
+                    _trace.emit_span(
+                        "server.migrate.stream", t0,
+                        time.time() - t0, server=self.name,
+                        plan=mig.plan, chunks=mig.chunks,
+                        bytes=mig.moved_bytes)
+        except Exception as exc:    # noqa: BLE001 — any stream fault
+            mig.error = f"{type(exc).__name__}: {exc}"  # fails the
+            with mig.lock:                              # reshard, not
+                if mig.state in ("begin", "streaming"):  # the server
+                    mig.state = "failed"
+            log.warn("server %r: reshard %r stream FAILED: %s",
+                     self.name, mig.plan, mig.error)
+
+    def _mig_stream_ranges(self, mig: _Migration) -> None:
+        for tid in sorted(set(mig.dense_segs) | set(mig.kv_segs)):
+            _name, _kind, spec = self._table_specs[tid]
+            table = self._tables[tid]
+            if tid in mig.dense_segs:
+                olo, _ohi = _partition_mod.PartitionMember(
+                    mig.old, mig.rank).dense_range(int(spec["size"]))
+                for rcpt, seg_lo, seg_hi in mig.dense_segs[tid]:
+                    pos = seg_lo
+                    while pos < seg_hi:
+                        hi = min(pos + _MIG_DENSE_CHUNK, seg_hi)
+                        with mig.lock:
+                            if mig.state != "streaming":
+                                return
+                            _chaos.chaos_point("reshard.handoff")
+                            link = self._mig_link(mig, rcpt)
+                            # re-read raw() EVERY chunk: add donates
+                            # the buffer, so a cached reference goes
+                            # stale under concurrent writes
+                            vals = np.ascontiguousarray(
+                                np.asarray(table.raw())
+                                [pos - olo: hi - olo])
+                            link.submit(wire.migrate_chunk_header(
+                                mig.plan, table=tid, kind="dense",
+                                lo=pos, hi=hi, seq=mig.next_seq(),
+                                from_rank=mig.rank,
+                                arrays=[vals]), [vals])
+                            mig.mark_shipped(tid, pos, hi)
+                            mig.chunks += 1
+                            mig.moved_bytes += int(vals.nbytes)
+                            self._c_mig_chunks.inc()
+                            self._c_mig_bytes.inc(int(vals.nbytes))
+                        self._mig_rate_sleep()
+                        pos = hi
+            for rcpt, blo, bhi in mig.kv_segs.get(tid, ()):
+                sent = 0
+                # one lock hold per donated bucket SEGMENT: the live
+                # rows are enumerated and every chunk submitted before
+                # any concurrent write can land between them, so
+                # mark_shipped flips the whole segment atomically
+                with mig.lock:
+                    if mig.state != "streaming":
+                        return
+                    _chaos.chaos_point("reshard.handoff")
+                    link = self._mig_link(mig, rcpt)
+                    keys, rows = self._mig_kv_rows(table)
+                    if len(keys):
+                        kb = mig.old.kv_bucket(keys)
+                        sel = (kb >= blo) & (kb < bhi)
+                        mkeys = keys[sel]
+                        mrows = rows[sel]
+                        for s in range(0, len(mkeys), _MIG_KV_CHUNK):
+                            ck = np.ascontiguousarray(
+                                mkeys[s:s + _MIG_KV_CHUNK])
+                            cv = np.ascontiguousarray(
+                                mrows[s:s + _MIG_KV_CHUNK])
+                            link.submit(wire.migrate_chunk_header(
+                                mig.plan, table=tid, kind="kv",
+                                lo=blo, hi=bhi, seq=mig.next_seq(),
+                                from_rank=mig.rank,
+                                arrays=[ck, cv]), [ck, cv])
+                            nb = int(ck.nbytes + cv.nbytes)
+                            mig.chunks += 1
+                            mig.moved_bytes += nb
+                            sent += 1
+                            self._c_mig_chunks.inc()
+                            self._c_mig_bytes.inc(nb)
+                    mig.mark_shipped(tid, blo, bhi)
+                self._mig_rate_sleep(max(sent, 1))
+
+    def _mig_kv_rows(self, table) -> Tuple[np.ndarray, np.ndarray]:
+        """Every live ``(key u64, value row)`` pair this shard holds.
+        Tier-aware: device rows come off the live arrays; warm/cold
+        rows come from the host/disk tiers' host-side records via
+        ``peek`` (never faults in) — a tiered donor demotes-and-
+        forwards with HBM flat."""
+        from multiverso_tpu.tables import hashing as _hashing
+        out_k: List[np.ndarray] = []
+        out_v: List[np.ndarray] = []
+
+        def collect(hk: np.ndarray, hv: np.ndarray) -> None:
+            # hk: (..., S, 2) u32 planes; EMPTY = all-0xFFFFFFFF
+            live = ~(hk == np.uint32(0xFFFFFFFF)).all(-1)
+            if live.any():
+                out_k.append(_hashing._join_keys(hk[live]))
+                out_v.append(np.asarray(hv)[live])
+
+        tiers = getattr(table, "tiers", None)
+        if tiers is None:
+            collect(np.asarray(table.keys), np.asarray(table.values))
+        else:
+            from multiverso_tpu.storage import manager as _tm
+            slots = np.flatnonzero(np.asarray(tiers.bucket_at) >= 0)
+            if len(slots):
+                collect(np.asarray(table.keys)[slots],
+                        np.asarray(table.values)[slots])
+            for b in list(tiers.host.buckets()):
+                if tiers.tier[int(b)] == _tm.TIER_HOST:
+                    rec = tiers.host.peek(int(b))
+                    collect(rec.keys[None], rec.values[None])
+            for b in list(tiers.disk.buckets()):
+                if tiers.tier[int(b)] == _tm.TIER_DISK:
+                    rec = tiers.disk.peek(int(b))
+                    collect(rec.keys[None], rec.values[None])
+        if not out_k:
+            vd = int(getattr(table, "value_dim", 0) or 0)
+            return (np.zeros(0, np.uint64),
+                    np.zeros((0, vd) if vd else (0,),
+                             np.dtype(table.dtype)))
+        return (np.concatenate(out_k),
+                np.concatenate([np.asarray(v) for v in out_v],
+                               axis=0))
+
+    @staticmethod
+    def _mig_set_row(bk: np.ndarray, bv: np.ndarray, k2: np.ndarray,
+                     row, name: str, key: int) -> None:
+        """Overwrite key ``k2``'s lane in one bucket's HOST copy
+        (``bk``: (S, 2) u32, ``bv``: (S[, V])), claiming the first
+        empty lane for a new key."""
+        hit = np.flatnonzero((bk == k2).all(-1))
+        if len(hit):
+            bv[int(hit[0])] = row
+            return
+        empty = np.flatnonzero(
+            (bk == np.uint32(0xFFFFFFFF)).all(-1))
+        if not len(empty):
+            raise ValueError(
+                f"kv table {name!r}: migrated key {key} overflows "
+                f"its bucket ({len(bk)} slots)")
+        lane = int(empty[0])
+        bk[lane] = k2
+        bv[lane] = row
+
+    def _mig_kv_install(self, table, hk: np.ndarray,
+                        hv: np.ndarray) -> None:
+        """ONE device reinstall of edited host copies (the
+        kv_table.load idiom): placed to the table's shardings, with a
+        generation bump so outstanding handles read superseded."""
+        import jax
+        table.keys = jax.device_put(hk, table._key_sharding)
+        table.values = jax.device_put(
+            hv.astype(table.dtype, copy=False), table._val_sharding)
+        with table._option_lock:
+            table.generation += 1
+        table._notify_views()
+
+    def _mig_kv_inject(self, table, keys: np.ndarray,
+                       rows: np.ndarray) -> None:
+        """Set-semantics install of migrated (key, value-row) pairs —
+        idempotent, so a replayed chunk is harmless. Plain KV: edit
+        host copies, ONE device reinstall. Tiered: each bucket is
+        edited in its CURRENT tier (device slot / host arena / disk
+        record / virgin→host-or-disk), so injection never inflates
+        HBM either."""
+        if len(keys) == 0:
+            return
+        from multiverso_tpu.tables import hashing as _hashing
+        keys = np.ascontiguousarray(keys).astype(np.uint64,
+                                                 copy=False)
+        k2 = _hashing._split_keys(keys)
+        tiers = getattr(table, "tiers", None)
+        if tiers is None:
+            hk = np.asarray(table.keys).copy()
+            hv = np.asarray(table.values).copy()
+            buckets = (_hashing._hash_u64(keys)
+                       % np.uint64(table.num_buckets)).astype(
+                           np.int64)
+            for i in range(len(keys)):
+                b = int(buckets[i])
+                self._mig_set_row(hk[b], hv[b], k2[i], rows[i],
+                                  table.name, int(keys[i]))
+            self._mig_kv_install(table, hk, hv)
+            return
+        from multiverso_tpu.storage import manager as _tm
+        logical = table._buckets_of(keys)
+        order = np.argsort(logical, kind="stable")
+        hk = hv = None      # device-tier host copies, installed once
+        i = 0
+        while i < len(order):
+            b = int(logical[order[i]])
+            j = i
+            while j < len(order) and int(logical[order[j]]) == b:
+                j += 1
+            idxs = order[i:j]
+            i = j
+            code = int(tiers.tier[b])
+            if code == _tm.TIER_DEVICE:
+                if hk is None:
+                    hk = np.asarray(table.keys).copy()
+                    hv = np.asarray(table.values).copy()
+                s = int(tiers.slot_of[b])
+                for t in idxs:
+                    self._mig_set_row(hk[s], hv[s], k2[t], rows[t],
+                                      table.name, int(keys[t]))
+                live = ~(hk[s] == np.uint32(0xFFFFFFFF)).all(-1)
+                tiers._live[b] = int(live.sum())
+                continue
+            if code == _tm.TIER_HOST:
+                rec = tiers.host.take(b)
+            elif code == _tm.TIER_DISK:
+                rec = tiers.disk.peek(b)
+            else:   # TIER_VIRGIN
+                rec = tiers.spec.empty()
+            for t in idxs:
+                self._mig_set_row(rec.keys, rec.values, k2[t],
+                                  rows[t], table.name, int(keys[t]))
+            if code == _tm.TIER_DISK:
+                tiers.disk.spill(b, rec)    # re-spill overwrites the
+            elif code == _tm.TIER_HOST \
+                    or not tiers.host.full:  # slot in place
+                tiers.host.put(b, rec)
+                tiers.tier[b] = _tm.TIER_HOST
+            else:
+                tiers.disk.spill(b, rec)
+                tiers.tier[b] = _tm.TIER_DISK
+            tiers._live[b] = rec.live()
+        if hk is not None:
+            self._mig_kv_install(table, hk, hv)
+
+    def _mig_commit_table(self, mig: _Migration, tid: int, st,
+                          old_member, new_member) -> None:
+        """Swap one table to its new-geometry staging shard: copy the
+        RETAINED intersection from the live shard (the moved part
+        arrived as chunks/forwards), then replace the live table and
+        rebuild its read replica."""
+        name, kind, spec = self._table_specs[tid]
+        old_table = self._tables[tid]
+        if kind == "array":
+            size = int(spec["size"])
+            olo, ohi = old_member.dense_range(size)
+            nlo, nhi = new_member.dense_range(size)
+            x, y = max(olo, nlo), min(ohi, nhi)
+            if x < y:
+                src = np.asarray(old_table.raw())[x - olo: y - olo]
+                host = np.asarray(st.raw()).copy()
+                host[x - nlo: y - nlo] = src
+                st.put_raw(host)
+        else:
+            keys, rows = self._mig_kv_rows(old_table)
+            if len(keys):
+                blo, bhi = new_member.bucket_range()
+                kb = mig.new.kv_bucket(keys)
+                sel = (kb >= blo) & (kb < bhi)
+                if sel.any():
+                    self._mig_kv_inject(st, keys[sel], rows[sel])
+        self._tables[tid] = st
+        rep = self._replicas.pop(tid, None)
+        if rep is not None:
+            rep.stop()
+        if kind in ("array", "kv"):
+            self._replicas[tid] = TableReplica(
+                st, kind, server=self.name, tid=tid,
+                stream=self._fstate if self._follower else None)
+
     # -- table ops ---------------------------------------------------------
 
     def _table(self, header: Dict[str, Any]):
@@ -1390,10 +2403,22 @@ class TableServer:
             heat.counts[int(b)] += float(counts[b])
 
     def _op_create(self, header: Dict[str, Any],
-                   force_tid: Optional[int] = None) -> tuple:
+                   force_tid: Optional[int] = None,
+                   staging_ok: bool = False) -> tuple:
         name = str(header["name"])
         kind = str(header.get("kind", "array"))
         spec = dict(header.get("spec") or {})
+        mig = self._migration
+        if name not in self._by_name and not staging_ok \
+                and mig is not None and mig.old is not None \
+                and mig.state in ("begin", "streaming", "shipped"):
+            # a brand-new table mid-reshard would miss the stream plan
+            # (begin precomputed the donated segments from the tables
+            # that existed then) — refuse, the client retries after
+            # the commit. Idempotent attaches above are unaffected.
+            return ({"ok": False, "retry": True, "server": self.name,
+                     "error": f"reshard {mig.plan!r} in flight: "
+                              "retry create after commit"}, [])
         if name in self._by_name:
             # idempotent by name: N workers all issue the same creates
             # at startup; first one builds, the rest attach
@@ -1415,6 +2440,9 @@ class TableServer:
             self._next_table = max(self._next_table, tid + 1)
             self._tables[tid] = table
             self._by_name[name] = tid
+            # the GLOBAL spec survives for migrate_begin: staging
+            # shards and recipient manifests rebuild from it
+            self._table_specs[tid] = (name, kind, dict(spec))
             if self._partition is not None:
                 self._table_parts[tid] = self._part_info(name, kind,
                                                          spec)
@@ -1440,18 +2468,22 @@ class TableServer:
             meta["size"] = int(size)
         return (meta, [])
 
-    def _build_table(self, name: str, kind: str, spec: Dict[str, Any]):
+    def _build_table(self, name: str, kind: str, spec: Dict[str, Any],
+                     member: Any = _DEFAULT_MEMBER):
         """Instantiate a table from its GLOBAL create spec. A fleet
         member builds only its local shard: the contiguous element
         range of a dense table, or ceil(capacity/n) KV slots (the
         router never sends this rank a key it doesn't own, so local
         bucket identity is free to differ from the fleet's logical
-        bucket space)."""
+        bucket space). ``member`` overrides the geometry — how a
+        reshard builds its NEW-map staging shard while the live one
+        keeps serving the old map."""
         common = {"name": name}
         for key in ("dtype", "updater"):
             if key in spec:
                 common[key] = spec[key]
-        member = self._partition
+        if member is _DEFAULT_MEMBER:
+            member = self._partition
         if kind == "array":
             from multiverso_tpu.tables.array_table import ArrayTable
             size = int(spec["size"])
@@ -1515,6 +2547,13 @@ class TableServer:
             rep.refresh()
 
     def _op_get(self, header: Dict[str, Any]) -> tuple:
+        mig = self._relay_mode(header)
+        if mig is not None:
+            # post-commit, old-map frame: the live table is already
+            # the NEW geometry — a slice would be the wrong length.
+            # Structured refusal carrying the new map; the router
+            # re-splits and retries (reads are idempotent).
+            return (self._mig_remap_refusal(mig), [])
         table = self._table(header)
         self._maybe_arm_replica(header)
         self._heat_touch_dense(header, table)
@@ -1523,6 +2562,9 @@ class TableServer:
 
     def _op_kv_get(self, header: Dict[str, Any],
                    arrays: List[np.ndarray]) -> tuple:
+        mig = self._relay_mode(header)
+        if mig is not None:
+            return (self._mig_remap_refusal(mig), [])
         table = self._table(header)
         self._maybe_arm_replica(header)
         keys = np.ascontiguousarray(arrays[0]).astype(np.uint64,
@@ -1534,23 +2576,64 @@ class TableServer:
 
     def _op_add(self, header: Dict[str, Any],
                 arrays: List[np.ndarray],
-                force_sync: bool = False) -> tuple:
+                force_sync: bool = False,
+                origin: Optional[str] = None) -> tuple:
+        relay = self._relay_mode(header)
+        if relay is not None:
+            # post-commit, old-map WRITE: dropping it loses an update
+            # the client already paid for — relay it by the new map
+            # instead (apply the retained overlap, forward the moved
+            # slices) and tell the client to re-split
+            return self._mig_relay_add(relay, header, arrays, origin,
+                                       force_sync)
         table = self._table(header)
         self._heat_touch_dense(header, table)
         # dequant-before-apply: the table layer only ever sees floats
         delta = wire.decode_delta(header.get("quant"), arrays)
-        handle = table.add(delta, self._option(header),
-                           sync=bool(header.get("sync")) or force_sync)
+        mig = self._mig_forwarding()
+        if mig is None:
+            handle = table.add(
+                delta, self._option(header),
+                sync=bool(header.get("sync")) or force_sync)
+        else:
+            # donor mid-reshard: apply + forward under the migration
+            # lock (see _Migration) so this delta can never fall
+            # between a shipped chunk and its forward
+            with mig.lock:
+                handle = table.add(
+                    delta, self._option(header),
+                    sync=bool(header.get("sync")) or force_sync)
+                self._mig_forward_dense(
+                    mig, int(header["table"]), np.asarray(delta),
+                    header.get("option"),
+                    [(origin or "?", header.get("rid"))])
         return ({"ok": True, "gen": handle.generation}, [])
 
     def _op_kv_add(self, header: Dict[str, Any],
                    arrays: List[np.ndarray],
-                   force_sync: bool = False) -> tuple:
+                   force_sync: bool = False,
+                   origin: Optional[str] = None) -> tuple:
+        relay = self._relay_mode(header)
+        if relay is not None:
+            return self._mig_relay_kv_add(relay, header, arrays,
+                                          origin, force_sync)
         table = self._table(header)
         keys = np.ascontiguousarray(arrays[0]).astype(np.uint64,
                                                       copy=False)
         self._heat_touch_keys(header, keys)
         delta = wire.decode_delta(header.get("quant"), arrays[1:])
-        handle = table.add(keys, delta, self._option(header),
-                           sync=bool(header.get("sync")) or force_sync)
+        mig = self._mig_forwarding()
+        if mig is None:
+            handle = table.add(
+                keys, delta, self._option(header),
+                sync=bool(header.get("sync")) or force_sync)
+        else:
+            with mig.lock:
+                handle = table.add(
+                    keys, delta, self._option(header),
+                    sync=bool(header.get("sync")) or force_sync)
+                self._mig_forward_kv(
+                    mig, int(header["table"]), keys, np.asarray(delta),
+                    header.get("option"),
+                    [(origin or "?", header.get("rid"))])
         return ({"ok": True, "gen": handle.generation}, [])

@@ -759,3 +759,91 @@ def repl_unwrap(header: Dict[str, Any]) -> Tuple[
     return (orig, origins,
             int(pgen) if pgen is not None else None,
             int(tid) if tid is not None else None)
+
+
+# -- migration frames (live resharding v→v+1) ------------------------------
+#
+# A reshard streams ONLY the ranges :func:`partition.map_diff` says
+# change hands, over the same MVW1 wire as everything else. Frame
+# roles, all dispatched through the server's ``_execute``:
+#
+#   migrate_begin     admin → every member: the new map + member
+#                     addresses; donors start streaming, everyone
+#                     stages new-geometry shards
+#   migrate_state     admin → member poll: phase, shipped/forwarded
+#                     counters, whether this donor has drained
+#   migrate_commit    admin → member: swap staging in, flip the
+#                     member's map to v+1 (the fleet FILE flips after
+#                     every member acks — atomically, via os.replace)
+#   migrate_abort     admin → member: drop staging, keep serving v
+#   migrate_manifest  donor → recipient: table specs so a brand-new
+#                     member can create the tables (force_tid keeps
+#                     table-id spaces aligned, like streamed creates)
+#   migrate_chunk     donor → recipient: one moved range's raw values
+#                     (dense: the value slice; kv: key/value rows),
+#                     CRC32-stamped — a torn chunk aborts loudly
+#   migrate_fwd       donor → recipient: a write that landed in an
+#                     already-shipped range, forwarded with its
+#                     (client, rid) origins so the recipient's dedup
+#                     window keeps it exactly-once (the repl-stream
+#                     trick, pointed sideways)
+#   migrate_fin       donor → recipient: end of this donor's stream
+#                     (chunk count + byte total for the recipient's
+#                     own accounting)
+
+MIGRATE_BEGIN = "migrate_begin"
+MIGRATE_STATE = "migrate_state"
+MIGRATE_COMMIT = "migrate_commit"
+MIGRATE_ABORT = "migrate_abort"
+MIGRATE_MANIFEST = "migrate_manifest"
+MIGRATE_CHUNK = "migrate_chunk"
+MIGRATE_FWD = "migrate_fwd"
+MIGRATE_FIN = "migrate_fin"
+
+#: every migrate frame op, for dispatch-completeness lint and the
+#: admission layer's op classification
+MIGRATE_OPS = (MIGRATE_BEGIN, MIGRATE_STATE, MIGRATE_COMMIT,
+               MIGRATE_ABORT, MIGRATE_MANIFEST, MIGRATE_CHUNK,
+               MIGRATE_FWD, MIGRATE_FIN)
+
+
+def migrate_crc(arrays: Sequence[np.ndarray]) -> int:
+    """CRC32 chained over every payload array's raw bytes — the chunk
+    integrity stamp (same codec as checkpoint payload CRCs)."""
+    import zlib
+    crc = 0
+    for a in arrays:
+        crc = zlib.crc32(np.ascontiguousarray(a).tobytes(), crc)
+    return int(crc)
+
+
+def migrate_chunk_header(plan: str, *, table: int, kind: str,
+                         lo: int, hi: int, seq: int, from_rank: int,
+                         arrays: Sequence[np.ndarray]) -> Dict[str, Any]:
+    """One moved-range chunk's header. ``kind`` is "dense" (arrays =
+    [values] for GLOBAL element range [lo, hi)) or "kv" (arrays =
+    [keys u64, value rows] for keys whose logical bucket falls in
+    [lo, hi))."""
+    return {"op": MIGRATE_CHUNK, "plan": str(plan), "table": int(table),
+            "kind": str(kind), "range": [int(lo), int(hi)],
+            "seq": int(seq), "from_rank": int(from_rank),
+            "crc": migrate_crc(arrays)}
+
+
+def migrate_fwd_wrap(orig_header: Dict[str, Any], *, plan: str,
+                     from_rank: int,
+                     origins: Sequence[Tuple[str, Any]]) -> Dict[str, Any]:
+    """Wrap a forwarded write's header (the donor-decoded moved
+    portion) for the recipient, carrying the originating (client, rid)
+    pairs for the dedup window."""
+    return {"op": MIGRATE_FWD, "plan": str(plan),
+            "from_rank": int(from_rank), "orig": dict(orig_header),
+            "origins": [[str(c), r] for c, r in origins]}
+
+
+def migrate_fwd_unwrap(header: Dict[str, Any]) -> Tuple[
+        Dict[str, Any], List[Tuple[str, Any]]]:
+    """``(orig_header, origins)`` off a forwarded-write frame."""
+    orig = dict(header.get("orig") or {})
+    origins = [(str(c), r) for c, r in (header.get("origins") or [])]
+    return orig, origins

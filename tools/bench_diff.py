@@ -127,7 +127,13 @@ DEFAULT_WATCH = ("value", "e2e_words_per_sec", "lda_doc_tokens_per_sec",
                  # bytes per replicated byte — a drop toward 1.0 means
                  # the tap started re-encoding (or raw-syncing) instead
                  # of forwarding the original encoded frames
-                 "replication_bytes_ratio")
+                 "replication_bytes_ratio",
+                 # reshard lane (serving_mp --reshard): migration
+                 # throughput over the grow's closed-form moved set —
+                 # a drop means the chunk stream (or the admin wave
+                 # around it) got slower at moving the SAME bytes,
+                 # stretching the window where donors relay
+                 "reshard_moved_mb_per_sec")
 
 # LOWER-is-better watches: a rise past the threshold regresses
 DEFAULT_WATCH_LOWER = ("serving_p99_ms",
@@ -149,7 +155,13 @@ DEFAULT_WATCH_LOWER = ("serving_p99_ms",
                        # class p999 under a deliberate flooder — a rise
                        # means admission control stopped insulating
                        # well-behaved clients from the flood
-                       "serving_protected_p999_ms")
+                       "serving_protected_p999_ms",
+                       # reshard lane: worst-case client step stall
+                       # while the fleet grows under the write storm —
+                       # a rise means live resharding stopped being
+                       # live (a lock hold, an unthrottled stream, or
+                       # the relay path blocking the client)
+                       "reshard_p999_stall_ms")
 
 
 def _flatten(prefix: str, obj, out: Dict[str, float]) -> None:
@@ -608,6 +620,30 @@ def selftest() -> int:
         rp_doc3["replica_read_speedup"] = 6.2
         assert main([rp_old, put("rp_base.json", rp_doc3)]) == 0, \
             "the primary-pinned baseline rides along unwatched"
+        # reshard lane: migration throughput is watched higher, the
+        # under-storm stall tail lower — either regressing means live
+        # resharding got less live, while the moved-bytes accounting
+        # and the quiet baseline ride along unwatched
+        rs_old = put("rs_old.json", {
+            "metric": "reshard_moved_mb_per_sec", "value": 40.0,
+            "unit": "MB/s", "reshard_moved_mb_per_sec": 40.0,
+            "reshard_p999_stall_ms": 20.0,
+            "reshard_moved_bytes": 527484.0,
+            "reshard_quiet_p99_ms": 4.0})
+        rs_doc = json.loads(json.dumps(json.load(open(rs_old))))
+        rs_doc["reshard_moved_mb_per_sec"] = 10.0       # -75%
+        rs_doc["value"] = 10.0
+        assert main([rs_old, put("rs_slow.json", rs_doc)]) == 1, \
+            "migration throughput drop must fail (stream got slower)"
+        rs_doc2 = json.loads(json.dumps(json.load(open(rs_old))))
+        rs_doc2["reshard_p999_stall_ms"] = 400.0        # 20x stall
+        assert main([rs_old, put("rs_stall.json", rs_doc2)]) == 1, \
+            "under-reshard stall-tail rise must fail (not live anymore)"
+        rs_doc3 = json.loads(json.dumps(json.load(open(rs_old))))
+        rs_doc3["reshard_moved_bytes"] = 1000.0         # unwatched
+        rs_doc3["reshard_quiet_p99_ms"] = 9.0
+        assert main([rs_old, put("rs_ride.json", rs_doc3)]) == 0, \
+            "moved-bytes accounting rides along unwatched"
         # windowed-series docs (/vars?window= captures): rates,
         # gauges, and windowed quantiles flatten with their own
         # prefixes and diff like any snapshot

@@ -13,7 +13,12 @@ Checks per file:
 - every ``MVTPU_*`` env var named anywhere in the tree appears in the
   README knob reference — an undocumented knob is a knob nobody can
   tune (or kill). String constants that are prefixes (trailing
-  ``_``/``*``) are exempt; so are lines carrying ``# noqa``.
+  ``_``/``*``) are exempt; so are lines carrying ``# noqa``,
+- every MVW1 frame op constant ``server/wire.py`` defines (``*_OP``
+  names and the ``MIGRATE_OPS`` members) is referenced by the
+  dispatcher in ``server/table_server.py`` — an op the protocol
+  module ships but the server never matches is a frame every peer
+  can send and no one can serve.
 
 Exit status: number of findings (0 = clean), capped at 125.
 """
@@ -130,6 +135,66 @@ def knob_doc_findings(files: List[Path],
     return findings
 
 
+def wire_dispatch_findings(pkg: Path) -> List[str]:
+    """Every MVW1 frame op ``server/wire.py`` defines must be matched
+    by the dispatcher in ``server/table_server.py``.
+
+    Frame ops are the module-level string constants named ``*_OP``
+    plus every member of the ``MIGRATE_OPS`` tuple. A handler
+    "matches" an op when ``table_server.py`` references the constant
+    (``wire.MIGRATE_BEGIN``) or names the op string literally
+    (``op == "repl"``) — membership tests against the whole
+    ``MIGRATE_OPS`` tuple classify but do not dispatch, so they
+    deliberately do not count."""
+    wire_py = pkg / "server" / "wire.py"
+    server_py = pkg / "server" / "table_server.py"
+    for p in (wire_py, server_py):
+        if not p.is_file():
+            return [f"{p}: missing (wire-dispatch check needs it)"]
+    try:
+        wire_tree = ast.parse(wire_py.read_text(), str(wire_py))
+        srv_tree = ast.parse(server_py.read_text(), str(server_py))
+    except SyntaxError:
+        return []           # already reported by lint_file
+
+    consts: dict = {}       # NAME -> op string
+    migrate_members: List[str] = []
+    for node in wire_tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        name = node.targets[0].id
+        if isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            consts[name] = node.value.value
+        elif name == "MIGRATE_OPS" \
+                and isinstance(node.value, ast.Tuple):
+            migrate_members = [e.id for e in node.value.elts
+                               if isinstance(e, ast.Name)]
+    ops = {n: v for n, v in consts.items()
+           if n.endswith("_OP") or n in migrate_members}
+
+    literals = set()
+    wire_attrs = set()
+    for node in ast.walk(srv_tree):
+        if isinstance(node, ast.Constant) \
+                and isinstance(node.value, str):
+            literals.add(node.value)
+        elif isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "wire":
+            wire_attrs.add(node.attr)
+
+    findings = []
+    for name, op in sorted(ops.items()):
+        if name in wire_attrs or op in literals:
+            continue
+        findings.append(
+            f"{wire_py}: frame op {name} = {op!r} has no dispatch "
+            f"handler in {server_py.name}")
+    return findings
+
+
 def main(argv: List[str]) -> int:
     roots = [Path(p) for p in (argv or ["multiverso_tpu"])]
     files: List[Path] = []
@@ -141,8 +206,9 @@ def main(argv: List[str]) -> int:
     findings: List[str] = []
     for f in files:
         findings.extend(lint_file(f))
-    readme = Path(__file__).resolve().parent.parent / "README.md"
-    findings.extend(knob_doc_findings(files, readme))
+    repo = Path(__file__).resolve().parent.parent
+    findings.extend(knob_doc_findings(files, repo / "README.md"))
+    findings.extend(wire_dispatch_findings(repo / "multiverso_tpu"))
     for line in findings:
         print(line)
     print(f"lint: {len(files)} files, {len(findings)} finding(s)",
